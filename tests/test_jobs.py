@@ -1,0 +1,71 @@
+"""Job TOML parsing tests, including a reference-shaped TOML golden."""
+
+import pytest
+
+from tpu_render_cluster.jobs.models import (
+    BlenderJob,
+    DistributionStrategy,
+    TpuBatchStrategyOptions,
+)
+from tpu_render_cluster.utils.paths import parse_with_base_directory_prefix
+
+REFERENCE_SHAPED_TOML = """
+job_name = "04_very-simple_measuring_14400f-40w_dynamic"
+job_description = "14400 frames across 40 workers, dynamic strategy"
+project_file_path = "%BASE%/blender-projects/04_very-simple/04_very-simple.blend"
+render_script_path = "%BASE%/scripts/render-timing-script.py"
+frame_range_from = 1
+frame_range_to = 14400
+wait_for_number_of_workers = 40
+output_directory_path = "%BASE%/blender-projects/04_very-simple/frames"
+output_file_name_format = "rendered-######"
+output_file_format = "JPEG"
+
+[frame_distribution_strategy]
+strategy_type = "dynamic"
+target_queue_size = 4
+min_queue_size_to_steal = 2
+min_seconds_before_resteal_to_elsewhere = 40
+min_seconds_before_resteal_to_original_worker = 80
+"""
+
+
+def test_load_reference_shaped_toml(tmp_path):
+    path = tmp_path / "job.toml"
+    path.write_text(REFERENCE_SHAPED_TOML)
+    job = BlenderJob.load_from_file(path)
+    assert job.job_name == "04_very-simple_measuring_14400f-40w_dynamic"
+    assert job.frame_count() == 14400
+    assert job.wait_for_number_of_workers == 40
+    strategy = job.frame_distribution_strategy
+    assert strategy.strategy_type == "dynamic"
+    assert strategy.dynamic.target_queue_size == 4
+    assert strategy.dynamic.min_seconds_before_resteal_to_original_worker == 80
+    # Round-trips through the wire dict.
+    assert BlenderJob.from_dict(job.to_dict()) == job
+
+
+def test_missing_file_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        BlenderJob.load_from_file(tmp_path / "nope.toml")
+
+
+def test_tpu_batch_strategy_round_trip():
+    strategy = DistributionStrategy.tpu_batch_strategy(
+        TpuBatchStrategyOptions(target_queue_size=6)
+    )
+    assert DistributionStrategy.from_dict(strategy.to_dict()) == strategy
+    assert strategy.to_dict()["strategy_type"] == "tpu-batch"
+
+
+def test_base_placeholder_resolution(tmp_path):
+    resolved = parse_with_base_directory_prefix("%BASE%/a/b.blend", tmp_path)
+    assert resolved == tmp_path / "a/b.blend"
+    plain = parse_with_base_directory_prefix("/abs/path.blend", tmp_path)
+    assert str(plain) == "/abs/path.blend"
+
+
+def test_tilde_expansion(monkeypatch, tmp_path):
+    monkeypatch.setenv("HOME", str(tmp_path))
+    resolved = parse_with_base_directory_prefix("~/x.blend", None)
+    assert resolved == tmp_path / "x.blend"

@@ -1,0 +1,120 @@
+"""Trace model round-trips and performance-reducer semantics.
+
+The JSON field names must match what the reference analysis suite parses
+(analysis/core/models.py:46-131); the idle-time semantics match
+shared/src/results/performance.rs:46-144.
+"""
+
+import json
+
+import pytest
+
+from tpu_render_cluster.traces.performance import WorkerPerformance
+from tpu_render_cluster.traces.worker_trace import (
+    FrameRenderTime,
+    WorkerFrameTrace,
+    WorkerTrace,
+    WorkerTraceBuilder,
+)
+
+
+def frame(start: float, duration: float = 4.0) -> WorkerFrameTrace:
+    return WorkerFrameTrace(
+        frame_index=int(start),
+        details=FrameRenderTime(
+            started_process_at=start,
+            finished_loading_at=start + 1.0,
+            started_rendering_at=start + 1.0,
+            finished_rendering_at=start + 3.0,
+            file_saving_started_at=start + 3.0,
+            file_saving_finished_at=start + 3.5,
+            exited_process_at=start + duration,
+        ),
+    )
+
+
+def test_builder_requires_start_and_finish():
+    builder = WorkerTraceBuilder()
+    with pytest.raises(ValueError):
+        builder.build()
+    builder.set_job_start_time(100.0)
+    with pytest.raises(ValueError):
+        builder.build()
+    builder.set_job_finish_time(200.0)
+    trace = builder.build()
+    assert trace.job_start_time == 100.0
+    assert trace.frame_render_traces == []
+
+
+def test_trace_json_schema_keys():
+    builder = WorkerTraceBuilder()
+    builder.set_job_start_time(100.0)
+    builder.set_job_finish_time(200.0)
+    builder.increment_total_queued_frames()
+    builder.trace_new_ping(110.0, 110.002)
+    builder.trace_new_rendered_frame(3, frame(120.0).details)
+    data = builder.build().to_dict()
+    # Exact key set the analysis suite parses.
+    assert set(data.keys()) == {
+        "total_queued_frames",
+        "total_queued_frames_removed_from_queue",
+        "job_start_time",
+        "job_finish_time",
+        "frame_render_traces",
+        "ping_traces",
+        "reconnection_traces",
+    }
+    frame_entry = data["frame_render_traces"][0]
+    assert frame_entry["frame_index"] == 3
+    assert set(frame_entry["details"].keys()) == {
+        "started_process_at",
+        "finished_loading_at",
+        "started_rendering_at",
+        "finished_rendering_at",
+        "file_saving_started_at",
+        "file_saving_finished_at",
+        "exited_process_at",
+    }
+    # All timestamps are plain floats (fractional unix seconds).
+    assert all(isinstance(v, float) for v in frame_entry["details"].values())
+    round_tripped = WorkerTrace.from_dict(json.loads(json.dumps(data)))
+    assert round_tripped.to_dict() == data
+
+
+def test_performance_reducer_idle_semantics():
+    # Three frames: lead-in 5s, gap1 2s (counted for middle frame), gap2 3s
+    # (NOT counted — reference branch ordering), tail 4s.
+    frames = [frame(105.0), frame(111.0), frame(118.0)]
+    trace = WorkerTrace(
+        total_queued_frames=3,
+        total_queued_frames_removed_from_queue=1,
+        job_start_time=100.0,
+        job_finish_time=126.0,
+        frame_render_traces=frames,
+        ping_traces=[],
+        reconnection_traces=[],
+    )
+    perf = WorkerPerformance.from_worker_trace(trace)
+    assert perf.total_frames_rendered == 3
+    assert perf.total_frames_queued == 3
+    assert perf.total_frames_stolen_from_queue == 1
+    assert perf.total_time == 26.0
+    assert perf.total_blend_file_reading_time == pytest.approx(3.0)
+    assert perf.total_rendering_time == pytest.approx(6.0)
+    assert perf.total_image_saving_time == pytest.approx(1.5)
+    # lead-in (105-100) + gap1 (111-109) + tail (126-122) = 5 + 2 + 4 = 11
+    assert perf.total_idle_time == pytest.approx(11.0)
+
+
+def test_performance_rejects_negative_durations():
+    bad = WorkerTrace(
+        total_queued_frames=0,
+        total_queued_frames_removed_from_queue=0,
+        job_start_time=200.0,
+        job_finish_time=100.0,
+        frame_render_traces=[],
+        ping_traces=[],
+        reconnection_traces=[],
+    )
+    with pytest.raises(ValueError):
+        WorkerPerformance.from_worker_trace(bad)

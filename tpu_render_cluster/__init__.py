@@ -1,0 +1,21 @@
+"""tpu_render_cluster — TPU-native distributed rendering framework.
+
+A master/worker render farm with the capabilities of the reference render
+cluster (see SURVEY.md): a 14-message WebSocket job protocol, pluggable
+frame-distribution strategies (naive-fine, eager-naive-coarse, dynamic work
+stealing, and the TPU cost-matrix `tpu-batch` scheduler), pluggable render
+backends (Blender subprocess, pure-JAX/Pallas `tpu-raytrace` path tracer),
+7-phase frame timing traces, and an analysis suite compatible with the
+reference's raw-trace JSON schema.
+
+Control-plane semantics follow the reference contract
+(`/root/reference/shared/src/` et al., cited per-module); the implementation
+is TPU-first: JAX/XLA/Pallas for compute and scheduling math, asyncio +
+a C++ codec for the control plane.
+"""
+
+__version__ = "1.0.0"
+
+# The protocol version exchanged during the handshake. The reference sends its
+# crate version here (reference: shared/src/messages/handshake.rs:31-47).
+PROTOCOL_VERSION = __version__

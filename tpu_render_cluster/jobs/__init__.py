@@ -1,0 +1,15 @@
+from tpu_render_cluster.jobs.models import (
+    BlenderJob,
+    DistributionStrategy,
+    DynamicStrategyOptions,
+    EagerNaiveCoarseOptions,
+    TpuBatchStrategyOptions,
+)
+
+__all__ = [
+    "BlenderJob",
+    "DistributionStrategy",
+    "DynamicStrategyOptions",
+    "EagerNaiveCoarseOptions",
+    "TpuBatchStrategyOptions",
+]

@@ -1,0 +1,245 @@
+"""Job definition model.
+
+The job schema matches the reference's ``BlenderJob`` TOML contract
+(reference: shared/src/jobs/mod.rs:7-101): job name/description, project
+file + render script paths (with %BASE% placeholder support), inclusive
+frame range, the worker-count barrier, an internally-tagged distribution
+strategy, and output directory / name format / file format.
+
+New in this build: the ``tpu-batch`` strategy (cost-matrix assignment solved
+on TPU, see tpu_render_cluster/master/tpu_batch.py) and an optional
+``render_backend`` hint ('blender' | 'tpu-raytrace') that workers may use as
+a default when no CLI backend is given. Both are backward compatible: the
+reference's job TOMLs parse unchanged, and serialisation of the three
+reference strategies is byte-identical in structure.
+"""
+
+from __future__ import annotations
+
+import tomllib
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+
+@dataclass(frozen=True)
+class DynamicStrategyOptions:
+    """Tuning knobs of the dynamic work-stealing strategy.
+
+    Reference: shared/src/jobs/mod.rs:8-30.
+    """
+
+    target_queue_size: int
+    min_queue_size_to_steal: int
+    min_seconds_before_resteal_to_elsewhere: int
+    min_seconds_before_resteal_to_original_worker: int
+
+
+@dataclass(frozen=True)
+class EagerNaiveCoarseOptions:
+    target_queue_size: int
+
+
+@dataclass(frozen=True)
+class TpuBatchStrategyOptions:
+    """Tuning knobs of the TPU cost-matrix scheduler (new in this build).
+
+    The scheduler keeps every worker's queue topped up to
+    ``target_queue_size`` like eager-naive-coarse, but chooses *which* frame
+    goes to *which* worker by solving a batched assignment problem on TPU
+    (predicted frame time x worker load), and steals from overloaded workers
+    like the dynamic strategy when the pending pool runs dry.
+    """
+
+    target_queue_size: int = 4
+    min_queue_size_to_steal: int = 2
+    min_seconds_before_resteal_to_elsewhere: int = 40
+    min_seconds_before_resteal_to_original_worker: int = 80
+    # EMA smoothing factor for per-worker frame-time prediction.
+    cost_ema_alpha: float = 0.3
+
+
+STRATEGY_NAIVE_FINE = "naive-fine"
+STRATEGY_EAGER_NAIVE_COARSE = "eager-naive-coarse"
+STRATEGY_DYNAMIC = "dynamic"
+STRATEGY_TPU_BATCH = "tpu-batch"
+
+
+@dataclass(frozen=True)
+class DistributionStrategy:
+    """Internally-tagged strategy enum.
+
+    Serialised as ``{"strategy_type": "...", ...options}`` exactly like the
+    reference's serde representation (shared/src/jobs/mod.rs:32-43), so the
+    analysis suite's ``FrameDistributionStrategy.from_raw_data`` keeps
+    working (analysis/core/models.py:16-27).
+    """
+
+    strategy_type: str
+    eager: EagerNaiveCoarseOptions | None = None
+    dynamic: DynamicStrategyOptions | None = None
+    tpu_batch: TpuBatchStrategyOptions | None = None
+
+    # -- constructors ------------------------------------------------------
+
+    @classmethod
+    def naive_fine(cls) -> "DistributionStrategy":
+        return cls(STRATEGY_NAIVE_FINE)
+
+    @classmethod
+    def eager_naive_coarse(cls, target_queue_size: int) -> "DistributionStrategy":
+        return cls(
+            STRATEGY_EAGER_NAIVE_COARSE,
+            eager=EagerNaiveCoarseOptions(target_queue_size),
+        )
+
+    @classmethod
+    def dynamic_strategy(cls, options: DynamicStrategyOptions) -> "DistributionStrategy":
+        return cls(STRATEGY_DYNAMIC, dynamic=options)
+
+    @classmethod
+    def tpu_batch_strategy(cls, options: TpuBatchStrategyOptions | None = None) -> "DistributionStrategy":
+        return cls(STRATEGY_TPU_BATCH, tpu_batch=options or TpuBatchStrategyOptions())
+
+    # -- serde -------------------------------------------------------------
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {"strategy_type": self.strategy_type}
+        if self.strategy_type == STRATEGY_EAGER_NAIVE_COARSE:
+            assert self.eager is not None
+            out["target_queue_size"] = self.eager.target_queue_size
+        elif self.strategy_type == STRATEGY_DYNAMIC:
+            assert self.dynamic is not None
+            out["target_queue_size"] = self.dynamic.target_queue_size
+            out["min_queue_size_to_steal"] = self.dynamic.min_queue_size_to_steal
+            out["min_seconds_before_resteal_to_elsewhere"] = (
+                self.dynamic.min_seconds_before_resteal_to_elsewhere
+            )
+            out["min_seconds_before_resteal_to_original_worker"] = (
+                self.dynamic.min_seconds_before_resteal_to_original_worker
+            )
+        elif self.strategy_type == STRATEGY_TPU_BATCH:
+            assert self.tpu_batch is not None
+            out["target_queue_size"] = self.tpu_batch.target_queue_size
+            out["min_queue_size_to_steal"] = self.tpu_batch.min_queue_size_to_steal
+            out["min_seconds_before_resteal_to_elsewhere"] = (
+                self.tpu_batch.min_seconds_before_resteal_to_elsewhere
+            )
+            out["min_seconds_before_resteal_to_original_worker"] = (
+                self.tpu_batch.min_seconds_before_resteal_to_original_worker
+            )
+            out["cost_ema_alpha"] = self.tpu_batch.cost_ema_alpha
+        return out
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "DistributionStrategy":
+        strategy_type = str(data["strategy_type"])
+        if strategy_type == STRATEGY_NAIVE_FINE:
+            return cls.naive_fine()
+        if strategy_type == STRATEGY_EAGER_NAIVE_COARSE:
+            return cls.eager_naive_coarse(int(data["target_queue_size"]))
+        if strategy_type == STRATEGY_DYNAMIC:
+            return cls.dynamic_strategy(
+                DynamicStrategyOptions(
+                    target_queue_size=int(data["target_queue_size"]),
+                    min_queue_size_to_steal=int(data["min_queue_size_to_steal"]),
+                    min_seconds_before_resteal_to_elsewhere=int(
+                        data["min_seconds_before_resteal_to_elsewhere"]
+                    ),
+                    min_seconds_before_resteal_to_original_worker=int(
+                        data["min_seconds_before_resteal_to_original_worker"]
+                    ),
+                )
+            )
+        if strategy_type == STRATEGY_TPU_BATCH:
+            return cls.tpu_batch_strategy(
+                TpuBatchStrategyOptions(
+                    target_queue_size=int(data.get("target_queue_size", 4)),
+                    min_queue_size_to_steal=int(data.get("min_queue_size_to_steal", 2)),
+                    min_seconds_before_resteal_to_elsewhere=int(
+                        data.get("min_seconds_before_resteal_to_elsewhere", 40)
+                    ),
+                    min_seconds_before_resteal_to_original_worker=int(
+                        data.get("min_seconds_before_resteal_to_original_worker", 80)
+                    ),
+                    cost_ema_alpha=float(data.get("cost_ema_alpha", 0.3)),
+                )
+            )
+        raise ValueError(f"Unknown strategy_type: {strategy_type!r}")
+
+
+@dataclass(frozen=True)
+class BlenderJob:
+    """A render job definition (reference: shared/src/jobs/mod.rs:46-81)."""
+
+    job_name: str
+    job_description: str | None
+    project_file_path: str
+    render_script_path: str
+    frame_range_from: int  # inclusive
+    frame_range_to: int  # inclusive
+    wait_for_number_of_workers: int
+    frame_distribution_strategy: DistributionStrategy
+    output_directory_path: str
+    output_file_name_format: str
+    output_file_format: str
+    # New (optional, absent from reference TOMLs): default worker backend hint.
+    render_backend: str | None = None
+
+    # -- derived -----------------------------------------------------------
+
+    def frame_indices(self) -> range:
+        return range(self.frame_range_from, self.frame_range_to + 1)
+
+    def frame_count(self) -> int:
+        return self.frame_range_to - self.frame_range_from + 1
+
+    # -- serde -------------------------------------------------------------
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "job_name": self.job_name,
+            "job_description": self.job_description,
+            "project_file_path": self.project_file_path,
+            "render_script_path": self.render_script_path,
+            "frame_range_from": self.frame_range_from,
+            "frame_range_to": self.frame_range_to,
+            "wait_for_number_of_workers": self.wait_for_number_of_workers,
+            "frame_distribution_strategy": self.frame_distribution_strategy.to_dict(),
+            "output_directory_path": self.output_directory_path,
+            "output_file_name_format": self.output_file_name_format,
+            "output_file_format": self.output_file_format,
+        }
+        if self.render_backend is not None:
+            out["render_backend"] = self.render_backend
+        return out
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "BlenderJob":
+        return cls(
+            job_name=str(data["job_name"]),
+            job_description=data.get("job_description"),
+            project_file_path=str(data["project_file_path"]),
+            render_script_path=str(data["render_script_path"]),
+            frame_range_from=int(data["frame_range_from"]),
+            frame_range_to=int(data["frame_range_to"]),
+            wait_for_number_of_workers=int(data["wait_for_number_of_workers"]),
+            frame_distribution_strategy=DistributionStrategy.from_dict(
+                data["frame_distribution_strategy"]
+            ),
+            output_directory_path=str(data["output_directory_path"]),
+            output_file_name_format=str(data["output_file_name_format"]),
+            output_file_format=str(data["output_file_format"]),
+            render_backend=data.get("render_backend"),
+        )
+
+    @classmethod
+    def load_from_file(cls, path: str | Path) -> "BlenderJob":
+        path = Path(path)
+        if path.exists() and not path.is_file():
+            raise ValueError(f"Path exists, but it is not a file: {path}")
+        if not path.exists():
+            raise FileNotFoundError(f"No such job file: {path}")
+        with path.open("rb") as f:
+            data = tomllib.load(f)
+        return cls.from_dict(data)

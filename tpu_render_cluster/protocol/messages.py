@@ -1,0 +1,456 @@
+"""The 14-message job protocol.
+
+Wire format is the reference's externally-observable contract: a JSON text
+frame ``{"message_type": "<tag>", "payload": {...}}`` (reference:
+shared/src/messages/mod.rs:150-236) with the exact serde tags from the
+reference's enum (including the asymmetric ``response_frame-queue-add`` tag,
+shared/src/messages/mod.rs:171). Requests carry a random u64
+``message_request_id``; responses echo it as ``message_request_context_id``
+(shared/src/messages/utilities.rs:5-14, shared/src/messages/queue.rs:13-100).
+
+Worker IDs are random u32s displayed as 8-hex
+(shared/src/messages/handshake.rs:9-26).
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+from dataclasses import dataclass
+from typing import Any, ClassVar
+
+from tpu_render_cluster.jobs.models import BlenderJob
+from tpu_render_cluster.traces.worker_trace import WorkerTrace
+from tpu_render_cluster.utils.timestamps import now_ts
+
+# ---------------------------------------------------------------------------
+# IDs
+
+def generate_message_request_id() -> int:
+    """Random u64 request id (reference: shared/src/messages/utilities.rs:11)."""
+    return secrets.randbits(64)
+
+
+def generate_worker_id() -> int:
+    """Random u32 worker id (reference: shared/src/messages/handshake.rs:20)."""
+    return secrets.randbits(32)
+
+
+def worker_id_to_string(worker_id: int) -> str:
+    """Workers display as 8-hex (reference: shared/src/messages/handshake.rs:14-17)."""
+    return f"{worker_id:08x}"
+
+
+# ---------------------------------------------------------------------------
+# Result-enum wire values
+
+FRAME_QUEUE_ADD_RESULT_ADDED = "added-to-queue"
+FRAME_QUEUE_ADD_RESULT_ERRORED = "errored"
+
+FRAME_QUEUE_REMOVE_RESULT_REMOVED = "removed-from-queue"
+FRAME_QUEUE_REMOVE_RESULT_ALREADY_RENDERING = "already-rendering"
+FRAME_QUEUE_REMOVE_RESULT_ALREADY_FINISHED = "already-finished"
+FRAME_QUEUE_REMOVE_RESULT_ERRORED = "errored"
+
+FRAME_QUEUE_ITEM_FINISHED_OK = "ok"
+FRAME_QUEUE_ITEM_FINISHED_ERRORED = "errored"
+
+HANDSHAKE_TYPE_FIRST_CONNECTION = "first-connection"
+HANDSHAKE_TYPE_RECONNECTING = "reconnecting"
+
+
+def _result_to_dict(result: str, error_reason: str | None) -> dict[str, Any]:
+    out: dict[str, Any] = {"result": result}
+    if result == "errored":
+        out["reason"] = error_reason or ""
+    return out
+
+
+def _result_from_dict(data: dict[str, Any]) -> tuple[str, str | None]:
+    return str(data["result"]), data.get("reason")
+
+
+# ---------------------------------------------------------------------------
+# Message classes
+
+
+class Message:
+    """Base class; subclasses define ``type_name`` (the wire tag) and payload serde."""
+
+    type_name: ClassVar[str]
+
+    def to_payload(self) -> dict[str, Any]:  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    @classmethod
+    def from_payload(cls, payload: dict[str, Any]) -> "Message":  # pragma: no cover
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class MasterHandshakeRequest(Message):
+    """M→W (reference: shared/src/messages/handshake.rs:31-47)."""
+
+    type_name: ClassVar[str] = "handshake_request"
+    server_version: str
+
+    def to_payload(self) -> dict[str, Any]:
+        return {"server_version": self.server_version}
+
+    @classmethod
+    def from_payload(cls, payload: dict[str, Any]) -> "MasterHandshakeRequest":
+        return cls(server_version=str(payload["server_version"]))
+
+
+@dataclass(frozen=True)
+class WorkerHandshakeResponse(Message):
+    """W→M (reference: shared/src/messages/handshake.rs:66-117)."""
+
+    type_name: ClassVar[str] = "handshake_response"
+    handshake_type: str  # "first-connection" | "reconnecting"
+    worker_version: str
+    worker_id: int
+
+    def to_payload(self) -> dict[str, Any]:
+        return {
+            "handshake_type": self.handshake_type,
+            "worker_version": self.worker_version,
+            "worker_id": self.worker_id,
+        }
+
+    @classmethod
+    def from_payload(cls, payload: dict[str, Any]) -> "WorkerHandshakeResponse":
+        return cls(
+            handshake_type=str(payload["handshake_type"]),
+            worker_version=str(payload["worker_version"]),
+            worker_id=int(payload["worker_id"]),
+        )
+
+
+@dataclass(frozen=True)
+class MasterHandshakeAcknowledgement(Message):
+    """M→W (reference: shared/src/messages/handshake.rs:139-153)."""
+
+    type_name: ClassVar[str] = "handshake_acknowledgement"
+    ok: bool
+
+    def to_payload(self) -> dict[str, Any]:
+        return {"ok": self.ok}
+
+    @classmethod
+    def from_payload(cls, payload: dict[str, Any]) -> "MasterHandshakeAcknowledgement":
+        return cls(ok=bool(payload["ok"]))
+
+
+@dataclass(frozen=True)
+class MasterFrameQueueAddRequest(Message):
+    """M→W: queue a frame; carries the full job (shared/src/messages/queue.rs:15-38)."""
+
+    type_name: ClassVar[str] = "request_frame-queue_add"
+    message_request_id: int
+    job: BlenderJob
+    frame_index: int
+
+    @classmethod
+    def new(cls, job: BlenderJob, frame_index: int) -> "MasterFrameQueueAddRequest":
+        return cls(generate_message_request_id(), job, frame_index)
+
+    def to_payload(self) -> dict[str, Any]:
+        return {
+            "message_request_id": self.message_request_id,
+            "job": self.job.to_dict(),
+            "frame_index": self.frame_index,
+        }
+
+    @classmethod
+    def from_payload(cls, payload: dict[str, Any]) -> "MasterFrameQueueAddRequest":
+        return cls(
+            message_request_id=int(payload["message_request_id"]),
+            job=BlenderJob.from_dict(payload["job"]),
+            frame_index=int(payload["frame_index"]),
+        )
+
+
+@dataclass(frozen=True)
+class WorkerFrameQueueAddResponse(Message):
+    """W→M (shared/src/messages/queue.rs:61-100). Note the asymmetric wire tag."""
+
+    type_name: ClassVar[str] = "response_frame-queue-add"
+    message_request_context_id: int
+    result: str
+    error_reason: str | None = None
+
+    @classmethod
+    def new_ok(cls, request_id: int) -> "WorkerFrameQueueAddResponse":
+        return cls(request_id, FRAME_QUEUE_ADD_RESULT_ADDED)
+
+    @classmethod
+    def new_errored(cls, request_id: int, reason: str) -> "WorkerFrameQueueAddResponse":
+        return cls(request_id, FRAME_QUEUE_ADD_RESULT_ERRORED, reason)
+
+    def to_payload(self) -> dict[str, Any]:
+        return {
+            "message_request_context_id": self.message_request_context_id,
+            "result": _result_to_dict(self.result, self.error_reason),
+        }
+
+    @classmethod
+    def from_payload(cls, payload: dict[str, Any]) -> "WorkerFrameQueueAddResponse":
+        result, reason = _result_from_dict(payload["result"])
+        return cls(int(payload["message_request_context_id"]), result, reason)
+
+
+@dataclass(frozen=True)
+class MasterFrameQueueRemoveRequest(Message):
+    """M→W: un-queue (steal) a frame (shared/src/messages/queue.rs:123-146)."""
+
+    type_name: ClassVar[str] = "request_frame-queue_remove"
+    message_request_id: int
+    job_name: str
+    frame_index: int
+
+    @classmethod
+    def new(cls, job_name: str, frame_index: int) -> "MasterFrameQueueRemoveRequest":
+        return cls(generate_message_request_id(), job_name, frame_index)
+
+    def to_payload(self) -> dict[str, Any]:
+        return {
+            "message_request_id": self.message_request_id,
+            "job_name": self.job_name,
+            "frame_index": self.frame_index,
+        }
+
+    @classmethod
+    def from_payload(cls, payload: dict[str, Any]) -> "MasterFrameQueueRemoveRequest":
+        return cls(
+            message_request_id=int(payload["message_request_id"]),
+            job_name=str(payload["job_name"]),
+            frame_index=int(payload["frame_index"]),
+        )
+
+
+@dataclass(frozen=True)
+class WorkerFrameQueueRemoveResponse(Message):
+    """W→M (shared/src/messages/queue.rs:168-227)."""
+
+    type_name: ClassVar[str] = "response_frame-queue_remove"
+    message_request_context_id: int
+    result: str
+    error_reason: str | None = None
+
+    @classmethod
+    def new_with_result(
+        cls, request_id: int, result: str, reason: str | None = None
+    ) -> "WorkerFrameQueueRemoveResponse":
+        return cls(request_id, result, reason)
+
+    def to_payload(self) -> dict[str, Any]:
+        return {
+            "message_request_context_id": self.message_request_context_id,
+            "result": _result_to_dict(self.result, self.error_reason),
+        }
+
+    @classmethod
+    def from_payload(cls, payload: dict[str, Any]) -> "WorkerFrameQueueRemoveResponse":
+        result, reason = _result_from_dict(payload["result"])
+        return cls(int(payload["message_request_context_id"]), result, reason)
+
+
+@dataclass(frozen=True)
+class WorkerFrameQueueItemRenderingEvent(Message):
+    """W→M: frame started rendering (shared/src/messages/queue.rs:255-274).
+
+    The reference defines + handles this event but its worker never emits it
+    (SURVEY.md §3.3); our worker does emit it, completing the protocol.
+    """
+
+    type_name: ClassVar[str] = "event_frame-queue_item-started-rendering"
+    job_name: str
+    frame_index: int
+
+    def to_payload(self) -> dict[str, Any]:
+        return {"job_name": self.job_name, "frame_index": self.frame_index}
+
+    @classmethod
+    def from_payload(cls, payload: dict[str, Any]) -> "WorkerFrameQueueItemRenderingEvent":
+        return cls(str(payload["job_name"]), int(payload["frame_index"]))
+
+
+@dataclass(frozen=True)
+class WorkerFrameQueueItemFinishedEvent(Message):
+    """W→M: frame finished (ok | errored) (shared/src/messages/queue.rs:299-343).
+
+    Unlike the reference's worker (which swallows render errors —
+    worker/src/rendering/queue.rs:169-174), ours reports errors so the
+    master can reschedule instead of hanging.
+    """
+
+    type_name: ClassVar[str] = "event_frame-queue_item-finished"
+    job_name: str
+    frame_index: int
+    result: str  # "ok" | "errored"
+    error_reason: str | None = None
+
+    @classmethod
+    def new_ok(cls, job_name: str, frame_index: int) -> "WorkerFrameQueueItemFinishedEvent":
+        return cls(job_name, frame_index, FRAME_QUEUE_ITEM_FINISHED_OK)
+
+    @classmethod
+    def new_errored(
+        cls, job_name: str, frame_index: int, reason: str
+    ) -> "WorkerFrameQueueItemFinishedEvent":
+        return cls(job_name, frame_index, FRAME_QUEUE_ITEM_FINISHED_ERRORED, reason)
+
+    def to_payload(self) -> dict[str, Any]:
+        return {
+            "job_name": self.job_name,
+            "frame_index": self.frame_index,
+            "result": _result_to_dict(self.result, self.error_reason),
+        }
+
+    @classmethod
+    def from_payload(cls, payload: dict[str, Any]) -> "WorkerFrameQueueItemFinishedEvent":
+        result, reason = _result_from_dict(payload["result"])
+        return cls(str(payload["job_name"]), int(payload["frame_index"]), result, reason)
+
+
+@dataclass(frozen=True)
+class MasterHeartbeatRequest(Message):
+    """M→W ping with fractional unix timestamp (shared/src/messages/heartbeat.rs:12-31)."""
+
+    type_name: ClassVar[str] = "request_heartbeat"
+    request_time: float
+
+    @classmethod
+    def new_now(cls) -> "MasterHeartbeatRequest":
+        return cls(request_time=now_ts())
+
+    def to_payload(self) -> dict[str, Any]:
+        return {"request_time": self.request_time}
+
+    @classmethod
+    def from_payload(cls, payload: dict[str, Any]) -> "MasterHeartbeatRequest":
+        return cls(request_time=float(payload["request_time"]))
+
+
+@dataclass(frozen=True)
+class WorkerHeartbeatResponse(Message):
+    """W→M empty pong (shared/src/messages/heartbeat.rs:52-66)."""
+
+    type_name: ClassVar[str] = "response_heartbeat"
+
+    def to_payload(self) -> dict[str, Any]:
+        return {}
+
+    @classmethod
+    def from_payload(cls, payload: dict[str, Any]) -> "WorkerHeartbeatResponse":
+        return cls()
+
+
+@dataclass(frozen=True)
+class MasterJobStartedEvent(Message):
+    """M→W empty job-started broadcast (shared/src/messages/job.rs:11-25)."""
+
+    type_name: ClassVar[str] = "event_job-started"
+
+    def to_payload(self) -> dict[str, Any]:
+        return {}
+
+    @classmethod
+    def from_payload(cls, payload: dict[str, Any]) -> "MasterJobStartedEvent":
+        return cls()
+
+
+@dataclass(frozen=True)
+class MasterJobFinishedRequest(Message):
+    """M→W: request the worker's trace (shared/src/messages/job.rs:48-67)."""
+
+    type_name: ClassVar[str] = "request_job-finished"
+    message_request_id: int
+
+    @classmethod
+    def new(cls) -> "MasterJobFinishedRequest":
+        return cls(generate_message_request_id())
+
+    def to_payload(self) -> dict[str, Any]:
+        return {"message_request_id": self.message_request_id}
+
+    @classmethod
+    def from_payload(cls, payload: dict[str, Any]) -> "MasterJobFinishedRequest":
+        return cls(message_request_id=int(payload["message_request_id"]))
+
+
+@dataclass(frozen=True)
+class WorkerJobFinishedResponse(Message):
+    """W→M: the full WorkerTrace (shared/src/messages/job.rs:90-110)."""
+
+    type_name: ClassVar[str] = "response_job-finished"
+    message_request_context_id: int
+    trace: WorkerTrace
+
+    def to_payload(self) -> dict[str, Any]:
+        return {
+            "message_request_context_id": self.message_request_context_id,
+            "trace": self.trace.to_dict(),
+        }
+
+    @classmethod
+    def from_payload(cls, payload: dict[str, Any]) -> "WorkerJobFinishedResponse":
+        return cls(
+            message_request_context_id=int(payload["message_request_context_id"]),
+            trace=WorkerTrace.from_dict(payload["trace"]),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Envelope
+
+ALL_MESSAGE_TYPES: tuple[type[Message], ...] = (
+    MasterHandshakeRequest,
+    WorkerHandshakeResponse,
+    MasterHandshakeAcknowledgement,
+    MasterFrameQueueAddRequest,
+    WorkerFrameQueueAddResponse,
+    MasterFrameQueueRemoveRequest,
+    WorkerFrameQueueRemoveResponse,
+    WorkerFrameQueueItemRenderingEvent,
+    WorkerFrameQueueItemFinishedEvent,
+    MasterHeartbeatRequest,
+    WorkerHeartbeatResponse,
+    MasterJobStartedEvent,
+    MasterJobFinishedRequest,
+    WorkerJobFinishedResponse,
+)
+
+_TYPE_REGISTRY: dict[str, type[Message]] = {m.type_name: m for m in ALL_MESSAGE_TYPES}
+
+
+def encode_message(message: Message) -> str:
+    """Serialise to the tagged JSON envelope (a WS text frame)."""
+    return json.dumps(
+        {"message_type": message.type_name, "payload": message.to_payload()},
+        separators=(",", ":"),
+    )
+
+
+def decode_message(text: str | bytes) -> Message:
+    """Parse a tagged JSON envelope back into a typed message."""
+    if isinstance(text, bytes):
+        text = text.decode("utf-8")
+    try:
+        data = json.loads(text)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"Malformed message frame: {e}") from e
+    if not isinstance(data, dict):
+        raise ValueError(f"Message frame must be a JSON object, got {type(data).__name__}")
+    tag = data.get("message_type")
+    cls = _TYPE_REGISTRY.get(tag) if isinstance(tag, str) else None
+    if cls is None:
+        raise ValueError(f"Unknown message_type: {tag!r}")
+    payload = data.get("payload") or {}
+    if not isinstance(payload, dict):
+        raise ValueError(f"Message payload must be a JSON object, got {type(payload).__name__}")
+    try:
+        return cls.from_payload(payload)
+    except (KeyError, TypeError, ValueError) as e:
+        raise ValueError(f"Invalid payload for {tag!r}: {e}") from e

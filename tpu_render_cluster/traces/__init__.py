@@ -1,0 +1,21 @@
+from tpu_render_cluster.traces.master_trace import MasterTrace
+from tpu_render_cluster.traces.performance import WorkerPerformance
+from tpu_render_cluster.traces.worker_trace import (
+    FrameRenderTime,
+    WorkerFrameTrace,
+    WorkerPingTrace,
+    WorkerReconnectionTrace,
+    WorkerTrace,
+    WorkerTraceBuilder,
+)
+
+__all__ = [
+    "MasterTrace",
+    "WorkerPerformance",
+    "FrameRenderTime",
+    "WorkerFrameTrace",
+    "WorkerPingTrace",
+    "WorkerReconnectionTrace",
+    "WorkerTrace",
+    "WorkerTraceBuilder",
+]

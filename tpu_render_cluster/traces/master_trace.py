@@ -1,0 +1,28 @@
+"""Master-side trace (reference: shared/src/results/master_trace.rs:7-24)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+
+@dataclass(frozen=True)
+class MasterTrace:
+    job_start_time: float
+    job_finish_time: float
+
+    def job_duration(self) -> float:
+        return self.job_finish_time - self.job_start_time
+
+    def to_dict(self) -> dict[str, float]:
+        return {
+            "job_start_time": self.job_start_time,
+            "job_finish_time": self.job_finish_time,
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "MasterTrace":
+        return cls(
+            job_start_time=float(data["job_start_time"]),
+            job_finish_time=float(data["job_finish_time"]),
+        )

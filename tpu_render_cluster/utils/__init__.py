@@ -1,0 +1,11 @@
+from tpu_render_cluster.utils.cancellation import CancellationToken
+from tpu_render_cluster.utils.paths import (
+    parse_with_base_directory_prefix,
+    parse_with_tilde_support,
+)
+
+__all__ = [
+    "CancellationToken",
+    "parse_with_base_directory_prefix",
+    "parse_with_tilde_support",
+]

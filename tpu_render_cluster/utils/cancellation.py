@@ -1,0 +1,62 @@
+"""Cooperative cancellation token.
+
+Semantics follow the reference's clonable atomic-bool token that every task
+loop polls (reference: shared/src/cancellation.rs:5-24). This implementation
+additionally exposes an asyncio-friendly wait so loops can block on
+"cancelled OR timeout" instead of busy-polling.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+
+
+class CancellationToken:
+    """Thread-safe, clonable-by-reference cancellation flag.
+
+    Async waiters register an ``asyncio.Event`` waker that ``cancel()`` sets
+    via ``loop.call_soon_threadsafe`` — no polling, and cancellation is
+    observed immediately from any thread.
+    """
+
+    __slots__ = ("_event", "_lock", "_wakers")
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._wakers: list[tuple[asyncio.AbstractEventLoop, asyncio.Event]] = []
+
+    def cancel(self) -> None:
+        with self._lock:
+            self._event.set()
+            wakers, self._wakers = self._wakers, []
+        for loop, event in wakers:
+            try:
+                loop.call_soon_threadsafe(event.set)
+            except RuntimeError:
+                pass  # loop already closed
+
+    def is_cancelled(self) -> bool:
+        return self._event.is_set()
+
+    async def wait_cancelled(self, timeout: float | None = None) -> bool:
+        """Asynchronously wait until cancelled (or timeout); returns is_cancelled."""
+        if self._event.is_set():
+            return True
+        loop = asyncio.get_running_loop()
+        waker = asyncio.Event()
+        entry = (loop, waker)
+        with self._lock:
+            if self._event.is_set():
+                return True
+            self._wakers.append(entry)
+        try:
+            await asyncio.wait_for(waker.wait(), timeout)
+        except asyncio.TimeoutError:
+            pass
+        finally:
+            with self._lock:
+                if entry in self._wakers:
+                    self._wakers.remove(entry)
+        return self._event.is_set()

@@ -1,0 +1,26 @@
+"""Timestamp helpers.
+
+All timestamps in the protocol and trace schema are fractional unix seconds
+(f64), matching the reference's ``TimestampSecondsWithFrac<f64>`` serde and
+the analysis suite's ``datetime.fromtimestamp(float)`` parsing
+(reference: shared/src/results/worker_trace.rs:12-34,
+analysis/core/models.py:62-68).
+"""
+
+from __future__ import annotations
+
+import time
+from datetime import datetime, timezone
+
+
+def now_ts() -> float:
+    """Current time as fractional unix seconds."""
+    return time.time()
+
+
+def ts_to_datetime(ts: float) -> datetime:
+    return datetime.fromtimestamp(ts, tz=timezone.utc)
+
+
+def datetime_to_ts(dt: datetime) -> float:
+    return dt.timestamp()
