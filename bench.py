@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Headline benchmark: path-traced frames/sec/chip on the 04_very-simple scene.
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "frames/s/chip", "vs_baseline": R}
+
+``vs_baseline`` compares against the single-host CPU render of the same
+workload (the stand-in for the reference's 1-worker eager-naive-coarse CPU
+Blender baseline — BASELINE.md north star is >=8x). The CPU number is
+measured in a subprocess with JAX_PLATFORMS=cpu unless BENCH_CPU_FPS is set
+(the driver can pin it to keep runs short).
+
+Workload: 256x256, 4 spp, 4 bounces — matching the 04_very-simple class of
+trivially-lit scenes rendered at JPEG-preview quality in the reference runs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+WIDTH = 256
+HEIGHT = 256
+SAMPLES = 4
+BOUNCES = 4
+BATCH = 8  # frames rendered per device dispatch (vmapped)
+TIMED_BATCHES = 4
+
+
+def measure_fps() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_render_cluster.render.camera import scene_camera
+    from tpu_render_cluster.render.integrator import render_tile
+    from tpu_render_cluster.render.scene import build_scene
+
+    def render_one(frame):
+        scene = build_scene("04_very-simple", frame)
+        camera = scene_camera("04_very-simple", frame)
+        return render_tile(
+            scene,
+            camera,
+            frame,
+            0,
+            0,
+            width=WIDTH,
+            height=HEIGHT,
+            tile_height=HEIGHT,
+            tile_width=WIDTH,
+            samples=SAMPLES,
+            max_bounces=BOUNCES,
+        )
+
+    render_batch = jax.jit(jax.vmap(render_one))
+
+    frames = jnp.arange(1, BATCH + 1, dtype=jnp.float32)
+    render_batch(frames).block_until_ready()  # compile + warm caches
+
+    t0 = time.perf_counter()
+    for i in range(TIMED_BATCHES):
+        offset = (i + 1) * BATCH
+        out = render_batch(frames + offset)
+    out.block_until_ready()
+    elapsed = time.perf_counter() - t0
+    return (BATCH * TIMED_BATCHES) / elapsed
+
+
+def cpu_baseline_fps() -> float:
+    pinned = os.environ.get("BENCH_CPU_FPS")
+    if pinned:
+        return float(pinned)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # Keep the axon TPU plugin's sitecustomize out of the CPU probe: its
+    # relay handshake can hang a process that never needs the TPU.
+    env["PYTHONPATH"] = ""
+    env.pop("BENCH_CPU_FPS", None)
+    result = subprocess.run(
+        [sys.executable, __file__, "--cpu-probe"],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=1200,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    for line in result.stdout.splitlines():
+        if line.startswith("CPU_FPS="):
+            return float(line.split("=", 1)[1])
+    raise RuntimeError(
+        f"CPU probe failed (rc={result.returncode}): {result.stderr[-400:]}"
+    )
+
+
+def main() -> int:
+    if "--cpu-probe" in sys.argv:
+        # Smaller sample for the slow CPU path; fps scales linearly in
+        # batches, so one timed batch suffices.
+        global TIMED_BATCHES
+        TIMED_BATCHES = 1
+        print(f"CPU_FPS={measure_fps()}")
+        return 0
+
+    import jax
+
+    fps = measure_fps()
+    platform = jax.devices()[0].platform
+    try:
+        baseline = cpu_baseline_fps()
+        vs_baseline = fps / baseline if baseline > 0 else 0.0
+    except Exception as e:  # noqa: BLE001 - bench must still report
+        print(f"warning: CPU baseline failed: {e}", file=sys.stderr)
+        vs_baseline = 0.0
+    print(
+        json.dumps(
+            {
+                "metric": f"04_very-simple frames/sec/chip ({WIDTH}x{HEIGHT}, {SAMPLES}spp, {platform})",
+                "value": round(fps, 3),
+                "unit": "frames/s/chip",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

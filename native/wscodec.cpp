@@ -1,0 +1,229 @@
+// WebSocket wire codec: framing, masking, and upgrade-key computation.
+//
+// Native counterpart of the transport hot path (the reference's native layer
+// is its Rust tokio-tungstenite stack; here the control plane is Python
+// asyncio with this C++ codec underneath for the byte-level work). Exposed
+// as a C ABI consumed via ctypes (tpu_render_cluster/native/__init__.py) —
+// pybind11 is not available in this environment.
+//
+// Functions:
+//   trc_accept_key     - Sec-WebSocket-Accept from Sec-WebSocket-Key
+//                        (RFC 6455 §4.2.2: SHA1(key + GUID) base64-encoded)
+//   trc_mask_payload   - in-place XOR masking (the per-byte hot loop)
+//   trc_encode_frame   - complete frame: header + optional mask + payload
+//   trc_parse_header   - progressive header parse for the receive path
+
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// SHA-1 (needed only for the 60-byte handshake input; simple and standalone)
+
+namespace {
+
+struct Sha1State {
+    uint32_t h[5];
+    uint64_t total_bits;
+};
+
+inline uint32_t rotl(uint32_t value, int bits) {
+    return (value << bits) | (value >> (32 - bits));
+}
+
+void sha1_block(Sha1State& state, const uint8_t* block) {
+    uint32_t w[80];
+    for (int i = 0; i < 16; i++) {
+        w[i] = (uint32_t(block[i * 4]) << 24) | (uint32_t(block[i * 4 + 1]) << 16) |
+               (uint32_t(block[i * 4 + 2]) << 8) | uint32_t(block[i * 4 + 3]);
+    }
+    for (int i = 16; i < 80; i++) {
+        w[i] = rotl(w[i - 3] ^ w[i - 8] ^ w[i - 14] ^ w[i - 16], 1);
+    }
+    uint32_t a = state.h[0], b = state.h[1], c = state.h[2], d = state.h[3],
+             e = state.h[4];
+    for (int i = 0; i < 80; i++) {
+        uint32_t f, k;
+        if (i < 20) {
+            f = (b & c) | ((~b) & d);
+            k = 0x5A827999;
+        } else if (i < 40) {
+            f = b ^ c ^ d;
+            k = 0x6ED9EBA1;
+        } else if (i < 60) {
+            f = (b & c) | (b & d) | (c & d);
+            k = 0x8F1BBCDC;
+        } else {
+            f = b ^ c ^ d;
+            k = 0xCA62C1D6;
+        }
+        uint32_t temp = rotl(a, 5) + f + e + k + w[i];
+        e = d;
+        d = c;
+        c = rotl(b, 30);
+        b = a;
+        a = temp;
+    }
+    state.h[0] += a;
+    state.h[1] += b;
+    state.h[2] += c;
+    state.h[3] += d;
+    state.h[4] += e;
+}
+
+void sha1(const uint8_t* data, size_t len, uint8_t out[20]) {
+    Sha1State state = {{0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476, 0xC3D2E1F0},
+                       0};
+    state.total_bits = uint64_t(len) * 8;
+    size_t offset = 0;
+    while (len - offset >= 64) {
+        sha1_block(state, data + offset);
+        offset += 64;
+    }
+    uint8_t tail[128];
+    size_t remaining = len - offset;
+    memcpy(tail, data + offset, remaining);
+    tail[remaining] = 0x80;
+    size_t padded = (remaining + 1 + 8 <= 64) ? 64 : 128;
+    memset(tail + remaining + 1, 0, padded - remaining - 1 - 8);
+    for (int i = 0; i < 8; i++) {
+        tail[padded - 1 - i] = uint8_t(state.total_bits >> (8 * i));
+    }
+    sha1_block(state, tail);
+    if (padded == 128) sha1_block(state, tail + 64);
+    for (int i = 0; i < 5; i++) {
+        out[i * 4] = uint8_t(state.h[i] >> 24);
+        out[i * 4 + 1] = uint8_t(state.h[i] >> 16);
+        out[i * 4 + 2] = uint8_t(state.h[i] >> 8);
+        out[i * 4 + 3] = uint8_t(state.h[i]);
+    }
+}
+
+const char kBase64Table[] =
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+
+size_t base64_encode(const uint8_t* data, size_t len, char* out) {
+    size_t written = 0;
+    size_t i = 0;
+    for (; i + 2 < len; i += 3) {
+        uint32_t chunk = (uint32_t(data[i]) << 16) | (uint32_t(data[i + 1]) << 8) |
+                         uint32_t(data[i + 2]);
+        out[written++] = kBase64Table[(chunk >> 18) & 63];
+        out[written++] = kBase64Table[(chunk >> 12) & 63];
+        out[written++] = kBase64Table[(chunk >> 6) & 63];
+        out[written++] = kBase64Table[chunk & 63];
+    }
+    if (i < len) {
+        uint32_t chunk = uint32_t(data[i]) << 16;
+        bool two = (i + 1 < len);
+        if (two) chunk |= uint32_t(data[i + 1]) << 8;
+        out[written++] = kBase64Table[(chunk >> 18) & 63];
+        out[written++] = kBase64Table[(chunk >> 12) & 63];
+        out[written++] = two ? kBase64Table[(chunk >> 6) & 63] : '=';
+        out[written++] = '=';
+    }
+    out[written] = '\0';
+    return written;
+}
+
+const char kWsGuid[] = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11";
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// Public C ABI
+
+// out must hold >= 29 bytes ("...=" + NUL). Returns length written, 0 on error.
+size_t trc_accept_key(const char* key, char* out, size_t out_capacity) {
+    if (key == nullptr || out == nullptr || out_capacity < 29) return 0;
+    char buffer[128];
+    size_t key_len = strlen(key);
+    if (key_len + sizeof(kWsGuid) > sizeof(buffer)) return 0;
+    memcpy(buffer, key, key_len);
+    memcpy(buffer + key_len, kWsGuid, sizeof(kWsGuid) - 1);
+    uint8_t digest[20];
+    sha1(reinterpret_cast<const uint8_t*>(buffer), key_len + sizeof(kWsGuid) - 1,
+         digest);
+    return base64_encode(digest, 20, out);
+}
+
+// In-place XOR with the 4-byte mask (word-at-a-time body, byte head/tail).
+void trc_mask_payload(uint8_t* data, size_t len, const uint8_t mask[4]) {
+    size_t i = 0;
+    if (len >= 16) {
+        uint64_t wide_mask;
+        uint8_t repeated[8] = {mask[0], mask[1], mask[2], mask[3],
+                               mask[0], mask[1], mask[2], mask[3]};
+        memcpy(&wide_mask, repeated, 8);
+        for (; i + 8 <= len; i += 8) {
+            uint64_t word;
+            memcpy(&word, data + i, 8);
+            word ^= wide_mask;
+            memcpy(data + i, &word, 8);
+        }
+    }
+    for (; i < len; i++) {
+        data[i] ^= mask[i & 3];
+    }
+}
+
+// Writes header (and mask key) into out (capacity >= 14). Returns header
+// size. The caller appends the (pre-masked) payload.
+size_t trc_encode_header(uint8_t opcode, int fin, int masked, uint64_t payload_len,
+                         const uint8_t mask[4], uint8_t* out, size_t out_capacity) {
+    if (out == nullptr || out_capacity < 14) return 0;
+    size_t written = 0;
+    out[written++] = uint8_t((fin ? 0x80 : 0x00) | (opcode & 0x0F));
+    uint8_t mask_bit = masked ? 0x80 : 0x00;
+    if (payload_len < 126) {
+        out[written++] = uint8_t(mask_bit | payload_len);
+    } else if (payload_len < (1ull << 16)) {
+        out[written++] = uint8_t(mask_bit | 126);
+        out[written++] = uint8_t(payload_len >> 8);
+        out[written++] = uint8_t(payload_len);
+    } else {
+        out[written++] = uint8_t(mask_bit | 127);
+        for (int i = 7; i >= 0; i--) {
+            out[written++] = uint8_t(payload_len >> (8 * i));
+        }
+    }
+    if (masked) {
+        memcpy(out + written, mask, 4);
+        written += 4;
+    }
+    return written;
+}
+
+// Parses a frame header from buf. Returns header length (>0) on success,
+// 0 if more bytes are needed, -1 on protocol error. Outputs via pointers.
+int trc_parse_header(const uint8_t* buf, size_t len, uint8_t* opcode, int* fin,
+                     int* masked, uint64_t* payload_len, uint8_t mask_out[4]) {
+    if (len < 2) return 0;
+    *fin = (buf[0] & 0x80) != 0;
+    *opcode = buf[0] & 0x0F;
+    *masked = (buf[1] & 0x80) != 0;
+    uint64_t length = buf[1] & 0x7F;
+    size_t offset = 2;
+    if (length == 126) {
+        if (len < offset + 2) return 0;
+        length = (uint64_t(buf[2]) << 8) | buf[3];
+        offset += 2;
+    } else if (length == 127) {
+        if (len < offset + 8) return 0;
+        length = 0;
+        for (int i = 0; i < 8; i++) length = (length << 8) | buf[offset + i];
+        if (length >> 63) return -1;
+        offset += 8;
+    }
+    if (*masked) {
+        if (len < offset + 4) return 0;
+        memcpy(mask_out, buf + offset, 4);
+        offset += 4;
+    }
+    *payload_len = length;
+    return int(offset);
+}
+
+}  // extern "C"

@@ -1,0 +1,91 @@
+"""Render-and-timing script executed inside Blender's Python.
+
+Contract-compatible re-implementation of the reference's script
+(reference: scripts/render-timing-script.py:23-102): parses
+``--render-output/--render-format/--render-frame`` after the ``--``
+separator, expands ``#####`` frame placeholders, sets scene
+frame/filepath/format (quality 90), times load -> render-start ->
+render-end, renders a single still, and prints ``RESULTS={json}`` for the
+worker's stdout parser
+(tpu_render_cluster/worker/backends/blender.py).
+"""
+
+import contextlib
+import json
+import os
+import sys
+import time
+
+# Import time doubles as the "project loaded" timestamp: Blender loads the
+# .blend before running --python scripts.
+time_init: float = time.time()
+
+try:
+    import bpy
+except ImportError:  # running outside Blender (tests import-check only)
+    bpy = None
+
+
+def parse_cli_arguments():
+    try:
+        separator_index = sys.argv.index("--")
+    except ValueError:
+        raise ValueError("Missing '--' separator for script arguments.")
+    arguments = sys.argv[separator_index + 1 :]
+
+    def value_of(flag: str) -> str:
+        index = arguments.index(flag)
+        return arguments[index + 1]
+
+    return {
+        "output_path": value_of("--render-output"),
+        "output_format": value_of("--render-format"),
+        "frame_number": int(value_of("--render-frame")),
+    }
+
+
+def format_hash_frame_placeholders(raw_file_path: str, frame_number: int) -> str:
+    format_length = raw_file_path.count("#")
+    if format_length == 0:
+        return raw_file_path
+    return raw_file_path.replace(
+        "#" * format_length, str(frame_number).rjust(format_length, "0")
+    )
+
+
+def main() -> None:
+    arguments = parse_cli_arguments()
+    frame_number = arguments["frame_number"]
+
+    bpy.context.scene.frame_set(frame_number)
+    bpy.context.scene.render.filepath = format_hash_frame_placeholders(
+        arguments["output_path"], frame_number
+    )
+    bpy.context.scene.render.image_settings.file_format = arguments["output_format"]
+    bpy.context.scene.render.image_settings.quality = 90
+
+    time_render_start = time.time()
+    with open(os.devnull, "w") as null:
+        with contextlib.redirect_stdout(null):
+            bpy.ops.render.render(animation=False, write_still=True, use_viewport=False)
+    time_render_end = time.time()
+
+    print(
+        "RESULTS="
+        + json.dumps(
+            {
+                "project_loaded_at": time_init,
+                "project_started_rendering_at": time_render_start,
+                "project_finished_rendering_at": time_render_end,
+            }
+        )
+    )
+    bpy.ops.wm.quit_blender()
+
+
+if bpy is not None:
+    try:
+        main()
+    except ValueError as error:
+        print(f"Missing render-and-timing-script arguments! ({error})")
+        bpy.ops.wm.quit_blender()

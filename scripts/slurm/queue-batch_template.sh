@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# SLURM batch template: one master + N workers per job allocation.
+#
+# Shaped after the reference's harness (reference:
+# scripts/arnes/queue-batch_04vs_14400f-40w_dynamic.sh): N+1 tasks, master
+# on the first node via srun, staggered worker starts, per-task log files,
+# singleton dependency so repeated same-named submissions serialize into a
+# sample population for the analysis suite.
+#
+# Customize the SBATCH lines + JOB_FILE/N_WORKERS below, then `sbatch` this.
+#SBATCH --job-name=trc-render
+#SBATCH --ntasks=41
+#SBATCH --cpus-per-task=4
+#SBATCH --mem-per-cpu=2G
+#SBATCH --time=160
+#SBATCH --dependency=singleton
+#SBATCH --output=logs/%x-%j.out
+
+set -euo pipefail
+
+JOB_FILE="${JOB_FILE:-blender-projects/04_very-simple/04_very-simple_measuring_14400f-40w_dynamic.toml}"
+N_WORKERS="${N_WORKERS:-40}"
+BACKEND="${BACKEND:-tpu-raytrace}"
+RESULTS_DIR="${RESULTS_DIR:-results/$SLURM_JOB_NAME}"
+BASE_DIR="${BASE_DIR:-$PWD}"
+PORT="${PORT:-9901}"
+export TRC_LOG="${TRC_LOG:-debug}"
+
+MASTER_HOST="$(scontrol show hostnames "$SLURM_JOB_NODELIST" | head -n1)"
+mkdir -p "$RESULTS_DIR" logs
+
+srun --ntasks=1 --nodes=1 --nodelist="$MASTER_HOST" \
+  python -m tpu_render_cluster.master.main \
+    --host 0.0.0.0 --port "$PORT" \
+    --logFilePath "logs/master-$SLURM_JOB_ID.log" \
+    run-job "$JOB_FILE" --resultsDirectory "$RESULTS_DIR" &
+MASTER_PID=$!
+sleep 5
+
+for i in $(seq 1 "$N_WORKERS"); do
+  srun --ntasks=1 --exact \
+    python -m tpu_render_cluster.worker.main \
+      --masterServerHost "$MASTER_HOST" --masterServerPort "$PORT" \
+      --baseDirectory "$BASE_DIR" --backend "$BACKEND" \
+      --logFilePath "logs/worker-$SLURM_JOB_ID-$i.log" &
+  sleep 1   # staggered starts (reference behavior)
+done
+
+wait "$MASTER_PID"

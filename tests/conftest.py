@@ -9,12 +9,34 @@ Must run before the first ``import jax`` anywhere in the test session.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The environment's sitecustomize registers the axon TPU PJRT plugin in every
+# interpreter; its backend init contacts a local relay and can hang the whole
+# test session if the relay is wedged. Tests are CPU-only by design — drop
+# the factory before any backend is initialized.
+try:
+    import jax
+
+    # sitecustomize may have imported jax before this file ran, locking the
+    # config to the env's JAX_PLATFORMS=axon — override it explicitly.
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # noqa: BLE001 - jax absent: nothing to do
+    pass
+try:
+    import jax._src.xla_bridge as _xla_bridge  # private API; best-effort
+
+    for _registry_name in ("_backend_factories", "backend_factories"):
+        _registry = getattr(_xla_bridge, _registry_name, None)
+        if isinstance(_registry, dict):
+            _registry.pop("axon", None)
+except Exception:  # noqa: BLE001 - registry moved: config override suffices
+    pass
 
 import sys
 from pathlib import Path

@@ -1,0 +1,181 @@
+"""Analysis suite tests over synthetic multi-run traces."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from tpu_render_cluster.analysis import metrics as M
+from tpu_render_cluster.analysis.models import JobTrace
+from tpu_render_cluster.analysis.parser import find_trace_files, load_traces
+from tpu_render_cluster.jobs.models import BlenderJob, DistributionStrategy
+from tpu_render_cluster.traces.worker_trace import (
+    FrameRenderTime,
+    WorkerFrameTrace,
+    WorkerPingTrace,
+    WorkerTrace,
+)
+
+
+def synth_trace(
+    tmp_path: Path,
+    *,
+    run_id: int,
+    workers: int,
+    strategy: DistributionStrategy,
+    frame_seconds: float = 2.0,
+    frames_per_worker: int = 5,
+    duration: float | None = None,
+) -> Path:
+    job = BlenderJob(
+        job_name="synth",
+        job_description="synthetic",
+        project_file_path="p.blend",
+        render_script_path="s.py",
+        frame_range_from=1,
+        frame_range_to=workers * frames_per_worker,
+        wait_for_number_of_workers=workers,
+        frame_distribution_strategy=strategy,
+        output_directory_path="out",
+        output_file_name_format="f-####",
+        output_file_format="PNG",
+    )
+    base = 1000.0
+    total = duration or (frames_per_worker * frame_seconds + 1.0)
+    worker_traces = {}
+    frame = 1
+    for w in range(workers):
+        traces = []
+        t = base + 0.5
+        for _ in range(frames_per_worker):
+            traces.append(
+                WorkerFrameTrace(
+                    frame,
+                    FrameRenderTime(
+                        started_process_at=t,
+                        finished_loading_at=t + 0.2 * frame_seconds,
+                        started_rendering_at=t + 0.2 * frame_seconds,
+                        finished_rendering_at=t + 0.9 * frame_seconds,
+                        file_saving_started_at=t + 0.9 * frame_seconds,
+                        file_saving_finished_at=t + frame_seconds,
+                        exited_process_at=t + frame_seconds,
+                    ),
+                )
+            )
+            frame += 1
+            t += frame_seconds
+        worker_traces[f"{w:08x}-127.0.0.1:1"] = WorkerTrace(
+            total_queued_frames=frames_per_worker,
+            total_queued_frames_removed_from_queue=0,
+            job_start_time=base,
+            job_finish_time=base + total,
+            frame_render_traces=traces,
+            ping_traces=[WorkerPingTrace(base + 1.0, base + 1.0015)],
+            reconnection_traces=[],
+        ).to_dict()
+    payload = {
+        "job": job.to_dict(),
+        "master_trace": {"job_start_time": base, "job_finish_time": base + total},
+        "worker_traces": worker_traces,
+    }
+    path = tmp_path / f"2026-01-0{run_id}_12-00-00_job-synth_raw-trace.json"
+    path.write_text(json.dumps(payload))
+    return path
+
+
+@pytest.fixture
+def results_dir(tmp_path):
+    eager = DistributionStrategy.eager_naive_coarse(5)
+    dynamic = DistributionStrategy.dynamic_strategy.__func__  # appease linters
+    # Two 1-worker sequential baseline runs (10s each), two 5-worker runs (2s + 3s).
+    synth_trace(tmp_path, run_id=1, workers=1, strategy=eager, frame_seconds=2.0,
+                frames_per_worker=5, duration=10.0)
+    synth_trace(tmp_path, run_id=2, workers=1, strategy=eager, frame_seconds=2.0,
+                frames_per_worker=5, duration=10.0)
+    synth_trace(tmp_path, run_id=3, workers=5, strategy=eager, frame_seconds=2.0,
+                frames_per_worker=1, duration=2.5)
+    synth_trace(tmp_path, run_id=4, workers=5, strategy=eager, frame_seconds=2.0,
+                frames_per_worker=1, duration=2.5)
+    return tmp_path
+
+
+def test_parser_and_loader(results_dir):
+    assert len(find_trace_files(results_dir)) == 4
+    traces = load_traces(results_dir, cache_directory=results_dir / ".cache")
+    assert len(traces) == 4
+    # Cached second load gives the same result.
+    cached = load_traces(results_dir, cache_directory=results_dir / ".cache")
+    assert len(cached) == 4
+
+
+def test_utilization(results_dir):
+    traces = load_traces(results_dir)
+    stats = M.utilization_stats(traces)
+    one_worker = stats[(1, "eager-naive-coarse")]
+    # 5 frames x 2 s active in a 10 s window = 1.0 utilization.
+    assert one_worker["mean"] == pytest.approx(1.0, abs=0.01)
+
+
+def test_speedup_and_efficiency(results_dir):
+    traces = load_traces(results_dir)
+    stats = M.speedup_stats(traces)
+    five = stats[(5, "eager-naive-coarse")]
+    # baseline mean 10 s / parallel mean 2.5 s = 4x; efficiency 0.8.
+    assert five["speedup"] == pytest.approx(4.0, rel=0.01)
+    assert five["efficiency"] == pytest.approx(0.8, rel=0.01)
+
+
+def test_tail_delay_and_phase_split(results_dir):
+    traces = load_traces(results_dir)
+    tail = M.tail_delay_stats(traces)
+    assert tail[(1, "eager-naive-coarse")]["mean_tail_seconds"] == pytest.approx(0.0)
+    phases = M.phase_split_stats(traces)
+    assert phases[1]["reading"] == pytest.approx(0.2, abs=0.01)
+    assert phases[1]["rendering"] == pytest.approx(0.7, abs=0.01)
+    assert phases[1]["writing"] == pytest.approx(0.1, abs=0.01)
+
+
+def test_latency_stats(results_dir):
+    traces = load_traces(results_dir)
+    stats = M.latency_stats(traces)
+    assert stats[1]["mean_ms"] == pytest.approx(1.5, abs=0.01)
+    assert stats[1]["over_25ms"] == 0
+
+
+def test_run_statistics(results_dir):
+    traces = load_traces(results_dir)
+    stats = M.run_statistics(traces)
+    assert stats[(1, "eager-naive-coarse")]["runs"] == 2
+    assert stats[(5, "eager-naive-coarse")]["runs"] == 2
+
+
+def test_run_all_cli(results_dir, tmp_path):
+    from tpu_render_cluster.analysis.run_all import main
+
+    out = tmp_path / "analysis-out"
+    assert main(["--results", str(results_dir), "--out", str(out)]) == 0
+    stats = json.loads((out / "statistics.json").read_text())
+    assert set(stats.keys()) == {
+        "utilization",
+        "speedup",
+        "job_duration",
+        "tail_delay",
+        "latency",
+        "phase_split",
+        "run_statistics",
+    }
+    # Plots were produced.
+    assert (out / "worker_utilization.png").exists()
+    assert (out / "speedup_efficiency.png").exists()
+
+
+def test_worker_count_mismatch_rejected(tmp_path):
+    path = synth_trace(
+        tmp_path, run_id=1, workers=1,
+        strategy=DistributionStrategy.eager_naive_coarse(5),
+    )
+    data = json.loads(path.read_text())
+    data["job"]["wait_for_number_of_workers"] = 3
+    path.write_text(json.dumps(data))
+    with pytest.raises(ValueError):
+        JobTrace.load_from_trace_file(path)

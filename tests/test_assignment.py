@@ -1,0 +1,80 @@
+"""Auction assignment solver tests (the tpu-batch scheduler core)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from tpu_render_cluster.ops.assignment import solve_assignment
+
+
+def brute_force_cost(cost):
+    n, m = cost.shape
+    return min(
+        sum(cost[i, p[i]] for i in range(n))
+        for p in itertools.permutations(range(m), n)
+    )
+
+
+def test_empty():
+    assert solve_assignment(np.zeros((0, 4), np.float32)).shape == (0,)
+
+
+def test_more_items_than_slots_rejected():
+    with pytest.raises(ValueError):
+        solve_assignment(np.zeros((5, 3), np.float32))
+
+
+def test_identity_preference():
+    # Strong diagonal preference must be honored exactly.
+    cost = np.full((4, 4), 10.0, np.float32)
+    np.fill_diagonal(cost, 0.0)
+    assignment = solve_assignment(cost)
+    np.testing.assert_array_equal(assignment, [0, 1, 2, 3])
+
+
+def test_optimal_on_random_instances():
+    rng = np.random.default_rng(42)
+    for _ in range(10):
+        n = int(rng.integers(2, 7))
+        m = int(rng.integers(n, 8))
+        cost = rng.uniform(0.0, 10.0, (n, m)).astype(np.float32)
+        assignment = solve_assignment(cost)
+        assert len(set(assignment.tolist())) == n  # valid (injective)
+        achieved = float(cost[np.arange(n), assignment].sum())
+        assert achieved <= brute_force_cost(cost) + 1e-2
+
+
+def test_rectangular_wide():
+    # 2 frames, 6 slots: must pick the two cheapest compatible slots.
+    cost = np.array(
+        [[5, 1, 9, 9, 9, 9], [5, 9, 9, 2, 9, 9]], dtype=np.float32
+    )
+    assignment = solve_assignment(cost)
+    assert assignment[0] == 1 and assignment[1] == 3
+
+
+def test_cost_model_build():
+    from tpu_render_cluster.master.tpu_batch import WorkerCostModel, build_cost_matrix
+
+    model = WorkerCostModel(alpha=0.5)
+    model.observe(1, 2.0)
+    model.observe(1, 4.0)  # EMA: 3.0
+    model.observe(2, 10.0)
+    assert model.predict(1) == pytest.approx(3.0)
+    assert model.predict(2) == pytest.approx(10.0)
+    # Unknown worker gets the median of known EMAs.
+    assert model.predict(99) == pytest.approx(6.5)
+
+    class FakeWorker:
+        def __init__(self, worker_id, queue_length):
+            self.worker_id = worker_id
+            self.queue = [None] * queue_length
+
+    fast = FakeWorker(1, 0)
+    slow = FakeWorker(2, 2)
+    slots = [(fast, 0), (fast, 1), (slow, 0)]
+    cost = build_cost_matrix([10, 11], slots, model)
+    assert cost.shape == (2, 3)
+    # fast slot 0: (0+0+1)*3 = 3; fast slot 1: (0+1+1)*3 = 6; slow: (2+0+1)*10 = 30
+    np.testing.assert_allclose(cost[0], [3.0, 6.0, 30.0])

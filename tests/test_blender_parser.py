@@ -1,0 +1,101 @@
+"""Blender stdout parser tests against canned output (SURVEY.md §4a)."""
+
+import pytest
+
+from tpu_render_cluster.jobs.models import BlenderJob, DistributionStrategy
+from tpu_render_cluster.worker.backends.blender import (
+    BlenderBackend,
+    extract_blender_render_information,
+    parse_blender_human_time,
+)
+
+CANNED_STDOUT = """Blender 3.6.0 (hash 223aaf6e8a3b built 2023-06-27 06:51:32)
+Read blend: /scratch/projects/04_very-simple.blend
+Fra:17 Mem:27.54M (Peak 28.75M) | Time:00:00.25 | Syncing Sun
+Fra:17 Mem:27.54M (Peak 28.75M) | Time:00:00.30 | Rendering 1 / 64 samples
+Fra:17 Mem:27.54M (Peak 28.75M) | Time:00:02.05 | Rendering 64 / 64 samples
+Saved: '/scratch/frames/rendered-000017.jpg'
+ Time: 00:03.55 (Saving: 00:00.36)
+
+RESULTS={"project_loaded_at": 1690000001.25, "project_started_rendering_at": 1690000001.5, "project_finished_rendering_at": 1690000005.0}
+"""
+
+
+def test_parse_human_time():
+    assert parse_blender_human_time("00:00.36") == pytest.approx(0.36)
+    assert parse_blender_human_time("02:30.50") == pytest.approx(150.5)
+
+
+def test_extract_canned_output():
+    stats = extract_blender_render_information(CANNED_STDOUT)
+    assert stats.loaded_at == pytest.approx(1690000001.25)
+    assert stats.started_rendering_at == pytest.approx(1690000001.5)
+    # Saving (0.36 s) is subtracted from the script's render-end.
+    assert stats.finished_rendering_at == pytest.approx(1690000005.0 - 0.36)
+    assert stats.file_saving_started_at == stats.finished_rendering_at
+    assert stats.file_saving_finished_at == pytest.approx(1690000005.0)
+
+    timing = stats.with_process_information(1690000000.0, 1690000006.0)
+    assert timing.started_process_at == pytest.approx(1690000000.0)
+    assert timing.exited_process_at == pytest.approx(1690000006.0)
+
+
+def test_missing_saved_line_rejected():
+    with pytest.raises(ValueError):
+        extract_blender_render_information("no such output")
+
+
+def test_missing_results_rejected():
+    truncated = CANNED_STDOUT.split("RESULTS=")[0]
+    with pytest.raises(ValueError):
+        extract_blender_render_information(truncated)
+
+
+def test_data_before_saved_line_is_ignored():
+    # A Time:/RESULTS= line before "Saved: '" must not be picked up.
+    tricked = (
+        ' Time: 99:99.99 (Saving: 99:99.99)\nRESULTS={"project_loaded_at": 1}\n'
+        + CANNED_STDOUT
+    )
+    stats = extract_blender_render_information(tricked)
+    assert stats.loaded_at == pytest.approx(1690000001.25)
+
+
+def test_command_assembly(tmp_path):
+    job = BlenderJob(
+        job_name="x",
+        job_description=None,
+        project_file_path="%BASE%/p.blend",
+        render_script_path="%BASE%/s.py",
+        frame_range_from=1,
+        frame_range_to=4,
+        wait_for_number_of_workers=1,
+        frame_distribution_strategy=DistributionStrategy.naive_fine(),
+        output_directory_path="%BASE%/out",
+        output_file_name_format="rendered-#####",
+        output_file_format="PNG",
+    )
+    backend = BlenderBackend(
+        blender_binary="blender",
+        base_directory=tmp_path,
+        prepend_arguments="--factory-startup",
+        append_arguments="--cycles-device CPU",
+    )
+    command = backend.build_command(job, 3)
+    assert command == [
+        "blender",
+        "--factory-startup",
+        str(tmp_path / "p.blend"),
+        "--background",
+        "--python",
+        str(tmp_path / "s.py"),
+        "--",
+        "--render-output",
+        str(tmp_path / "out" / "rendered-#####"),
+        "--render-format",
+        "PNG",
+        "--render-frame",
+        "3",
+        "--cycles-device",
+        "CPU",
+    ]

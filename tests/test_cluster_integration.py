@@ -1,0 +1,180 @@
+"""End-to-end in-process cluster tests: master + real workers over real
+WebSockets on localhost, with the sleep-based mock renderer.
+
+This is the "minimum end-to-end slice" from SURVEY.md §7 step 2, extended to
+all four strategies: barrier -> job-started -> distribution -> finished
+events -> trace collection -> raw-trace JSON that the REFERENCE analysis
+suite parses without error.
+"""
+
+import asyncio
+import json
+import sys
+from datetime import datetime
+from pathlib import Path
+
+import pytest
+
+from tpu_render_cluster.jobs.models import (
+    BlenderJob,
+    DistributionStrategy,
+    DynamicStrategyOptions,
+    TpuBatchStrategyOptions,
+)
+from tpu_render_cluster.master.cluster import ClusterManager
+from tpu_render_cluster.master.persist import (
+    parse_worker_traces,
+    save_processed_results,
+    save_raw_traces,
+)
+from tpu_render_cluster.worker.backends.mock import MockBackend
+from tpu_render_cluster.worker.runtime import Worker
+
+REFERENCE_ANALYSIS = Path("/root/reference/analysis")
+
+
+def make_job(strategy: DistributionStrategy, frames: int, workers: int) -> BlenderJob:
+    return BlenderJob(
+        job_name="integration-test",
+        job_description="in-process cluster test",
+        project_file_path="%BASE%/p.blend",
+        render_script_path="%BASE%/s.py",
+        frame_range_from=1,
+        frame_range_to=frames,
+        wait_for_number_of_workers=workers,
+        frame_distribution_strategy=strategy,
+        output_directory_path="%BASE%/out",
+        output_file_name_format="rendered-#####",
+        output_file_format="PNG",
+    )
+
+
+async def run_cluster(job: BlenderJob, backends: list[MockBackend]):
+    manager = ClusterManager("127.0.0.1", 0, job)
+    server_task = asyncio.create_task(manager.initialize_server_and_run_job())
+    # Wait until the server picked its port.
+    while manager._server is None:
+        await asyncio.sleep(0.01)
+    port = manager.port
+
+    workers = [Worker("127.0.0.1", port, backend) for backend in backends]
+    worker_tasks = [
+        asyncio.create_task(w.connect_and_run_to_job_completion()) for w in workers
+    ]
+    master_trace, worker_traces = await server_task
+    await asyncio.gather(*worker_tasks)
+    return master_trace, worker_traces
+
+
+STRATEGIES = [
+    DistributionStrategy.naive_fine(),
+    DistributionStrategy.eager_naive_coarse(3),
+    DistributionStrategy.dynamic_strategy(DynamicStrategyOptions(3, 1, 1, 2)),
+    DistributionStrategy.tpu_batch_strategy(TpuBatchStrategyOptions(target_queue_size=3)),
+]
+
+
+@pytest.mark.parametrize(
+    "strategy", STRATEGIES, ids=[s.strategy_type for s in STRATEGIES]
+)
+def test_full_job_all_strategies(strategy):
+    frames, n_workers = 12, 3
+    job = make_job(strategy, frames, n_workers)
+    backends = [MockBackend() for _ in range(n_workers)]
+
+    master_trace, worker_traces = asyncio.run(
+        asyncio.wait_for(run_cluster(job, backends), 120)
+    )
+
+    assert len(worker_traces) == n_workers
+    rendered = sorted(
+        frame
+        for backend in backends
+        for frame in backend.rendered_frames
+    )
+    assert rendered == list(range(1, frames + 1))
+    # Every frame traced exactly once across workers.
+    traced = sorted(
+        t.frame_index
+        for _, trace in worker_traces
+        for t in trace.frame_render_traces
+    )
+    assert traced == list(range(1, frames + 1))
+    assert master_trace.job_finish_time > master_trace.job_start_time
+    # Trace keys look like "<8hex>-<ip>:<port>".
+    for name, _ in worker_traces:
+        worker_hex, _, address = name.partition("-")
+        assert len(worker_hex) == 8
+        assert ":" in address
+
+
+def test_render_error_is_rescheduled():
+    # Frame 5 fails once on its first worker; the master must reschedule it
+    # (the reference would hang forever here - SURVEY.md §7 bug list).
+    frames, n_workers = 8, 2
+    job = make_job(DistributionStrategy.naive_fine(), frames, n_workers)
+    backends = [MockBackend(fail_frames={5}), MockBackend(fail_frames={5})]
+
+    _, worker_traces = asyncio.run(asyncio.wait_for(run_cluster(job, backends), 120))
+    traced = sorted(
+        t.frame_index
+        for _, trace in worker_traces
+        for t in trace.frame_render_traces
+    )
+    assert traced == list(range(1, frames + 1))
+
+
+def test_raw_trace_parses_with_reference_analysis(tmp_path):
+    job = make_job(DistributionStrategy.eager_naive_coarse(2), 6, 2)
+    backends = [MockBackend(), MockBackend()]
+    master_trace, worker_traces = asyncio.run(
+        asyncio.wait_for(run_cluster(job, backends), 120)
+    )
+
+    start = datetime.now()
+    raw_path = save_raw_traces(start, job, tmp_path, master_trace, worker_traces)
+    performance = parse_worker_traces(worker_traces)
+    processed_path = save_processed_results(start, job, tmp_path, performance)
+    assert raw_path.name.endswith("_raw-trace.json")
+    assert processed_path.exists()
+
+    # Parse with OUR models.
+    data = json.loads(raw_path.read_text())
+    assert set(data.keys()) == {"job", "master_trace", "worker_traces"}
+
+    # Parse with the REFERENCE analysis suite (the acceptance surface).
+    sys.path.insert(0, str(REFERENCE_ANALYSIS))
+    try:
+        from core.models import JobTrace
+
+        job_trace = JobTrace.load_from_trace_file(raw_path)
+        assert len(job_trace.worker_traces) == 2
+        assert job_trace.get_last_frame_finished_at() is not None
+        for trace in job_trace.worker_traces.values():
+            utilization_window = (
+                trace.worker_job_finish_time - trace.worker_job_start_time
+            ).total_seconds()
+            assert utilization_window > 0
+    finally:
+        sys.path.remove(str(REFERENCE_ANALYSIS))
+
+
+def test_worker_count_mismatch_detected_by_reference_loader(tmp_path):
+    # The reference loader refuses traces whose worker count disagrees with
+    # the job's barrier - make sure our writer preserves that invariant.
+    job = make_job(DistributionStrategy.naive_fine(), 4, 2)
+    backends = [MockBackend(), MockBackend()]
+    master_trace, worker_traces = asyncio.run(
+        asyncio.wait_for(run_cluster(job, backends), 120)
+    )
+    raw_path = save_raw_traces(
+        datetime.now(), job, tmp_path, master_trace, worker_traces[:1]  # drop one
+    )
+    sys.path.insert(0, str(REFERENCE_ANALYSIS))
+    try:
+        from core.models import JobTrace
+
+        with pytest.raises(ValueError):
+            JobTrace.load_from_trace_file(raw_path)
+    finally:
+        sys.path.remove(str(REFERENCE_ANALYSIS))

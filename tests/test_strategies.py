@@ -1,0 +1,66 @@
+"""Unit tests for the pure steal-candidate selection logic.
+
+The reference never unit-tested this (SURVEY.md §4); these encode the
+documented semantics of strategies.rs:155-248.
+"""
+
+from tpu_render_cluster.jobs.models import DynamicStrategyOptions
+from tpu_render_cluster.master.queue_mirror import FrameOnWorker
+from tpu_render_cluster.master.strategies import select_best_frame_to_steal
+
+OPTIONS = DynamicStrategyOptions(
+    target_queue_size=4,
+    min_queue_size_to_steal=2,
+    min_seconds_before_resteal_to_elsewhere=40,
+    min_seconds_before_resteal_to_original_worker=80,
+)
+
+NOW = 10_000.0
+THIEF = 0xAA
+VICTIM = 0xBB
+
+
+def frame(index: int, age: float, stolen_from: int | None = None) -> FrameOnWorker:
+    return FrameOnWorker(index, queued_at=NOW - age, stolen_from=stolen_from)
+
+
+def test_skips_first_min_queue_size_frames():
+    queue = [frame(1, 100), frame(2, 100), frame(3, 100)]
+    best = select_best_frame_to_steal(THIEF, queue, OPTIONS, now=NOW)
+    # First two are protected; only index 3 is eligible.
+    assert best is not None and best.frame_index == 3
+
+
+def test_requires_min_age_before_resteal():
+    queue = [frame(1, 100), frame(2, 100), frame(3, 10), frame(4, 39.9)]
+    assert select_best_frame_to_steal(THIEF, queue, OPTIONS, now=NOW) is None
+    queue.append(frame(5, 40.1))
+    best = select_best_frame_to_steal(THIEF, queue, OPTIONS, now=NOW)
+    assert best is not None and best.frame_index == 5
+
+
+def test_prefers_longest_queued():
+    queue = [frame(1, 100), frame(2, 100), frame(3, 50), frame(4, 90), frame(5, 60)]
+    best = select_best_frame_to_steal(THIEF, queue, OPTIONS, now=NOW)
+    assert best is not None and best.frame_index == 4
+
+
+def test_resteal_to_original_worker_needs_longer_timer():
+    # Frame was stolen FROM the thief; it needs the 80 s timer, not 40 s.
+    queue = [frame(1, 100), frame(2, 100), frame(3, 60, stolen_from=THIEF)]
+    assert select_best_frame_to_steal(THIEF, queue, OPTIONS, now=NOW) is None
+    queue2 = [frame(1, 100), frame(2, 100), frame(3, 81, stolen_from=THIEF)]
+    best = select_best_frame_to_steal(THIEF, queue2, OPTIONS, now=NOW)
+    assert best is not None and best.frame_index == 3
+    # Stolen from a different worker: the 40 s timer applies.
+    queue3 = [frame(1, 100), frame(2, 100), frame(3, 60, stolen_from=VICTIM)]
+    best = select_best_frame_to_steal(THIEF, queue3, OPTIONS, now=NOW)
+    assert best is not None and best.frame_index == 3
+
+
+def test_empty_and_short_queues():
+    assert select_best_frame_to_steal(THIEF, [], OPTIONS, now=NOW) is None
+    assert (
+        select_best_frame_to_steal(THIEF, [frame(1, 100), frame(2, 100)], OPTIONS, now=NOW)
+        is None
+    )

@@ -1,0 +1,81 @@
+"""tpu-raytrace worker backend + graft entry tests (CPU mesh)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from tpu_render_cluster.jobs.models import BlenderJob, DistributionStrategy
+from tpu_render_cluster.worker.backends import create_backend
+
+
+def make_job(tmp_path, scene_job_name="04_very-simple_demo") -> BlenderJob:
+    return BlenderJob(
+        job_name=scene_job_name,
+        job_description=None,
+        project_file_path="%BASE%/p.blend",
+        render_script_path="%BASE%/s.py",
+        frame_range_from=1,
+        frame_range_to=4,
+        wait_for_number_of_workers=1,
+        frame_distribution_strategy=DistributionStrategy.naive_fine(),
+        output_directory_path="%BASE%/frames",
+        output_file_name_format="rendered-#####",
+        output_file_format="PNG",
+    )
+
+
+def test_tpu_raytrace_backend_renders_and_traces(tmp_path):
+    backend = create_backend(
+        "tpu-raytrace",
+        base_directory=tmp_path,
+        width=32,
+        height=32,
+        samples=1,
+        max_bounces=2,
+    )
+    job = make_job(tmp_path)
+    timing = asyncio.run(backend.render_frame(job, 3))
+
+    output = tmp_path / "frames" / "rendered-00003.png"
+    assert output.is_file()
+    from PIL import Image
+
+    image = np.asarray(Image.open(output))
+    assert image.shape == (32, 32, 3)
+    assert image.std() > 5.0
+
+    # 7-phase monotonicity.
+    assert timing.started_process_at <= timing.finished_loading_at
+    assert timing.started_rendering_at <= timing.finished_rendering_at
+    assert timing.file_saving_started_at <= timing.file_saving_finished_at
+    assert timing.exited_process_at >= timing.file_saving_finished_at
+    assert timing.total_execution_time() > 0
+
+
+def test_tpu_raytrace_jpeg_output(tmp_path):
+    backend = create_backend(
+        "tpu-raytrace", base_directory=tmp_path, width=16, height=16, samples=1,
+        max_bounces=2,
+    )
+    job = make_job(tmp_path)
+    job = BlenderJob.from_dict({**job.to_dict(), "output_file_format": "JPEG"})
+    asyncio.run(backend.render_frame(job, 1))
+    assert (tmp_path / "frames" / "rendered-00001.jpg").is_file()
+
+
+def test_graft_entry_single_chip():
+    import jax
+
+    from __graft_entry__ import entry
+
+    fn, example_args = entry()
+    out = jax.jit(fn)(*example_args)
+    out.block_until_ready()
+    assert out.shape == (128, 128, 3)
+
+
+def test_graft_dryrun_multichip():
+    from __graft_entry__ import dryrun_multichip
+
+    dryrun_multichip(8)

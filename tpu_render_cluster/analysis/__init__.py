@@ -1,0 +1,10 @@
+"""Offline analysis suite — the metrics product.
+
+Python re-implementation of the reference's ``analysis/`` package
+(reference: analysis/run_all.py and modules A5-A12 in SURVEY.md §2.5),
+operating on the same raw-trace JSON schema. Every metric definition
+follows the reference exactly (utilization, speedup vs the 1-worker
+eager-naive-coarse sequential mean, efficiency, job duration, absolute and
+frame-time-scaled tail delay, heartbeat RTT latency, read/render/write
+phase split, run statistics).
+"""

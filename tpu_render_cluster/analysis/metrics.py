@@ -1,0 +1,229 @@
+"""Metric computations A5-A12 (reference: analysis/*.py).
+
+Each function takes loaded ``JobTrace`` objects and returns plain dicts so
+tests and the report generator stay decoupled from plotting.
+"""
+
+from __future__ import annotations
+
+import statistics
+from collections import defaultdict
+from dataclasses import dataclass
+
+from tpu_render_cluster.analysis.models import (
+    JobTrace,
+    last_frame_finished_at,
+    mean_frame_time,
+    worker_active_time,
+    worker_tail_delay,
+)
+
+SEQUENTIAL_BASELINE_STRATEGY = "eager-naive-coarse"  # reference: speedup.py:35-40
+
+
+# -- A5: worker utilization --------------------------------------------------
+
+
+@dataclass(frozen=True)
+class WorkerUtilization:
+    """active/total per worker (reference: worker_utilization.py:28-91)."""
+
+    worker_name: str
+    utilization: float
+    utilization_without_tail: float
+
+
+def worker_utilizations(trace: JobTrace) -> list[WorkerUtilization]:
+    out = []
+    for name, worker in trace.worker_traces.items():
+        total = worker.job_finish_time - worker.job_start_time
+        active = worker_active_time(worker)
+        utilization = active / total if total > 0 else 0.0
+        non_tail_window = last_frame_finished_at(worker) - worker.job_start_time
+        without_tail = active / non_tail_window if non_tail_window > 0 else 0.0
+        out.append(WorkerUtilization(name, utilization, min(1.0, without_tail)))
+    return out
+
+
+def utilization_stats(traces: list[JobTrace]) -> dict:
+    """Utilization grouped by (cluster_size, strategy)."""
+    grouped: dict[tuple[int, str], list[float]] = defaultdict(list)
+    for trace in traces:
+        for u in worker_utilizations(trace):
+            grouped[(trace.cluster_size(), trace.strategy_type())].append(
+                u.utilization
+            )
+    return {
+        key: {
+            "max": max(values),
+            "mean": statistics.fmean(values),
+            "median": statistics.median(values),
+            "min": min(values),
+            "count": len(values),
+        }
+        for key, values in grouped.items()
+    }
+
+
+# -- A6/A7: speedup + efficiency --------------------------------------------
+
+
+def sequential_baseline_mean(traces: list[JobTrace]) -> float | None:
+    """Mean duration of 1-worker eager-naive-coarse runs (reference:
+    speedup.py:35-40)."""
+    durations = [
+        t.job_duration()
+        for t in traces
+        if t.cluster_size() == 1
+        and t.strategy_type() == SEQUENTIAL_BASELINE_STRATEGY
+    ]
+    return statistics.fmean(durations) if durations else None
+
+
+def speedup_stats(traces: list[JobTrace]) -> dict:
+    baseline = sequential_baseline_mean(traces)
+    if baseline is None:
+        return {}
+    grouped: dict[tuple[int, str], list[float]] = defaultdict(list)
+    for trace in traces:
+        grouped[(trace.cluster_size(), trace.strategy_type())].append(
+            trace.job_duration()
+        )
+    return {
+        key: {
+            "speedup": baseline / statistics.fmean(durations),
+            "efficiency": baseline / statistics.fmean(durations) / key[0],
+            "runs": len(durations),
+        }
+        for key, durations in grouped.items()
+    }
+
+
+# -- A8: job duration --------------------------------------------------------
+
+
+def job_duration_stats(traces: list[JobTrace]) -> dict:
+    grouped: dict[tuple[int, str], list[float]] = defaultdict(list)
+    for trace in traces:
+        grouped[(trace.cluster_size(), trace.strategy_type())].append(
+            trace.job_duration()
+        )
+    return {
+        key: {
+            "mean_seconds": statistics.fmean(durations),
+            "mean_hours": statistics.fmean(durations) / 3600.0,
+            "runs": len(durations),
+        }
+        for key, durations in grouped.items()
+    }
+
+
+# -- A9: job tail delay ------------------------------------------------------
+
+
+def tail_delay_stats(traces: list[JobTrace]) -> dict:
+    """Per-run max worker tail delay, absolute and scaled by mean frame time
+    (reference: job_tail_delay.py)."""
+    grouped: dict[tuple[int, str], list[tuple[float, float]]] = defaultdict(list)
+    for trace in traces:
+        global_last = trace.get_last_frame_finished_at()
+        delays = [
+            worker_tail_delay(worker, global_last)
+            for worker in trace.worker_traces.values()
+        ]
+        run_tail = max(delays) if delays else 0.0
+        frame_times = [
+            mean_frame_time(worker)
+            for worker in trace.worker_traces.values()
+            if worker.frame_render_traces
+        ]
+        mean_ft = statistics.fmean(frame_times) if frame_times else 0.0
+        scaled = run_tail / mean_ft if mean_ft > 0 else 0.0
+        grouped[(trace.cluster_size(), trace.strategy_type())].append(
+            (run_tail, scaled)
+        )
+    return {
+        key: {
+            "mean_tail_seconds": statistics.fmean(v[0] for v in values),
+            "max_tail_seconds": max(v[0] for v in values),
+            "mean_tail_scaled": statistics.fmean(v[1] for v in values),
+            "runs": len(values),
+        }
+        for key, values in grouped.items()
+    }
+
+
+# -- A10: worker latency -----------------------------------------------------
+
+
+def latency_stats(traces: list[JobTrace]) -> dict:
+    """Heartbeat RTT in milliseconds (reference: worker_latency.py:74-87)."""
+    grouped: dict[int, list[float]] = defaultdict(list)
+    for trace in traces:
+        for worker in trace.worker_traces.values():
+            for ping in worker.ping_traces:
+                grouped[trace.cluster_size()].append(ping.latency() * 1000.0)
+    return {
+        size: {
+            "mean_ms": statistics.fmean(values),
+            "median_ms": statistics.median(values),
+            "max_ms": max(values),
+            "over_25ms": sum(1 for v in values if v > 25.0),
+            "count": len(values),
+        }
+        for size, values in grouped.items()
+        if values
+    }
+
+
+# -- A11: read/render/write split -------------------------------------------
+
+
+def phase_split_stats(traces: list[JobTrace]) -> dict:
+    """Mean fraction of frame time in load/render/save
+    (reference: reading_rendering_writing.py)."""
+    grouped: dict[int, list[tuple[float, float, float]]] = defaultdict(list)
+    for trace in traces:
+        for worker in trace.worker_traces.values():
+            for frame in worker.frame_render_traces:
+                d = frame.details
+                total = d.total_execution_time()
+                if total <= 0:
+                    continue
+                read = d.finished_loading_at - d.started_process_at
+                render = d.finished_rendering_at - d.started_rendering_at
+                save = d.file_saving_finished_at - d.file_saving_started_at
+                grouped[trace.cluster_size()].append(
+                    (read / total, render / total, save / total)
+                )
+    return {
+        size: {
+            "reading": statistics.fmean(v[0] for v in values),
+            "rendering": statistics.fmean(v[1] for v in values),
+            "writing": statistics.fmean(v[2] for v in values),
+            "frames": len(values),
+        }
+        for size, values in grouped.items()
+        if values
+    }
+
+
+# -- A12: run statistics -----------------------------------------------------
+
+
+def run_statistics(traces: list[JobTrace]) -> dict:
+    """Run + reconnect counts per (size, strategy)
+    (reference: results_statistics.py:34-73)."""
+    grouped: dict[tuple[int, str], dict] = defaultdict(
+        lambda: {"runs": 0, "reconnects": 0, "frames": 0}
+    )
+    for trace in traces:
+        entry = grouped[(trace.cluster_size(), trace.strategy_type())]
+        entry["runs"] += 1
+        entry["reconnects"] += sum(
+            len(w.reconnection_traces) for w in trace.worker_traces.values()
+        )
+        entry["frames"] += sum(
+            len(w.frame_render_traces) for w in trace.worker_traces.values()
+        )
+    return dict(grouped)

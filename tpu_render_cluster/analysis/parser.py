@@ -1,0 +1,68 @@
+"""Trace discovery + loading (reference: analysis/core/parser.py).
+
+Globs ``*_raw-trace.json`` under a results directory and loads them
+sequentially or with a thread pool; an optional on-disk cache (pickle —
+the reference uses dill, same role) skips re-parsing unchanged files.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import pickle
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+
+from tpu_render_cluster.analysis.models import JobTrace
+
+logger = logging.getLogger(__name__)
+
+RAW_TRACE_GLOB = "*_raw-trace.json"
+
+
+def find_trace_files(results_directory: str | Path) -> list[Path]:
+    return sorted(Path(results_directory).rglob(RAW_TRACE_GLOB))
+
+
+def load_traces(
+    results_directory: str | Path,
+    *,
+    workers: int = 4,
+    cache_directory: str | Path | None = None,
+) -> list[JobTrace]:
+    """Load every raw trace under the directory (thread pool, optional cache)."""
+    paths = find_trace_files(results_directory)
+    if not paths:
+        return []
+
+    cache_dir = Path(cache_directory) if cache_directory else None
+    if cache_dir is not None:
+        cache_dir.mkdir(parents=True, exist_ok=True)
+
+    def load_one(path: Path) -> JobTrace | None:
+        cache_path = None
+        if cache_dir is not None:
+            digest = hashlib.sha1(
+                f"{path}:{path.stat().st_mtime_ns}".encode()
+            ).hexdigest()
+            cache_path = cache_dir / f"{digest}.pkl"
+            if cache_path.is_file():
+                try:
+                    return pickle.loads(cache_path.read_bytes())
+                except Exception:  # noqa: BLE001 - stale cache
+                    cache_path.unlink(missing_ok=True)
+        try:
+            trace = JobTrace.load_from_trace_file(path)
+        except Exception as e:  # noqa: BLE001 - skip malformed, keep going
+            logger.warning("Skipping malformed trace %s: %s", path, e)
+            return None
+        if cache_path is not None:
+            cache_path.write_bytes(pickle.dumps(trace))
+        return trace
+
+    if workers <= 1:
+        loaded = [load_one(p) for p in paths]
+    else:
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            loaded = list(pool.map(load_one, paths))
+    return [t for t in loaded if t is not None]

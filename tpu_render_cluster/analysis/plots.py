@@ -1,0 +1,163 @@
+"""Plot generation for the A5-A12 metrics (reference: analysis/*.py plots).
+
+Matplotlib with the Agg backend; each function writes one PNG and returns
+its path. Axis conventions follow the reference where they matter
+(utilization emphasised on [0.95, 1.0], latency on [0, 5] ms, scaled tail
+delay on [0, 2] — reference: worker_utilization.py:154-157,
+worker_latency.py:129-132, job_tail_delay.py).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from pathlib import Path
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+from tpu_render_cluster.analysis.models import JobTrace  # noqa: E402
+from tpu_render_cluster.analysis import metrics as M  # noqa: E402
+
+
+def _strategy_groups(traces: list[JobTrace]):
+    groups = defaultdict(list)
+    for trace in traces:
+        groups[trace.strategy_type()].append(trace)
+    return groups
+
+
+def plot_worker_utilization(traces: list[JobTrace], output_directory: Path) -> Path:
+    """Boxplots of per-worker utilization vs cluster size, per strategy."""
+    output_directory.mkdir(parents=True, exist_ok=True)
+    groups = _strategy_groups(traces)
+    fig, axes = plt.subplots(
+        1, max(len(groups), 1), figsize=(5 * max(len(groups), 1), 4), squeeze=False
+    )
+    for axis, (strategy, strategy_traces) in zip(axes[0], sorted(groups.items())):
+        by_size = defaultdict(list)
+        for trace in strategy_traces:
+            for u in M.worker_utilizations(trace):
+                by_size[trace.cluster_size()].append(u.utilization)
+        sizes = sorted(by_size)
+        axis.boxplot([by_size[s] for s in sizes], tick_labels=[str(s) for s in sizes])
+        axis.set_title(f"Utilization — {strategy}")
+        axis.set_xlabel("cluster size")
+        axis.set_ylabel("utilization")
+        axis.set_ybound(0.0, 1.02)
+    path = output_directory / "worker_utilization.png"
+    fig.tight_layout()
+    fig.savefig(path, dpi=110)
+    plt.close(fig)
+    return path
+
+
+def plot_speedup_and_efficiency(traces: list[JobTrace], output_directory: Path) -> Path:
+    output_directory.mkdir(parents=True, exist_ok=True)
+    stats = M.speedup_stats(traces)
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(11, 4))
+    strategies = sorted({key[1] for key in stats})
+    sizes = sorted({key[0] for key in stats})
+    width = 0.8 / max(len(strategies), 1)
+    for i, strategy in enumerate(strategies):
+        xs, speedups, efficiencies = [], [], []
+        for j, size in enumerate(sizes):
+            if (size, strategy) in stats:
+                xs.append(j + i * width)
+                speedups.append(stats[(size, strategy)]["speedup"])
+                efficiencies.append(stats[(size, strategy)]["efficiency"])
+        ax1.bar(xs, speedups, width=width, label=strategy)
+        ax2.bar(xs, efficiencies, width=width, label=strategy)
+    for axis, title in ((ax1, "Speedup"), (ax2, "Efficiency")):
+        axis.set_xticks(range(len(sizes)))
+        axis.set_xticklabels([str(s) for s in sizes])
+        axis.set_xlabel("cluster size")
+        axis.set_title(title)
+        axis.legend(fontsize=7)
+    ax2.set_ybound(0.0, 1.05)
+    path = output_directory / "speedup_efficiency.png"
+    fig.tight_layout()
+    fig.savefig(path, dpi=110)
+    plt.close(fig)
+    return path
+
+
+def plot_job_durations(traces: list[JobTrace], output_directory: Path) -> Path:
+    output_directory.mkdir(parents=True, exist_ok=True)
+    stats = M.job_duration_stats(traces)
+    fig, axis = plt.subplots(figsize=(7, 4))
+    labels = [f"{size}w/{strategy}" for size, strategy in sorted(stats)]
+    values = [stats[key]["mean_seconds"] for key in sorted(stats)]
+    axis.bar(range(len(values)), values)
+    axis.set_xticks(range(len(values)))
+    axis.set_xticklabels(labels, rotation=30, ha="right", fontsize=7)
+    axis.set_ylabel("mean job duration (s)")
+    path = output_directory / "job_duration.png"
+    fig.tight_layout()
+    fig.savefig(path, dpi=110)
+    plt.close(fig)
+    return path
+
+
+def plot_tail_delay(traces: list[JobTrace], output_directory: Path) -> Path:
+    output_directory.mkdir(parents=True, exist_ok=True)
+    stats = M.tail_delay_stats(traces)
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(11, 4))
+    keys = sorted(stats)
+    labels = [f"{size}w/{strategy}" for size, strategy in keys]
+    ax1.bar(range(len(keys)), [stats[k]["mean_tail_seconds"] for k in keys])
+    ax1.set_title("Tail delay (s)")
+    ax2.bar(range(len(keys)), [stats[k]["mean_tail_scaled"] for k in keys])
+    ax2.set_title("Tail delay (x mean frame time)")
+    ax2.set_ybound(0.0, 2.0)
+    for axis in (ax1, ax2):
+        axis.set_xticks(range(len(keys)))
+        axis.set_xticklabels(labels, rotation=30, ha="right", fontsize=7)
+    path = output_directory / "job_tail_delay.png"
+    fig.tight_layout()
+    fig.savefig(path, dpi=110)
+    plt.close(fig)
+    return path
+
+
+def plot_latency(traces: list[JobTrace], output_directory: Path) -> Path:
+    output_directory.mkdir(parents=True, exist_ok=True)
+    by_size = defaultdict(list)
+    for trace in traces:
+        for worker in trace.worker_traces.values():
+            for ping in worker.ping_traces:
+                by_size[trace.cluster_size()].append(ping.latency() * 1000.0)
+    sizes = sorted(by_size)
+    fig, axis = plt.subplots(figsize=(7, 4))
+    if sizes:
+        axis.boxplot([by_size[s] for s in sizes], tick_labels=[str(s) for s in sizes])
+    axis.set_xlabel("cluster size")
+    axis.set_ylabel("heartbeat RTT (ms)")
+    axis.set_ybound(0.0, 5.0)
+    path = output_directory / "worker_latency.png"
+    fig.tight_layout()
+    fig.savefig(path, dpi=110)
+    plt.close(fig)
+    return path
+
+
+def plot_phase_split(traces: list[JobTrace], output_directory: Path) -> Path:
+    output_directory.mkdir(parents=True, exist_ok=True)
+    stats = M.phase_split_stats(traces)
+    sizes = sorted(stats)
+    fig, axis = plt.subplots(figsize=(7, 4))
+    left = [0.0] * len(sizes)
+    for phase, color in (("reading", "#4878a8"), ("rendering", "#e8a33d"), ("writing", "#6aa56a")):
+        values = [stats[s][phase] for s in sizes]
+        axis.barh(range(len(sizes)), values, left=left, label=phase, color=color)
+        left = [l + v for l, v in zip(left, values)]
+    axis.set_yticks(range(len(sizes)))
+    axis.set_yticklabels([f"{s} workers" for s in sizes])
+    axis.set_xlabel("fraction of frame time")
+    axis.legend(fontsize=8)
+    path = output_directory / "reading_rendering_writing.png"
+    fig.tight_layout()
+    fig.savefig(path, dpi=110)
+    plt.close(fig)
+    return path

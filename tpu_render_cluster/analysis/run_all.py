@@ -1,0 +1,69 @@
+"""Run the full analysis pipeline (reference: analysis/run_all.py).
+
+Usage:
+  python -m tpu_render_cluster.analysis.run_all --results <dir> --out <dir>
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tpu_render_cluster.analysis import metrics as M
+from tpu_render_cluster.analysis.parser import load_traces
+from tpu_render_cluster.analysis.timed_context import timed_section
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="trc-analysis")
+    parser.add_argument("--results", required=True, help="Directory of *_raw-trace.json")
+    parser.add_argument("--out", required=True, help="Output directory for plots + stats")
+    parser.add_argument("--no-plots", action="store_true")
+    args = parser.parse_args(argv)
+
+    with timed_section("load traces"):
+        traces = load_traces(args.results)
+    if not traces:
+        print(f"No raw traces found under {args.results}", file=sys.stderr)
+        return 1
+    print(f"Loaded {len(traces)} run(s).")
+
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+
+    stats = {
+        "utilization": {str(k): v for k, v in M.utilization_stats(traces).items()},
+        "speedup": {str(k): v for k, v in M.speedup_stats(traces).items()},
+        "job_duration": {str(k): v for k, v in M.job_duration_stats(traces).items()},
+        "tail_delay": {str(k): v for k, v in M.tail_delay_stats(traces).items()},
+        "latency": {str(k): v for k, v in M.latency_stats(traces).items()},
+        "phase_split": {str(k): v for k, v in M.phase_split_stats(traces).items()},
+        "run_statistics": {str(k): v for k, v in M.run_statistics(traces).items()},
+    }
+    stats_path = out / "statistics.json"
+    stats_path.write_text(json.dumps(stats, indent=2))
+    print(f"Statistics written to {stats_path}")
+
+    if not args.no_plots:
+        from tpu_render_cluster.analysis import plots
+
+        with timed_section("plots"):
+            for fn in (
+                plots.plot_worker_utilization,
+                plots.plot_speedup_and_efficiency,
+                plots.plot_job_durations,
+                plots.plot_tail_delay,
+                plots.plot_latency,
+                plots.plot_phase_split,
+            ):
+                try:
+                    print(f"  wrote {fn(traces, out)}")
+                except Exception as e:  # noqa: BLE001 - keep producing others
+                    print(f"  {fn.__name__} failed: {e}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

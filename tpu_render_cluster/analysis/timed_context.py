@@ -1,0 +1,16 @@
+"""Pretty timing context manager (reference: analysis/core/timed_context.py)."""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+
+@contextmanager
+def timed_section(name: str):
+    start = time.perf_counter()
+    print(f"[{name}] ...", flush=True)
+    try:
+        yield
+    finally:
+        print(f"[{name}] done in {time.perf_counter() - start:.2f} s", flush=True)

@@ -17,7 +17,7 @@ reference strategies is byte-identical in structure.
 from __future__ import annotations
 
 import tomllib
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from pathlib import Path
 from typing import Any
 

@@ -1,0 +1,3 @@
+from tpu_render_cluster.master.cluster import ClusterManager
+
+__all__ = ["ClusterManager"]

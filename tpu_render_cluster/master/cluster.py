@@ -1,0 +1,228 @@
+"""Cluster manager: the master's top-level orchestration.
+
+Lifecycle (reference: master/src/cluster/mod.rs:484-672):
+bind -> accept connections (3-step app handshake; first-connection builds a
+worker, reconnecting swaps the socket into the existing logical connection)
+-> barrier-wait for ``wait_for_number_of_workers`` -> broadcast job-started
+-> run the distribution strategy to completion -> collect every worker's
+trace (cancelling its heartbeat first; 600 s budget) -> shut down.
+
+Improvements over the reference, kept behaviorally compatible:
+- late-joining workers receive ``event_job-started`` at handshake time (the
+  reference acknowledges this hole at master/src/cluster/mod.rs:616-617);
+- a worker that misses heartbeats or fails mid-RPC is *evicted*: its queued
+  frames return to the pending pool so the job still finishes (the
+  reference leaves them assigned forever — SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from tpu_render_cluster import PROTOCOL_VERSION
+from tpu_render_cluster.jobs.models import BlenderJob
+from tpu_render_cluster.master.state import ClusterManagerState, FrameStatus
+from tpu_render_cluster.master.strategies import run_strategy
+from tpu_render_cluster.master.worker_handle import WorkerHandle
+from tpu_render_cluster.protocol import messages as pm
+from tpu_render_cluster.traces.master_trace import MasterTrace
+from tpu_render_cluster.traces.worker_trace import WorkerTrace
+from tpu_render_cluster.transport.reconnect import ReconnectableServerConnection
+from tpu_render_cluster.transport.ws import (
+    WebSocketClosed,
+    WebSocketConnection,
+    websocket_accept,
+)
+from tpu_render_cluster.utils.cancellation import CancellationToken
+
+logger = logging.getLogger(__name__)
+
+HANDSHAKE_TIMEOUT = 30.0
+BARRIER_POLL_SECONDS = 1.0  # reference: master/src/cluster/mod.rs:568-585
+
+
+class ClusterManager:
+    """Runs one job across a cluster of connected workers."""
+
+    def __init__(self, host: str, port: int, job: BlenderJob) -> None:
+        self.host = host
+        self.port = port
+        self.job = job
+        self.state = ClusterManagerState(job)
+        self.workers: dict[int, WorkerHandle] = {}
+        self.cancellation = CancellationToken()
+        self._job_started = False
+        self._server: asyncio.Server | None = None
+
+    # -- public ------------------------------------------------------------
+
+    async def initialize_server_and_run_job(
+        self,
+    ) -> tuple[MasterTrace, list[tuple[str, WorkerTrace]]]:
+        """Bind, run the job to completion, and collect all traces."""
+        self._server = await asyncio.start_server(
+            self._on_tcp_connection, self.host, self.port
+        )
+        actual_port = self._server.sockets[0].getsockname()[1]
+        self.port = actual_port
+        logger.info("Master listening on %s:%d", self.host, actual_port)
+        try:
+            master_trace = await self._wait_for_workers_and_run_job()
+            worker_traces = await self._collect_worker_traces()
+            return master_trace, worker_traces
+        finally:
+            self.cancellation.cancel()
+            # Close worker sockets BEFORE wait_closed(): since 3.12,
+            # Server.wait_closed() waits for every live connection handler.
+            for worker in list(self.workers.values()):
+                await worker.shutdown()
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 5.0)
+            except asyncio.TimeoutError:
+                logger.warning("Server close timed out; continuing shutdown.")
+
+    def live_workers(self) -> list[WorkerHandle]:
+        return [w for w in self.workers.values() if not w.is_dead]
+
+    # -- accept loop --------------------------------------------------------
+
+    async def _on_tcp_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        """WS upgrade + 3-step application handshake.
+
+        Reference: master/src/cluster/mod.rs:280-481.
+        """
+        try:
+            ws = await asyncio.wait_for(
+                websocket_accept(reader, writer), HANDSHAKE_TIMEOUT
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.debug("WS upgrade failed: %s", e)
+            writer.close()
+            return
+        try:
+            await asyncio.wait_for(self._perform_handshake(ws), HANDSHAKE_TIMEOUT)
+        except Exception as e:  # noqa: BLE001
+            logger.warning("Handshake with %s failed: %s", ws.peer_address(), e)
+            ws.abort()
+
+    async def _perform_handshake(self, ws: WebSocketConnection) -> None:
+        await ws.send_text(
+            pm.encode_message(pm.MasterHandshakeRequest(PROTOCOL_VERSION))
+        )
+        response = pm.decode_message(await ws.receive_text())
+        if not isinstance(response, pm.WorkerHandshakeResponse):
+            raise WebSocketClosed(f"Expected handshake response, got {type(response)}")
+
+        if response.handshake_type == pm.HANDSHAKE_TYPE_FIRST_CONNECTION:
+            await ws.send_text(
+                pm.encode_message(pm.MasterHandshakeAcknowledgement(True))
+            )
+            await self._register_new_worker(response.worker_id, ws)
+        elif response.handshake_type == pm.HANDSHAKE_TYPE_RECONNECTING:
+            known = response.worker_id in self.workers
+            await ws.send_text(
+                pm.encode_message(pm.MasterHandshakeAcknowledgement(known))
+            )
+            if not known:
+                # Reference: reconnect from an unknown worker is refused
+                # (master/src/cluster/mod.rs:378-385).
+                logger.warning(
+                    "Refusing reconnect from unknown worker %08x", response.worker_id
+                )
+                ws.abort()
+                return
+            worker = self.workers[response.worker_id]
+            worker.connection.replace_inner_connection(ws)
+            worker.logger.info("Worker reconnected from %s", ws.peer_address())
+        else:
+            raise WebSocketClosed(
+                f"Unknown handshake type: {response.handshake_type!r}"
+            )
+
+    async def _register_new_worker(self, worker_id: int, ws: WebSocketConnection) -> None:
+        if worker_id in self.workers:
+            logger.warning(
+                "Worker id collision (%08x); refusing duplicate.", worker_id
+            )
+            ws.abort()
+            return
+        connection = ReconnectableServerConnection(ws)
+        worker = WorkerHandle(
+            worker_id, connection, self.state, on_dead=self._evict_worker
+        )
+        self.workers[worker_id] = worker
+        worker.start()
+        logger.info(
+            "Worker %08x connected from %s (%d/%d).",
+            worker_id,
+            ws.peer_address(),
+            len(self.workers),
+            self.job.wait_for_number_of_workers,
+        )
+        # Late joiners still learn the job has started (reference FIXME at
+        # master/src/cluster/mod.rs:616-617).
+        if self._job_started:
+            await worker.send_job_started()
+
+    async def _evict_worker(self, worker: WorkerHandle, reason: str) -> None:
+        """Return a dead worker's frames to the pool so the job can finish."""
+        logger.warning("Evicting worker %08x: %s", worker.worker_id, reason)
+        for frame in worker.queue.all_frames():
+            record = self.state.frames.get(frame.frame_index)
+            if record is not None and record.status is not FrameStatus.FINISHED:
+                self.state.return_frame_to_pending(frame.frame_index)
+
+    # -- job execution ------------------------------------------------------
+
+    async def _wait_for_workers_and_run_job(self) -> MasterTrace:
+        target = self.job.wait_for_number_of_workers
+        logger.info("Waiting for %d workers to connect...", target)
+        while len(self.workers) < target:
+            if self.cancellation.is_cancelled():
+                raise RuntimeError("Cancelled while waiting for workers.")
+            await asyncio.sleep(BARRIER_POLL_SECONDS)
+        logger.info("All %d workers connected; starting job.", target)
+
+        self._job_started = True
+        for worker in self.live_workers():
+            await worker.send_job_started()
+
+        start = time.time()
+        await run_strategy(
+            self.job, self.state, self.live_workers, self.cancellation
+        )
+        finish = time.time()
+        if not self.state.all_frames_finished():
+            raise RuntimeError("Strategy exited before all frames finished.")
+        logger.info("All frames finished in %.2f s.", finish - start)
+        return MasterTrace(job_start_time=start, job_finish_time=finish)
+
+    async def _collect_worker_traces(self) -> list[tuple[str, WorkerTrace]]:
+        """Gather traces; key format ``<worker_id:08x>-<addr>``.
+
+        Reference: master/src/cluster/mod.rs:514-541.
+        """
+        traces: list[tuple[str, WorkerTrace]] = []
+        for worker in self.workers.values():
+            worker.cancel_heartbeat()
+            if worker.is_dead:
+                logger.warning(
+                    "Skipping trace collection for dead worker %08x.",
+                    worker.worker_id,
+                )
+                continue
+            try:
+                trace = await worker.finish_job_and_get_trace()
+            except Exception as e:  # noqa: BLE001
+                logger.error(
+                    "Could not collect trace from %08x: %s", worker.worker_id, e
+                )
+                continue
+            name = f"{pm.worker_id_to_string(worker.worker_id)}-{worker.connection.last_known_address}"
+            traces.append((name, trace))
+        return traces

@@ -1,0 +1,65 @@
+"""Master CLI entry point.
+
+Flag surface matches the reference's clap parser (reference:
+master/src/cli.rs:5-40, master/src/main.rs:275-338):
+``master --host H --port P [--logFilePath F] run-job <job.toml>
+--resultsDirectory D``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+from datetime import datetime
+from pathlib import Path
+
+from tpu_render_cluster.jobs.models import BlenderJob
+from tpu_render_cluster.master.cluster import ClusterManager
+from tpu_render_cluster.master.persist import (
+    parse_worker_traces,
+    print_results,
+    save_processed_results,
+    save_raw_traces,
+)
+from tpu_render_cluster.utils.logging import initialize_console_and_file_logging
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="trc-master", description="Render cluster master")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=9901)
+    parser.add_argument("--logFilePath", dest="log_file_path", default=None)
+    subparsers = parser.add_subparsers(dest="command", required=True)
+    run_job = subparsers.add_parser("run-job", help="Run a job to completion")
+    run_job.add_argument("job_file_path")
+    run_job.add_argument(
+        "--resultsDirectory", dest="results_directory", required=True
+    )
+    return parser
+
+
+async def run_job_command(args: argparse.Namespace) -> int:
+    job = BlenderJob.load_from_file(args.job_file_path)
+    start_time = datetime.now()
+    manager = ClusterManager(args.host, args.port, job)
+    master_trace, worker_traces = await manager.initialize_server_and_run_job()
+
+    results_directory = Path(args.results_directory)
+    save_raw_traces(start_time, job, results_directory, master_trace, worker_traces)
+    performance = parse_worker_traces(worker_traces)
+    save_processed_results(start_time, job, results_directory, performance)
+    print_results(master_trace, performance)
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    initialize_console_and_file_logging(args.log_file_path)
+    if args.command == "run-job":
+        return asyncio.run(run_job_command(args))
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,117 @@
+"""Results persistence + human report.
+
+Writes the two JSON artifacts the reference master produces
+(reference: master/src/main.rs:26-272):
+
+- ``<ts>_job-<name>_raw-trace.json`` — ``{job, master_trace, worker_traces}``
+  with worker keys ``<worker_id:08x>-<addr>`` — the file the analysis suite
+  consumes (analysis/core/models.py:251-313);
+- ``<ts>_job-<name>_processed-results.json`` — per-worker ``WorkerPerformance``.
+
+Timestamp prefix format matches the reference: ``%Y-%m-%d_%H-%M-%S`` local
+time (master/src/main.rs:71-75).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from datetime import datetime
+from pathlib import Path
+
+from tpu_render_cluster.jobs.models import BlenderJob
+from tpu_render_cluster.traces.master_trace import MasterTrace
+from tpu_render_cluster.traces.performance import WorkerPerformance
+from tpu_render_cluster.traces.worker_trace import WorkerTrace
+
+logger = logging.getLogger(__name__)
+
+
+def _file_prefix(start_time: datetime, job: BlenderJob) -> str:
+    return (
+        f"{start_time.strftime('%Y-%m-%d_%H-%M-%S')}"
+        f"_job-{job.job_name.replace(' ', '_')}"
+    )
+
+
+def save_raw_traces(
+    start_time: datetime,
+    job: BlenderJob,
+    output_directory: Path,
+    master_trace: MasterTrace,
+    worker_traces: list[tuple[str, WorkerTrace]],
+) -> Path:
+    output_directory.mkdir(parents=True, exist_ok=True)
+    path = output_directory / f"{_file_prefix(start_time, job)}_raw-trace.json"
+    payload = {
+        "job": job.to_dict(),
+        "master_trace": master_trace.to_dict(),
+        "worker_traces": {name: trace.to_dict() for name, trace in worker_traces},
+    }
+    path.write_text(json.dumps(payload, indent=2), encoding="utf-8")
+    logger.info("Raw traces saved to %s", path)
+    return path
+
+
+def parse_worker_traces(
+    worker_traces: list[tuple[str, WorkerTrace]],
+) -> list[tuple[str, WorkerPerformance]]:
+    return [
+        (name, WorkerPerformance.from_worker_trace(trace))
+        for name, trace in worker_traces
+    ]
+
+
+def save_processed_results(
+    start_time: datetime,
+    job: BlenderJob,
+    output_directory: Path,
+    worker_performance: list[tuple[str, WorkerPerformance]],
+) -> Path:
+    output_directory.mkdir(parents=True, exist_ok=True)
+    path = output_directory / f"{_file_prefix(start_time, job)}_processed-results.json"
+    payload = {
+        "worker_performance": {
+            name: performance.to_dict() for name, performance in worker_performance
+        }
+    }
+    path.write_text(json.dumps(payload, indent=2), encoding="utf-8")
+    logger.info("Processed results saved to %s", path)
+    return path
+
+
+def print_results(
+    master_trace: MasterTrace,
+    worker_performance: list[tuple[str, WorkerPerformance]],
+) -> str:
+    """Per-worker + cumulative report (reference: master/src/main.rs:148-272)."""
+    lines: list[str] = []
+    lines.append("=" * 60)
+    lines.append("Job complete.")
+    lines.append(f"  Total job duration: {master_trace.job_duration():.2f} s")
+    lines.append("")
+    total_frames = 0
+    for name, perf in worker_performance:
+        total_frames += perf.total_frames_rendered
+        lines.append(f"Worker {name}:")
+        lines.append(f"  frames rendered : {perf.total_frames_rendered}")
+        lines.append(f"  frames queued   : {perf.total_frames_queued}")
+        lines.append(f"  frames stolen   : {perf.total_frames_stolen_from_queue}")
+        lines.append(f"  reconnects      : {perf.total_times_reconnected}")
+        lines.append(f"  total time      : {perf.total_time:.2f} s")
+        lines.append(f"  reading time    : {perf.total_blend_file_reading_time:.2f} s")
+        lines.append(f"  rendering time  : {perf.total_rendering_time:.2f} s")
+        lines.append(f"  saving time     : {perf.total_image_saving_time:.2f} s")
+        lines.append(f"  idle time       : {perf.total_idle_time:.2f} s")
+        if perf.total_time > 0:
+            utilization = 1.0 - perf.total_idle_time / perf.total_time
+            lines.append(f"  utilization     : {utilization:.3f}")
+        lines.append("")
+    lines.append(f"Cumulative frames rendered: {total_frames}")
+    duration = master_trace.job_duration()
+    if duration > 0:
+        lines.append(f"Throughput: {total_frames / duration:.3f} frames/s")
+    lines.append("=" * 60)
+    report = "\n".join(lines)
+    print(report)
+    return report

@@ -1,0 +1,55 @@
+"""Master-side replica of each worker's frame queue.
+
+Reference: ``WorkerQueue`` / ``FrameOnWorker``
+(master/src/connection/queue.rs:10-122). The mirror lets the scheduler sort
+workers by load and pick steal candidates without a network round-trip; the
+atomic size counter of the reference collapses to ``len()`` because all
+mutation happens on one event loop.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class FrameOnWorker:
+    frame_index: int
+    queued_at: float
+    is_rendering: bool = False
+    stolen_from: int | None = None
+
+
+class WorkerQueueMirror:
+    """Insertion-ordered mirror of a worker's remote queue."""
+
+    def __init__(self) -> None:
+        self._frames: dict[int, FrameOnWorker] = {}
+
+    def __len__(self) -> int:
+        return len(self._frames)
+
+    def __contains__(self, frame_index: int) -> bool:
+        return frame_index in self._frames
+
+    def add(self, frame: FrameOnWorker) -> None:
+        self._frames[frame.frame_index] = frame
+
+    def remove(self, frame_index: int) -> FrameOnWorker | None:
+        return self._frames.pop(frame_index, None)
+
+    def set_rendering(self, frame_index: int) -> None:
+        frame = self._frames.get(frame_index)
+        if frame is not None:
+            frame.is_rendering = True
+
+    def queued_frames_in_order(self) -> list[FrameOnWorker]:
+        """Frames not yet rendering, oldest first (steal-candidate order)."""
+        return [f for f in self._frames.values() if not f.is_rendering]
+
+    def all_frames(self) -> list[FrameOnWorker]:
+        return list(self._frames.values())
+
+    def pending_size(self) -> int:
+        """Queue entries that have not started rendering."""
+        return sum(1 for f in self._frames.values() if not f.is_rendering)

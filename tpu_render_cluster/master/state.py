@@ -1,0 +1,128 @@
+"""Cluster manager state: the global frame table + worker registry.
+
+Semantics follow the reference's ``ClusterManagerState`` frame status machine
+(Pending -> QueuedOnWorker -> RenderingOnWorker -> Finished, with steal
+transitions back to Queued — reference: master/src/cluster/state.rs:13-130),
+but the data structures are scale-fixed: the reference linearly scans a
+``Vec`` of 14 400 frames on every 50 ms tick (state.rs:63-80, flagged in
+SURVEY.md §5.7); here pending frames live in a deque and finished frames in
+a counter, making ``next_pending_frame``/``all_frames_finished`` O(1).
+"""
+
+from __future__ import annotations
+
+import enum
+from collections import deque
+from dataclasses import dataclass, field
+
+from tpu_render_cluster.jobs.models import BlenderJob
+
+
+class FrameStatus(enum.Enum):
+    PENDING = "pending"
+    QUEUED_ON_WORKER = "queued"
+    RENDERING_ON_WORKER = "rendering"
+    FINISHED = "finished"
+
+
+@dataclass
+class FrameRecord:
+    frame_index: int
+    status: FrameStatus = FrameStatus.PENDING
+    worker_id: int | None = None
+    queued_at: float | None = None
+    # Worker the frame was last stolen FROM (provenance for the
+    # resteal-to-original-worker anti-thrash timer, reference:
+    # master/src/cluster/state.rs:13-24, strategies.rs:155-191).
+    stolen_from: int | None = None
+    stolen_at: float | None = None
+
+
+class ClusterManagerState:
+    """Global frame table; single event loop, so no locking is needed."""
+
+    def __init__(self, job: BlenderJob) -> None:
+        self.job = job
+        self.frames: dict[int, FrameRecord] = {
+            index: FrameRecord(index) for index in job.frame_indices()
+        }
+        self._pending: deque[int] = deque(job.frame_indices())
+        self._finished_count = 0
+
+    # -- queries -----------------------------------------------------------
+
+    def next_pending_frame(self) -> int | None:
+        """Peek the next pending frame index (O(1))."""
+        while self._pending:
+            index = self._pending[0]
+            if self.frames[index].status is FrameStatus.PENDING:
+                return index
+            self._pending.popleft()  # stale entry
+        return None
+
+    def all_frames_finished(self) -> bool:
+        return self._finished_count >= len(self.frames)
+
+    def pending_count(self) -> int:
+        return sum(
+            1 for i in self._pending if self.frames[i].status is FrameStatus.PENDING
+        )
+
+    def pending_frames(self, limit: int | None = None) -> list[int]:
+        out = []
+        for index in self._pending:
+            if self.frames[index].status is FrameStatus.PENDING:
+                out.append(index)
+                if limit is not None and len(out) >= limit:
+                    break
+        return out
+
+    # -- transitions -------------------------------------------------------
+
+    def mark_frame_as_queued(
+        self,
+        frame_index: int,
+        worker_id: int,
+        queued_at: float,
+        *,
+        stolen_from: int | None = None,
+        stolen_at: float | None = None,
+    ) -> None:
+        record = self.frames[frame_index]
+        if record.status is FrameStatus.FINISHED:
+            raise ValueError(f"BUG: frame {frame_index} is already finished.")
+        record.status = FrameStatus.QUEUED_ON_WORKER
+        record.worker_id = worker_id
+        record.queued_at = queued_at
+        if stolen_from is not None:
+            record.stolen_from = stolen_from
+            record.stolen_at = stolen_at
+        if self._pending and self._pending[0] == frame_index:
+            self._pending.popleft()
+
+    def mark_frame_as_rendering(self, frame_index: int, worker_id: int) -> None:
+        record = self.frames[frame_index]
+        if record.status is FrameStatus.FINISHED:
+            return  # late event after a race; harmless
+        record.status = FrameStatus.RENDERING_ON_WORKER
+        record.worker_id = worker_id
+
+    def mark_frame_as_finished(self, frame_index: int) -> None:
+        record = self.frames[frame_index]
+        if record.status is FrameStatus.FINISHED:
+            return
+        record.status = FrameStatus.FINISHED
+        self._finished_count += 1
+
+    def return_frame_to_pending(self, frame_index: int) -> None:
+        """Frame comes back to the pool (steal succeeded, render errored,
+        or its worker died). Unlike the reference — where a dead worker's
+        frames stay QueuedOnWorker forever (SURVEY.md §5.3) — this makes
+        eviction recoverable."""
+        record = self.frames[frame_index]
+        if record.status is FrameStatus.FINISHED:
+            return
+        record.status = FrameStatus.PENDING
+        record.worker_id = None
+        record.queued_at = None
+        self._pending.append(frame_index)

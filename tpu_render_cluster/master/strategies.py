@@ -1,0 +1,264 @@
+"""Frame-distribution strategies (the scheduler).
+
+Behavioral contract from the reference (master/src/cluster/strategies.rs):
+
+- **naive-fine** (strategies.rs:16-68): 50 ms tick; any worker with an empty
+  queue receives exactly one pending frame.
+- **eager-naive-coarse** (strategies.rs:70-150): 100 ms tick; every worker's
+  queue is topped up to ``target_queue_size``.
+- **dynamic** (strategies.rs:155-405): 50 ms tick; workers sorted by queue
+  size ascending; each below-target worker gets one pending frame, or — when
+  the pending pool is dry — steals one from the busiest worker. The steal
+  candidate skips the first ``min_queue_size_to_steal`` entries (nearest to
+  rendering), respects both anti-thrash resteal timers, and prefers the
+  longest-queued frame; remove-vs-render races (``already-rendering`` /
+  ``already-finished``) are tolerated by skipping the steal.
+
+The selection helpers are pure functions over the queue mirrors so they are
+unit-testable without a cluster (the reference never had such tests —
+SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import TYPE_CHECKING, Sequence
+
+from tpu_render_cluster.jobs.models import (
+    BlenderJob,
+    DynamicStrategyOptions,
+)
+from tpu_render_cluster.master.queue_mirror import FrameOnWorker
+from tpu_render_cluster.master.state import ClusterManagerState
+from tpu_render_cluster.protocol import messages as pm
+from tpu_render_cluster.utils.cancellation import CancellationToken
+
+if TYPE_CHECKING:
+    from tpu_render_cluster.master.worker_handle import WorkerHandle
+
+logger = logging.getLogger(__name__)
+
+NAIVE_FINE_TICK = 0.05
+EAGER_COARSE_TICK = 0.1
+DYNAMIC_TICK = 0.05
+
+
+# ---------------------------------------------------------------------------
+# Pure steal-candidate selection (reference: strategies.rs:155-248)
+
+
+def select_best_frame_to_steal(
+    thief_worker_id: int,
+    victim_queue: Sequence[FrameOnWorker],
+    options: DynamicStrategyOptions,
+    *,
+    now: float | None = None,
+) -> FrameOnWorker | None:
+    """Pick the steal candidate from a victim's queue mirror.
+
+    ``victim_queue`` must be the not-yet-rendering frames in queue order.
+    Returns the oldest eligible frame at position >= ``min_queue_size_to_steal``,
+    where eligibility requires the frame to have sat on the victim for at
+    least the resteal-to-elsewhere timer (or the longer resteal-to-original
+    timer when the thief is the worker it was originally stolen from).
+    """
+    now = time.time() if now is None else now
+    best: FrameOnWorker | None = None
+    for frame in victim_queue[options.min_queue_size_to_steal :]:
+        since_queued = now - frame.queued_at
+        if frame.stolen_from is not None and frame.stolen_from == thief_worker_id:
+            if since_queued >= options.min_seconds_before_resteal_to_original_worker:
+                if best is None or frame.queued_at < best.queued_at:
+                    best = frame
+            continue
+        if since_queued >= options.min_seconds_before_resteal_to_elsewhere:
+            if best is None or frame.queued_at < best.queued_at:
+                best = frame
+    return best
+
+
+def find_busiest_worker_and_frame_to_steal(
+    thief: "WorkerHandle",
+    workers: Sequence["WorkerHandle"],
+    options: DynamicStrategyOptions,
+    *,
+    now: float | None = None,
+) -> tuple["WorkerHandle", FrameOnWorker] | None:
+    """Find (victim, frame) — the biggest queue holding an eligible frame.
+
+    Only queues strictly larger than ``min_queue_size_to_steal`` are
+    considered (reference: strategies.rs:193-248).
+    """
+    best: tuple["WorkerHandle", int, FrameOnWorker] | None = None
+    for victim in workers:
+        if victim.worker_id == thief.worker_id or victim.is_dead:
+            continue
+        queue_size = len(victim.queue)
+        if queue_size <= options.min_queue_size_to_steal:
+            continue
+        if best is not None and queue_size <= best[1]:
+            continue
+        candidate = select_best_frame_to_steal(
+            thief.worker_id, victim.queue.queued_frames_in_order(), options, now=now
+        )
+        if candidate is not None:
+            best = (victim, queue_size, candidate)
+    if best is None:
+        return None
+    return best[0], best[2]
+
+
+# ---------------------------------------------------------------------------
+# Strategy loops
+
+
+async def _queue_one_pending(
+    worker: "WorkerHandle", job: BlenderJob, state: ClusterManagerState
+) -> bool:
+    frame_index = state.next_pending_frame()
+    if frame_index is None:
+        return False
+    # Claim immediately so concurrent assignment in the same tick can't
+    # double-queue the frame, then confirm via RPC.
+    state.mark_frame_as_queued(frame_index, worker.worker_id, time.time())
+    try:
+        await worker.queue_frame(job, frame_index)
+    except Exception as e:  # noqa: BLE001 - worker failure mid-RPC
+        logger.warning(
+            "Failed to queue frame %d on %08x: %s", frame_index, worker.worker_id, e
+        )
+        state.return_frame_to_pending(frame_index)
+        return False
+    return True
+
+
+async def naive_fine_strategy(
+    job: BlenderJob,
+    state: ClusterManagerState,
+    workers_fn,
+    cancellation: CancellationToken,
+) -> None:
+    """One frame at a time per idle worker (reference: strategies.rs:16-68)."""
+    while not cancellation.is_cancelled():
+        if state.all_frames_finished():
+            return
+        for worker in workers_fn():
+            if worker.is_dead or not worker.has_empty_queue():
+                continue
+            await _queue_one_pending(worker, job, state)
+        await asyncio.sleep(NAIVE_FINE_TICK)
+
+
+async def eager_naive_coarse_strategy(
+    job: BlenderJob,
+    state: ClusterManagerState,
+    workers_fn,
+    cancellation: CancellationToken,
+    target_queue_size: int,
+) -> None:
+    """Top every queue up to the target (reference: strategies.rs:70-150)."""
+    while not cancellation.is_cancelled():
+        if state.all_frames_finished():
+            return
+        for worker in workers_fn():
+            if worker.is_dead:
+                continue
+            deficit = target_queue_size - len(worker.queue)
+            for _ in range(max(0, deficit)):
+                if not await _queue_one_pending(worker, job, state):
+                    break
+        await asyncio.sleep(EAGER_COARSE_TICK)
+
+
+async def dynamic_strategy(
+    job: BlenderJob,
+    state: ClusterManagerState,
+    workers_fn,
+    cancellation: CancellationToken,
+    options: DynamicStrategyOptions,
+) -> None:
+    """Target-size top-up with work stealing (reference: strategies.rs:250-405)."""
+    while not cancellation.is_cancelled():
+        if state.all_frames_finished():
+            return
+        workers = [w for w in workers_fn() if not w.is_dead]
+        workers.sort(key=lambda w: len(w.queue))
+        for worker in workers:
+            if len(worker.queue) >= options.target_queue_size:
+                continue
+            if await _queue_one_pending(worker, job, state):
+                continue
+            # Pending pool dry: steal from the busiest worker.
+            found = find_busiest_worker_and_frame_to_steal(worker, workers, options)
+            if found is None:
+                break  # nobody has anything stealable; next tick
+            victim, frame = found
+            await steal_frame(job, state, worker, victim, frame.frame_index)
+        await asyncio.sleep(DYNAMIC_TICK)
+
+
+async def steal_frame(
+    job: BlenderJob,
+    state: ClusterManagerState,
+    thief: "WorkerHandle",
+    victim: "WorkerHandle",
+    frame_index: int,
+) -> bool:
+    """Unqueue from victim, requeue on thief with provenance.
+
+    Tolerates the distributed races exactly like the reference
+    (strategies.rs:340-396): if the victim already started rendering or
+    finished the frame, the steal silently aborts.
+    """
+    try:
+        result = await victim.unqueue_frame(job.job_name, frame_index)
+    except Exception as e:  # noqa: BLE001
+        logger.warning("Steal unqueue RPC failed on %08x: %s", victim.worker_id, e)
+        return False
+    if result in (
+        pm.FRAME_QUEUE_REMOVE_RESULT_ALREADY_RENDERING,
+        pm.FRAME_QUEUE_REMOVE_RESULT_ALREADY_FINISHED,
+    ):
+        return False
+    if result != pm.FRAME_QUEUE_REMOVE_RESULT_REMOVED:
+        logger.warning("Steal unqueue errored on %08x: %s", victim.worker_id, result)
+        return False
+    victim.frames_stolen_count += 1
+    try:
+        await thief.queue_frame(job, frame_index, stolen_from=victim.worker_id)
+    except Exception as e:  # noqa: BLE001
+        logger.warning("Steal requeue failed on %08x: %s", thief.worker_id, e)
+        state.return_frame_to_pending(frame_index)
+        return False
+    logger.debug(
+        "Stole frame %d: %08x -> %08x", frame_index, victim.worker_id, thief.worker_id
+    )
+    return True
+
+
+async def run_strategy(
+    job: BlenderJob,
+    state: ClusterManagerState,
+    workers_fn,
+    cancellation: CancellationToken,
+) -> None:
+    """Dispatch on the job's strategy (reference: master/src/cluster/mod.rs:622-654)."""
+    strategy = job.frame_distribution_strategy
+    if strategy.strategy_type == "naive-fine":
+        await naive_fine_strategy(job, state, workers_fn, cancellation)
+    elif strategy.strategy_type == "eager-naive-coarse":
+        await eager_naive_coarse_strategy(
+            job, state, workers_fn, cancellation, strategy.eager.target_queue_size
+        )
+    elif strategy.strategy_type == "dynamic":
+        await dynamic_strategy(job, state, workers_fn, cancellation, strategy.dynamic)
+    elif strategy.strategy_type == "tpu-batch":
+        from tpu_render_cluster.master.tpu_batch import tpu_batch_strategy
+
+        await tpu_batch_strategy(
+            job, state, workers_fn, cancellation, strategy.tpu_batch
+        )
+    else:
+        raise ValueError(f"Unknown strategy: {strategy.strategy_type}")

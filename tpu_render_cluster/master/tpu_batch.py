@@ -1,0 +1,188 @@
+"""The `tpu-batch` scheduler: cost-matrix assignment solved on TPU.
+
+New in this build (the north-star scheduler from BASELINE.md): each
+scheduling tick gathers every worker's queue deficit into a pool of *slots*
+(worker x queue position), predicts the completion time of putting a frame
+into each slot from a per-worker EMA of observed frame times, and solves the
+frame->slot min-cost assignment with the JAX auction kernel
+(tpu_render_cluster/ops/assignment.py). Assignments are issued as the same
+``request_frame-queue_add`` RPCs the reference strategies use, so workers
+can't tell the schedulers apart.
+
+When the pending pool runs dry it degrades to dynamic-strategy stealing
+(reference semantics: master/src/cluster/strategies.rs:250-405), which also
+covers the cold-start case where no frame-time history exists yet.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import TYPE_CHECKING, Sequence
+
+import numpy as np
+
+from tpu_render_cluster.jobs.models import (
+    BlenderJob,
+    DynamicStrategyOptions,
+    TpuBatchStrategyOptions,
+)
+from tpu_render_cluster.master.state import ClusterManagerState
+from tpu_render_cluster.master.strategies import (
+    find_busiest_worker_and_frame_to_steal,
+    steal_frame,
+)
+from tpu_render_cluster.utils.cancellation import CancellationToken
+
+if TYPE_CHECKING:
+    from tpu_render_cluster.master.worker_handle import WorkerHandle
+
+logger = logging.getLogger(__name__)
+
+TPU_BATCH_TICK = 0.1
+DEFAULT_FRAME_TIME_GUESS = 5.0  # seconds, until history arrives
+
+
+class WorkerCostModel:
+    """Per-worker EMA frame-time predictor fed by finished events."""
+
+    def __init__(self, alpha: float) -> None:
+        self.alpha = alpha
+        self._ema: dict[int, float] = {}
+
+    def observe(self, worker_id: int, frame_seconds: float) -> None:
+        previous = self._ema.get(worker_id)
+        if previous is None:
+            self._ema[worker_id] = frame_seconds
+        else:
+            self._ema[worker_id] = (
+                self.alpha * frame_seconds + (1 - self.alpha) * previous
+            )
+
+    def predict(self, worker_id: int) -> float:
+        if self._ema:
+            default = float(np.median(list(self._ema.values())))
+        else:
+            default = DEFAULT_FRAME_TIME_GUESS
+        return self._ema.get(worker_id, default)
+
+
+def build_cost_matrix(
+    frames: Sequence[int],
+    slots: Sequence[tuple["WorkerHandle", int]],
+    cost_model: WorkerCostModel,
+    *,
+    frame_complexity: dict[int, float] | None = None,
+) -> np.ndarray:
+    """cost[i, j] = predicted completion time of frame i in slot j.
+
+    A slot is (worker, position-in-queue): completion = (current queue length
+    + position + 1) * predicted frame time on that worker, scaled by the
+    frame's complexity factor when a per-frame predictor is available.
+    """
+    cost = np.zeros((len(frames), len(slots)), dtype=np.float32)
+    slot_base = np.array(
+        [
+            (len(worker.queue) + position + 1) * cost_model.predict(worker.worker_id)
+            for worker, position in slots
+        ],
+        dtype=np.float32,
+    )
+    for i, frame_index in enumerate(frames):
+        scale = 1.0
+        if frame_complexity is not None:
+            scale = frame_complexity.get(frame_index, 1.0)
+        cost[i] = slot_base * scale
+    return cost
+
+
+def _as_dynamic_options(options: TpuBatchStrategyOptions) -> DynamicStrategyOptions:
+    return DynamicStrategyOptions(
+        target_queue_size=options.target_queue_size,
+        min_queue_size_to_steal=options.min_queue_size_to_steal,
+        min_seconds_before_resteal_to_elsewhere=options.min_seconds_before_resteal_to_elsewhere,
+        min_seconds_before_resteal_to_original_worker=options.min_seconds_before_resteal_to_original_worker,
+    )
+
+
+async def tpu_batch_strategy(
+    job: BlenderJob,
+    state: ClusterManagerState,
+    workers_fn,
+    cancellation: CancellationToken,
+    options: TpuBatchStrategyOptions,
+) -> None:
+    from tpu_render_cluster.ops.assignment import solve_assignment
+
+    cost_model = WorkerCostModel(options.cost_ema_alpha)
+    dynamic_options = _as_dynamic_options(options)
+    observed_frames: set[tuple[int, int]] = set()
+
+    while not cancellation.is_cancelled():
+        if state.all_frames_finished():
+            return
+        workers = [w for w in workers_fn() if not w.is_dead]
+        if not workers:
+            await asyncio.sleep(TPU_BATCH_TICK)
+            continue
+
+        # Feed the cost model with fresh completions.
+        for worker in workers:
+            for frame_index, seconds in worker.drain_completion_observations():
+                key = (worker.worker_id, frame_index)
+                if key not in observed_frames:
+                    observed_frames.add(key)
+                    cost_model.observe(worker.worker_id, seconds)
+
+        # Collect slots from queue deficits.
+        slots: list[tuple["WorkerHandle", int]] = []
+        for worker in workers:
+            deficit = options.target_queue_size - len(worker.queue)
+            for position in range(max(0, deficit)):
+                slots.append((worker, position))
+
+        if slots:
+            frames = state.pending_frames(limit=len(slots))
+            if frames:
+                cost = build_cost_matrix(frames, slots, cost_model)
+                assignment = solve_assignment(cost)
+                # Claim frames synchronously, then issue the add-RPCs
+                # concurrently (the reference queues serially in the tick
+                # loop; batching the RPCs keeps tick latency flat as the
+                # cluster grows).
+                async def assign(frame_index: int, worker: "WorkerHandle") -> None:
+                    try:
+                        await worker.queue_frame(job, frame_index)
+                    except Exception as e:  # noqa: BLE001
+                        logger.warning(
+                            "tpu-batch: failed to queue frame %d on %08x: %s",
+                            frame_index,
+                            worker.worker_id,
+                            e,
+                        )
+                        state.return_frame_to_pending(frame_index)
+
+                tasks = []
+                for i, frame_index in enumerate(frames):
+                    worker, _position = slots[int(assignment[i])]
+                    state.mark_frame_as_queued(frame_index, worker.worker_id, time.time())
+                    tasks.append(assign(frame_index, worker))
+                await asyncio.gather(*tasks)
+                await asyncio.sleep(TPU_BATCH_TICK)
+                continue
+
+            # Pending pool dry -> steal like the dynamic strategy.
+            workers_sorted = sorted(workers, key=lambda w: len(w.queue))
+            for thief in workers_sorted:
+                if len(thief.queue) >= options.target_queue_size:
+                    continue
+                found = find_busiest_worker_and_frame_to_steal(
+                    thief, workers_sorted, dynamic_options
+                )
+                if found is None:
+                    break
+                victim, frame = found
+                await steal_frame(job, state, thief, victim, frame.frame_index)
+
+        await asyncio.sleep(TPU_BATCH_TICK)

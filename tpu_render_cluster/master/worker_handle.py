@@ -1,0 +1,265 @@
+"""Per-worker façade on the master.
+
+Aggregates the logical (reconnectable) connection, sender, router, queue
+mirror, heartbeat task, and incoming-event handling — the asyncio
+re-expression of the reference's ``Worker`` struct
+(master/src/connection/mod.rs:36-423). Public surface:
+``queue_frame`` / ``unqueue_frame`` (RPC + mirror/state sync),
+``finish_job_and_get_trace`` (600 s timeout RPC —
+master/src/connection/requester.rs:97), and ``maintain_heartbeat``
+(10 s ping interval — master/src/connection/mod.rs:36-37).
+
+Improvements over the reference (SURVEY.md §7 "known bugs to fix"):
+an errored finished-event returns the frame to the pending pool instead of
+hanging the job, and a heartbeat failure triggers worker eviction via the
+``on_dead`` callback instead of leaving frames assigned to a ghost.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Awaitable, Callable
+
+from tpu_render_cluster.jobs.models import BlenderJob
+from tpu_render_cluster.master.queue_mirror import FrameOnWorker, WorkerQueueMirror
+from tpu_render_cluster.master.state import ClusterManagerState
+from tpu_render_cluster.protocol import messages as pm
+from tpu_render_cluster.transport.actors import MessageRouter, SenderHandle, request_response
+from tpu_render_cluster.transport.reconnect import ReconnectableServerConnection
+from tpu_render_cluster.utils.logging import WorkerLogger
+
+HEARTBEAT_INTERVAL_SECONDS = 10.0  # reference: master/src/connection/mod.rs:36
+HEARTBEAT_RESPONSE_TIMEOUT = 60.0  # reference: master/src/connection/receiver.rs:27
+JOB_FINISH_TRACE_TIMEOUT = 600.0  # reference: master/src/connection/requester.rs:97
+
+
+class WorkerHandle:
+    """One connected worker, as seen by the master."""
+
+    def __init__(
+        self,
+        worker_id: int,
+        connection: ReconnectableServerConnection,
+        state: ClusterManagerState,
+        *,
+        on_dead: Callable[["WorkerHandle", str], Awaitable[None]] | None = None,
+    ) -> None:
+        self.worker_id = worker_id
+        self.connection = connection
+        self.state = state
+        self.queue = WorkerQueueMirror()
+        self.frames_stolen_count = 0
+        self.is_dead = False
+        # Observed per-frame render durations (for scheduler cost models).
+        self._rendering_started_at: dict[int, float] = {}
+        self._completion_observations: list[tuple[int, float]] = []
+        self._on_dead = on_dead
+        self.logger = WorkerLogger(
+            logging.getLogger("master.worker"),
+            pm.worker_id_to_string(worker_id),
+            connection.last_known_address,
+        )
+
+        self.sender = SenderHandle(self._send_message)
+        self.router = MessageRouter(self._receive_message)
+        self._heartbeat_task: asyncio.Task | None = None
+        self._events_task: asyncio.Task | None = None
+        self._tasks_started = False
+
+    # -- transport adapters -------------------------------------------------
+
+    async def _send_message(self, message: pm.Message) -> None:
+        await self.connection.send_text(pm.encode_message(message))
+
+    async def _receive_message(self) -> pm.Message:
+        return pm.decode_message(await self.connection.receive_text())
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        """Spawn sender/receiver/heartbeat/event tasks."""
+        assert not self._tasks_started
+        self._tasks_started = True
+        self.sender.start()
+        self.router.start()
+        self._events_task = asyncio.create_task(
+            self._manage_incoming_events(), name=f"events-{self.worker_id:08x}"
+        )
+        self._heartbeat_task = asyncio.create_task(
+            self._maintain_heartbeat(), name=f"heartbeat-{self.worker_id:08x}"
+        )
+
+    def cancel_heartbeat(self) -> None:
+        if self._heartbeat_task is not None:
+            self._heartbeat_task.cancel()
+
+    async def shutdown(self) -> None:
+        self.cancel_heartbeat()
+        if self._events_task is not None:
+            self._events_task.cancel()
+        await self.router.stop()
+        await self.sender.stop()
+        self.connection.close()
+
+    async def _mark_dead(self, reason: str) -> None:
+        if self.is_dead:
+            return
+        self.is_dead = True
+        self.logger.warning("Worker marked dead: %s", reason)
+        if self._on_dead is not None:
+            await self._on_dead(self, reason)
+
+    # -- scheduling RPCs ----------------------------------------------------
+
+    async def queue_frame(
+        self,
+        job: BlenderJob,
+        frame_index: int,
+        *,
+        stolen_from: int | None = None,
+    ) -> None:
+        """RPC a frame onto this worker's queue; sync mirror + global state.
+
+        Reference: master/src/connection/mod.rs:139-168.
+        """
+        request = pm.MasterFrameQueueAddRequest.new(job, frame_index)
+        response = await request_response(
+            self.sender, self.router, request, pm.WorkerFrameQueueAddResponse
+        )
+        if response.result != pm.FRAME_QUEUE_ADD_RESULT_ADDED:
+            raise RuntimeError(
+                f"Worker rejected frame {frame_index}: {response.error_reason}"
+            )
+        now = time.time()
+        self.queue.add(
+            FrameOnWorker(frame_index, queued_at=now, stolen_from=stolen_from)
+        )
+        self.state.mark_frame_as_queued(
+            frame_index,
+            self.worker_id,
+            now,
+            stolen_from=stolen_from,
+            stolen_at=now if stolen_from is not None else None,
+        )
+
+    async def unqueue_frame(self, job_name: str, frame_index: int) -> str:
+        """RPC-remove a frame (the steal primitive); returns the result enum.
+
+        Tolerates the remove-vs-render races (``already-rendering`` /
+        ``already-finished`` — reference: strategies.rs:347-373 leaves those
+        to the caller).
+        """
+        request = pm.MasterFrameQueueRemoveRequest.new(job_name, frame_index)
+        response = await request_response(
+            self.sender, self.router, request, pm.WorkerFrameQueueRemoveResponse
+        )
+        if response.result == pm.FRAME_QUEUE_REMOVE_RESULT_REMOVED:
+            self.queue.remove(frame_index)
+        return response.result
+
+    def has_empty_queue(self) -> bool:
+        return len(self.queue) == 0
+
+    def drain_completion_observations(self) -> list[tuple[int, float]]:
+        """Take (frame_index, seconds) samples observed since the last call."""
+        observations, self._completion_observations = self._completion_observations, []
+        return observations
+
+    # -- job lifecycle RPCs --------------------------------------------------
+
+    async def send_job_started(self) -> None:
+        await self.sender.send_message(pm.MasterJobStartedEvent())
+
+    async def finish_job_and_get_trace(self):
+        """Request the worker's trace; 600 s budget for huge traces."""
+        request = pm.MasterJobFinishedRequest.new()
+        response = await request_response(
+            self.sender,
+            self.router,
+            request,
+            pm.WorkerJobFinishedResponse,
+            timeout=JOB_FINISH_TRACE_TIMEOUT,
+        )
+        return response.trace
+
+    # -- background loops ----------------------------------------------------
+
+    async def _manage_incoming_events(self) -> None:
+        """Apply rendering/finished events to the mirror + global state.
+
+        Reference: master/src/connection/mod.rs:240-326.
+        """
+        rendering_queue = self.router.subscribe(pm.WorkerFrameQueueItemRenderingEvent)
+        finished_queue = self.router.subscribe(pm.WorkerFrameQueueItemFinishedEvent)
+
+        async def handle_rendering() -> None:
+            while True:
+                event = await rendering_queue.get()
+                self.logger.debug("Frame %d started rendering.", event.frame_index)
+                self._rendering_started_at[event.frame_index] = time.time()
+                self.queue.set_rendering(event.frame_index)
+                self.state.mark_frame_as_rendering(event.frame_index, self.worker_id)
+
+        async def handle_finished() -> None:
+            while True:
+                event = await finished_queue.get()
+                frame_on_worker = self.queue.remove(event.frame_index)
+                if event.result == pm.FRAME_QUEUE_ITEM_FINISHED_OK:
+                    self.logger.debug("Frame %d finished.", event.frame_index)
+                    started = self._rendering_started_at.pop(event.frame_index, None)
+                    if started is None and frame_on_worker is not None:
+                        started = frame_on_worker.queued_at
+                    if started is not None:
+                        self._completion_observations.append(
+                            (event.frame_index, max(1e-4, time.time() - started))
+                        )
+                    self.state.mark_frame_as_finished(event.frame_index)
+                else:
+                    # Reference workers swallow render errors and the master
+                    # hangs (worker/src/rendering/queue.rs:169-174); we
+                    # reschedule the frame instead.
+                    self.logger.warning(
+                        "Frame %d errored on worker (%s); rescheduling.",
+                        event.frame_index,
+                        event.error_reason,
+                    )
+                    self.state.return_frame_to_pending(event.frame_index)
+
+        try:
+            async with asyncio.TaskGroup() as group:
+                group.create_task(handle_rendering())
+                group.create_task(handle_finished())
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 - loop death is a worker failure
+            await self._mark_dead(f"event loop failed: {e}")
+
+    async def _maintain_heartbeat(self) -> None:
+        """Ping every 10 s; a missed pong (60 s) marks the worker dead.
+
+        Reference: master/src/connection/mod.rs:327-423, except failure
+        triggers eviction instead of only killing the heartbeat task.
+        """
+        pong_queue = self.router.subscribe(pm.WorkerHeartbeatResponse)
+        try:
+            while True:
+                await asyncio.sleep(HEARTBEAT_INTERVAL_SECONDS)
+                request = pm.MasterHeartbeatRequest.new_now()
+                try:
+                    await self.sender.send_message(request)
+                    await self.router.wait_for_message(
+                        pm.WorkerHeartbeatResponse,
+                        timeout=HEARTBEAT_RESPONSE_TIMEOUT,
+                        queue=pong_queue,
+                    )
+                except (asyncio.TimeoutError, ConnectionError, Exception) as e:
+                    if isinstance(e, asyncio.CancelledError):
+                        raise
+                    await self._mark_dead(f"heartbeat failed: {e}")
+                    return
+        except asyncio.CancelledError:
+            raise
+        finally:
+            self.router.unsubscribe(pm.WorkerHeartbeatResponse, pong_queue)

@@ -1,0 +1,114 @@
+"""ctypes bindings for the C++ WebSocket codec (native/wscodec.cpp).
+
+Builds the shared library on first use (g++ -O2, cached next to the source)
+and degrades gracefully to the pure-Python codec when unavailable —
+``load_codec()`` returns None and callers keep their Python fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import subprocess
+import threading
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+_SOURCE = Path(__file__).resolve().parent.parent.parent / "native" / "wscodec.cpp"
+_LIBRARY = _SOURCE.parent / "libwscodec.so"
+
+_lock = threading.Lock()
+_codec: "NativeCodec | None" = None
+_load_attempted = False
+
+
+class NativeCodec:
+    def __init__(self, lib: ctypes.CDLL) -> None:
+        self._lib = lib
+        lib.trc_accept_key.restype = ctypes.c_size_t
+        lib.trc_accept_key.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+        ]
+        lib.trc_mask_payload.restype = None
+        lib.trc_mask_payload.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_char_p,
+        ]
+        lib.trc_encode_header.restype = ctypes.c_size_t
+        lib.trc_encode_header.argtypes = [
+            ctypes.c_uint8,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_uint64,
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+        ]
+
+    def accept_key(self, key: str) -> str:
+        out = ctypes.create_string_buffer(32)
+        written = self._lib.trc_accept_key(key.encode("ascii"), out, 32)
+        if written == 0:
+            raise ValueError("accept_key failed")
+        return out.value.decode("ascii")
+
+    def mask_payload(self, payload: bytes, mask: bytes) -> bytes:
+        buffer = ctypes.create_string_buffer(payload, len(payload))
+        self._lib.trc_mask_payload(buffer, len(payload), mask)
+        return buffer.raw
+
+    def encode_header(
+        self, opcode: int, fin: bool, masked: bool, payload_len: int, mask: bytes
+    ) -> bytes:
+        out = ctypes.create_string_buffer(14)
+        written = self._lib.trc_encode_header(
+            opcode, int(fin), int(masked), payload_len, mask or b"\0\0\0\0", out, 14
+        )
+        return out.raw[:written]
+
+
+def _build() -> bool:
+    if not _SOURCE.is_file():
+        return False
+    if _LIBRARY.is_file() and _LIBRARY.stat().st_mtime >= _SOURCE.stat().st_mtime:
+        return True
+    try:
+        subprocess.run(
+            [
+                "g++",
+                "-O2",
+                "-shared",
+                "-fPIC",
+                "-o",
+                str(_LIBRARY),
+                str(_SOURCE),
+            ],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except (subprocess.SubprocessError, OSError) as e:
+        logger.debug("Native codec build failed (%s); using Python codec.", e)
+        return False
+
+
+def load_codec() -> NativeCodec | None:
+    """The built codec, or None when the toolchain/source is unavailable."""
+    global _codec, _load_attempted
+    with _lock:
+        if _load_attempted:
+            return _codec
+        _load_attempted = True
+        if not _build():
+            return None
+        try:
+            _codec = NativeCodec(ctypes.CDLL(str(_LIBRARY)))
+        except OSError as e:
+            logger.debug("Native codec load failed: %s", e)
+            _codec = None
+        return _codec

@@ -1,0 +1,1 @@
+"""JAX/Pallas compute kernels: assignment solvers and render ops."""

@@ -1,0 +1,12 @@
+"""Device-mesh parallelism: tile/sample/frame sharding with XLA collectives.
+
+The reference scales by adding worker *processes* connected over WebSockets
+(its only parallelism is the task farm — SURVEY.md §2.7). This package adds
+the intra-worker dimension it never had: one worker drives an entire TPU
+slice through ``jax.sharding.Mesh`` + ``shard_map``, with XLA collectives
+(psum/all_gather over ICI) instead of socket traffic.
+"""
+
+from tpu_render_cluster.parallel.mesh import device_mesh, local_device_count
+
+__all__ = ["device_mesh", "local_device_count"]

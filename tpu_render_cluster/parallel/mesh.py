@@ -1,0 +1,31 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+
+
+def local_device_count() -> int:
+    return len(jax.devices())
+
+
+def device_mesh(
+    n_devices: int | None = None, *, axis_name: str = "d"
+) -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` devices (default: all).
+
+    Multi-host expansion: call ``jax.distributed.initialize()`` before this
+    and the mesh spans the global device set (DCN between hosts, ICI within
+    a slice) — same code path either way.
+    """
+    devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"Requested {n_devices} devices, have {len(devices)}."
+            )
+        devices = devices[:n_devices]
+    import numpy as np
+
+    return Mesh(np.array(devices), (axis_name,))

@@ -1,0 +1,167 @@
+"""Multi-device frame rendering via shard_map.
+
+Three sharding modes, mirroring how a multi-chip worker can split render
+work (the SP/DP analogs called for by SURVEY.md §2.7 / §5.7):
+
+- **tile**: the image's row dimension is sharded — each device renders a
+  horizontal band of the same frame (spatial decomposition; output is
+  jointly sharded, gathered on host read);
+- **spp**: every device renders the full frame with a decorrelated subset
+  of samples and the results are averaged with a ``psum`` over ICI
+  (sample decomposition — a true collective reduction);
+- **frames**: a batch of frames is sharded one-per-device (the task-farm
+  axis collapsed into the device mesh — highest throughput for animation).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_render_cluster.parallel.mesh import device_mesh
+from tpu_render_cluster.render.camera import scene_camera
+from tpu_render_cluster.render.integrator import render_tile
+from tpu_render_cluster.render.scene import build_scene
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    # check_vma=False: the integrator's scan carries start replicated and
+    # become device-varying when axis_index feeds the RNG — intended here.
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+    )
+
+
+def render_frame_sharded(
+    scene_name: str,
+    frame_index: int,
+    *,
+    width: int = 512,
+    height: int = 512,
+    samples: int = 8,
+    max_bounces: int = 4,
+    mode: str = "tile",
+    n_devices: int | None = None,
+) -> jnp.ndarray:
+    """Render one frame across the local mesh; returns [H, W, 3] linear."""
+    mesh = device_mesh(n_devices)
+    n = mesh.devices.size
+    scene = build_scene(scene_name, frame_index)
+    camera = scene_camera(scene_name, frame_index)
+    frame = jnp.asarray(frame_index, jnp.float32)
+
+    if mode == "tile":
+        if height % n != 0:
+            raise ValueError(f"height {height} not divisible by {n} devices.")
+        rows_per_device = height // n
+
+        def render_band(scene, camera, frame):
+            band_index = jax.lax.axis_index("d")
+            y0 = band_index * rows_per_device
+            return render_tile(
+                scene,
+                camera,
+                frame,
+                y0,
+                0,
+                width=width,
+                height=height,
+                tile_height=rows_per_device,
+                tile_width=width,
+                samples=samples,
+                max_bounces=max_bounces,
+            )
+
+        sharded = _shard_map(
+            render_band,
+            mesh=mesh,
+            in_specs=(P(), P(), P()),
+            out_specs=P("d", None, None),
+        )
+        return sharded(scene, camera, frame)
+
+    if mode == "spp":
+        if samples % n != 0:
+            raise ValueError(f"samples {samples} not divisible by {n} devices.")
+        samples_per_device = samples // n
+
+        def render_subset(scene, camera, frame):
+            device_index = jax.lax.axis_index("d")
+            # Decorrelate: fold the device index into the frame-derived seed
+            # by offsetting the y0 RNG ingredient with a device-unique tag.
+            image = render_tile(
+                scene,
+                camera,
+                frame,
+                0,
+                device_index * 131071,  # x0 only feeds the RNG here
+                width=width,
+                height=height,
+                tile_height=height,
+                tile_width=width,
+                samples=samples_per_device,
+                max_bounces=max_bounces,
+            )
+            return jax.lax.psum(image, "d") / n
+
+        sharded = _shard_map(
+            render_subset,
+            mesh=mesh,
+            in_specs=(P(), P(), P()),
+            out_specs=P(),
+        )
+        return sharded(scene, camera, frame)
+
+    raise ValueError(f"Unknown sharding mode: {mode!r}")
+
+
+def render_frames_batched(
+    scene_name: str,
+    frame_indices,
+    *,
+    width: int = 256,
+    height: int = 256,
+    samples: int = 4,
+    max_bounces: int = 4,
+    n_devices: int | None = None,
+) -> jnp.ndarray:
+    """Render a batch of frames, one shard of the batch per device.
+
+    The frame batch must be divisible by the device count. Scene build is
+    vmapped on device; the only host work is the final gather.
+    Returns [B, H, W, 3] linear radiance.
+    """
+    mesh = device_mesh(n_devices)
+    n = mesh.devices.size
+    frames = jnp.asarray(frame_indices, jnp.float32)
+    if frames.shape[0] % n != 0:
+        raise ValueError(f"Batch {frames.shape[0]} not divisible by {n} devices.")
+
+    def render_one(frame):
+        scene = build_scene(scene_name, frame)
+        camera = scene_camera(scene_name, frame)
+        return render_tile(
+            scene,
+            camera,
+            frame,
+            0,
+            0,
+            width=width,
+            height=height,
+            tile_height=height,
+            tile_width=width,
+            samples=samples,
+            max_bounces=max_bounces,
+        )
+
+    batch_sharding = NamedSharding(mesh, P("d"))
+
+    @functools.partial(jax.jit, out_shardings=batch_sharding)
+    def render_batch(frames):
+        frames = jax.lax.with_sharding_constraint(frames, batch_sharding)
+        return jax.vmap(render_one)(frames)
+
+    return render_batch(frames)

@@ -1,0 +1,30 @@
+"""The `tpu-raytrace` render engine: a pure-JAX path tracer.
+
+This is the compute plane that has no counterpart in the reference (which
+shells out to Blender); it exists so the render farm's work can execute on
+TPU. Design is TPU-first:
+
+- scenes are structure-of-arrays with static shapes (`scene.py`), built as
+  pure functions of the frame index so whole frame *batches* vmap;
+- intersection is a rays x spheres batch computed with matmul-shaped
+  contractions that XLA tiles onto the MXU (`geometry.py`), with a Pallas
+  kernel variant for the hot loop (`pallas_kernels.py`);
+- the integrator uses `lax.scan` over bounces with masked lanes instead of
+  data-dependent control flow (`integrator.py`);
+- multi-device execution shards tiles or samples over a
+  `jax.sharding.Mesh` via `shard_map` with XLA collectives
+  (tpu_render_cluster/parallel/).
+"""
+
+from tpu_render_cluster.render.scene import Scene, build_scene
+from tpu_render_cluster.render.camera import camera_rays, scene_camera
+from tpu_render_cluster.render.integrator import render_frame, render_tile
+
+__all__ = [
+    "Scene",
+    "build_scene",
+    "camera_rays",
+    "scene_camera",
+    "render_frame",
+    "render_tile",
+]

@@ -1,0 +1,54 @@
+"""Standalone render CLI: render one frame of a procedural scene to a file.
+
+Usage:
+  python -m tpu_render_cluster.render.cli --scene 04_very-simple --frame 1 \
+      --width 256 --height 256 --samples 4 --out /tmp/frame.png
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="trc-render")
+    parser.add_argument("--scene", default="04_very-simple")
+    parser.add_argument("--frame", type=int, default=1)
+    parser.add_argument("--width", type=int, default=512)
+    parser.add_argument("--height", type=int, default=512)
+    parser.add_argument("--samples", type=int, default=8)
+    parser.add_argument("--bounces", type=int, default=4)
+    parser.add_argument("--out", required=True)
+    args = parser.parse_args(argv)
+
+    import numpy as np
+
+    from tpu_render_cluster.render.image_io import write_image
+    from tpu_render_cluster.render.integrator import render_frame, tonemap
+
+    t0 = time.time()
+    linear = render_frame(
+        args.scene,
+        args.frame,
+        width=args.width,
+        height=args.height,
+        samples=args.samples,
+        max_bounces=args.bounces,
+    )
+    linear.block_until_ready()
+    render_seconds = time.time() - t0
+    path = Path(args.out)
+    write_image(path, np.asarray(tonemap(linear)), path.suffix.lstrip(".").upper() or "PNG")
+    print(
+        f"Rendered {args.scene} frame {args.frame} "
+        f"({args.width}x{args.height}, {args.samples} spp) "
+        f"in {render_seconds:.2f} s -> {path}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

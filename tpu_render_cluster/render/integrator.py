@@ -1,0 +1,240 @@
+"""Path-tracing integrator: `lax.scan` over bounces, masked lanes.
+
+TPU-first structure: no data-dependent control flow — every ray marches the
+same fixed bounce count with an ``alive`` mask (dead lanes contribute
+nothing); samples-per-pixel is a second ``lax.scan``; RNG is counter-based
+(``jax.random.fold_in``) so any (frame, sample, pixel) is reproducible
+without sequential state, which is what lets frames/tiles be rendered in any
+order on any device.
+
+Lighting: sun next-event-estimation (shadow ray per bounce) + emissive
+spheres + sky on escape. Cosine-weighted hemisphere sampling.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from tpu_render_cluster.render.camera import Camera, camera_rays, scene_camera
+from tpu_render_cluster.render.geometry import (
+    EPS,
+    INF,
+    checker_albedo,
+    intersect_scene,
+    occluded,
+    sky_color,
+)
+from tpu_render_cluster.render.scene import Scene, build_scene
+
+
+def _cosine_sample_hemisphere(normals, key):
+    """Cosine-weighted directions about unit normals [R, 3]."""
+    u1, u2 = jax.random.uniform(key, (2,) + normals.shape[:1])
+    r = jnp.sqrt(u1)
+    phi = 2.0 * jnp.pi * u2
+    x = r * jnp.cos(phi)
+    y = r * jnp.sin(phi)
+    z = jnp.sqrt(jnp.maximum(0.0, 1.0 - u1))
+    # Build a tangent frame per normal.
+    helper = jnp.where(
+        jnp.abs(normals[:, 0:1]) > 0.9,
+        jnp.array([0.0, 1.0, 0.0])[None, :],
+        jnp.array([1.0, 0.0, 0.0])[None, :],
+    )
+    tangent = jnp.cross(helper, normals)
+    tangent = tangent / jnp.linalg.norm(tangent, axis=-1, keepdims=True)
+    bitangent = jnp.cross(normals, tangent)
+    return (
+        x[:, None] * tangent + y[:, None] * bitangent + z[:, None] * normals
+    )
+
+
+def _shade_bounce(scene: Scene, carry, key):
+    origins, directions, throughput, radiance, alive = carry
+    t, sphere_index, is_plane = intersect_scene(scene, origins, directions)
+    hit = t < INF
+
+    # Escaped rays pick up the sky and die.
+    sky = sky_color(scene, directions)
+    radiance = radiance + throughput * sky * (alive & ~hit)[:, None]
+
+    alive = alive & hit
+    points = origins + directions * t[:, None]
+    sphere_normals = (points - scene.centers[sphere_index]) / jnp.maximum(
+        scene.radii[sphere_index][:, None], 1e-6
+    )
+    plane_normal = jnp.array([0.0, 1.0, 0.0], jnp.float32)
+    normals = jnp.where(is_plane[:, None], plane_normal[None, :], sphere_normals)
+
+    albedo = jnp.where(
+        is_plane[:, None],
+        checker_albedo(scene, points),
+        scene.albedo[sphere_index],
+    )
+    emission = jnp.where(
+        is_plane[:, None],
+        jnp.zeros((1, 3), jnp.float32),
+        scene.emission[sphere_index],
+    )
+    radiance = radiance + throughput * emission * alive[:, None]
+
+    # Sun next-event estimation (delta light -> single shadow ray).
+    cos_sun = jnp.maximum(normals @ scene.sun_direction, 0.0)
+    shadow_origin = points + normals * EPS * 4.0
+    sun_dir = jnp.broadcast_to(scene.sun_direction, normals.shape)
+    in_shadow = occluded(scene, shadow_origin, sun_dir, jnp.full(t.shape, INF))
+    direct = (
+        albedo
+        * scene.sun_color[None, :]
+        * (cos_sun * (~in_shadow) * alive)[:, None]
+        / jnp.pi
+    )
+    radiance = radiance + throughput * direct
+
+    # Continue the path: cosine sample (BRDF/pi * cos / pdf == albedo).
+    throughput = throughput * jnp.where(alive[:, None], albedo, 1.0)
+    new_directions = _cosine_sample_hemisphere(normals, key)
+    new_origins = points + normals * EPS * 4.0
+    origins = jnp.where(alive[:, None], new_origins, origins)
+    directions = jnp.where(alive[:, None], new_directions, directions)
+    return (origins, directions, throughput, radiance, alive)
+
+
+def trace_paths(
+    scene: Scene, origins, directions, key, *, max_bounces: int = 4
+) -> jnp.ndarray:
+    """Trace one sample per ray; returns radiance [R, 3]."""
+    n = origins.shape[0]
+    carry = (
+        origins,
+        directions,
+        jnp.ones((n, 3), jnp.float32),
+        jnp.zeros((n, 3), jnp.float32),
+        jnp.ones((n,), bool),
+    )
+    keys = jax.random.split(key, max_bounces)
+
+    def step(carry, bounce_key):
+        return _shade_bounce(scene, carry, bounce_key), None
+
+    (_, _, _, radiance, _), _ = jax.lax.scan(step, carry, keys)
+    return radiance
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("width", "height", "tile_height", "tile_width", "samples", "max_bounces"),
+)
+def render_tile(
+    scene: Scene,
+    camera: Camera,
+    frame: jnp.ndarray,
+    y0,
+    x0,
+    *,
+    width: int,
+    height: int,
+    tile_height: int,
+    tile_width: int,
+    samples: int = 8,
+    max_bounces: int = 4,
+) -> jnp.ndarray:
+    """Render a tile; returns [tile_height, tile_width, 3] linear radiance.
+
+    The RNG key derives from (frame, y0, x0, sample) so any tile of any
+    frame renders identically regardless of device/order.
+    """
+    n = tile_height * tile_width
+    base_key = jax.random.fold_in(
+        jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(917), frame.astype(jnp.int32)),
+            jnp.asarray(y0, jnp.int32),
+        ),
+        jnp.asarray(x0, jnp.int32),
+    )
+
+    def sample_step(accumulated, sample_index):
+        key = jax.random.fold_in(base_key, sample_index)
+        jitter_key, trace_key = jax.random.split(key)
+        jitter = jax.random.uniform(jitter_key, (n, 2))
+        origins, directions = camera_rays(
+            camera,
+            width,
+            height,
+            y0=y0,
+            x0=x0,
+            tile_height=tile_height,
+            tile_width=tile_width,
+            jitter=jitter,
+        )
+        radiance = trace_paths(
+            scene, origins, directions, trace_key, max_bounces=max_bounces
+        )
+        return accumulated + radiance, None
+
+    accumulated, _ = jax.lax.scan(
+        sample_step, jnp.zeros((n, 3), jnp.float32), jnp.arange(samples)
+    )
+    image = accumulated / samples
+    return image.reshape(tile_height, tile_width, 3)
+
+
+def render_frame(
+    scene_name: str,
+    frame_index: int,
+    *,
+    width: int = 512,
+    height: int = 512,
+    samples: int = 8,
+    max_bounces: int = 4,
+    tile_size: int | None = None,
+) -> jnp.ndarray:
+    """Render a full frame on the default device; returns [H, W, 3] linear."""
+    scene = build_scene(scene_name, frame_index)
+    camera = scene_camera(scene_name, frame_index)
+    frame = jnp.asarray(frame_index, jnp.float32)
+    if tile_size is None:
+        return render_tile(
+            scene,
+            camera,
+            frame,
+            0,
+            0,
+            width=width,
+            height=height,
+            tile_height=height,
+            tile_width=width,
+            samples=samples,
+            max_bounces=max_bounces,
+        )
+    rows = []
+    for y0 in range(0, height, tile_size):
+        row = []
+        for x0 in range(0, width, tile_size):
+            row.append(
+                render_tile(
+                    scene,
+                    camera,
+                    frame,
+                    y0,
+                    x0,
+                    width=width,
+                    height=height,
+                    tile_height=min(tile_size, height - y0),
+                    tile_width=min(tile_size, width - x0),
+                    samples=samples,
+                    max_bounces=max_bounces,
+                )
+            )
+        rows.append(jnp.concatenate(row, axis=1))
+    return jnp.concatenate(rows, axis=0)
+
+
+def tonemap(image: jnp.ndarray) -> jnp.ndarray:
+    """Linear -> display: Reinhard + gamma 2.2, uint8."""
+    mapped = image / (1.0 + image)
+    srgb = jnp.power(jnp.clip(mapped, 0.0, 1.0), 1.0 / 2.2)
+    return (srgb * 255.0 + 0.5).astype(jnp.uint8)

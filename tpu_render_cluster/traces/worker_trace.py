@@ -10,7 +10,7 @@ analysis/core/models.py:46-131).
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any
 
 

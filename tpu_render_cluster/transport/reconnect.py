@@ -1,0 +1,210 @@
+"""Reconnection semantics for both sides of the cluster link.
+
+The reference treats a connection as a *logical* entity that survives socket
+death: the worker actively reconnects with exponential backoff (base 2.0,
+30 s cap, max 12 retries — worker/src/connection/mod.rs:360-398,475-487) and
+re-handshakes with ``handshake_type=reconnecting``; the master passively
+accepts the reconnect handshake and swaps the new socket into the existing
+connection object while in-flight send/receive calls wait for the swap
+(master/src/cluster/mod.rs:45-231,453-477).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable
+
+from tpu_render_cluster.transport.ws import (
+    WebSocketClosed,
+    WebSocketConnection,
+    websocket_connect,
+)
+
+logger = logging.getLogger(__name__)
+
+# Reference: worker/src/connection/mod.rs:360-398,475-487.
+BACKOFF_BASE = 2.0
+BACKOFF_CAP_SECONDS = 30.0
+MAX_CONNECT_RETRIES = 12
+# Reference: worker/src/connection/mod.rs:133-274 (per-op reconnect budget).
+MAX_RECONNECTS_PER_OP = 2
+OP_DEADLINE_SECONDS = 30.0
+
+
+async def connect_with_exponential_backoff(
+    host: str,
+    port: int,
+    *,
+    max_retries: int = MAX_CONNECT_RETRIES,
+    base: float = BACKOFF_BASE,
+    cap_seconds: float = BACKOFF_CAP_SECONDS,
+) -> WebSocketConnection:
+    """TCP connect + WS upgrade with exponential backoff."""
+    last_error: Exception | None = None
+    for attempt in range(max_retries + 1):
+        try:
+            return await websocket_connect(host, port)
+        except (WebSocketClosed, OSError) as e:
+            last_error = e
+            if attempt == max_retries:
+                break
+            delay = min(base**attempt, cap_seconds)
+            logger.debug(
+                "Connect attempt %d/%d to %s:%d failed (%s); retrying in %.1f s",
+                attempt + 1, max_retries, host, port, e, delay,
+            )
+            await asyncio.sleep(delay)
+    raise WebSocketClosed(
+        f"Could not connect to {host}:{port} after {max_retries} retries: {last_error}"
+    )
+
+
+class ReconnectingClient:
+    """Worker-side logical connection with transparent reconnect.
+
+    ``reconnect_fn`` re-establishes the socket AND replays the application
+    handshake (with ``handshake_type=reconnecting``); it returns the new
+    ``WebSocketConnection``. Send/receive transparently retry through at
+    most ``MAX_RECONNECTS_PER_OP`` reconnects within a 30 s op deadline,
+    recording each outage window via ``on_reconnect(lost_at, restored_at)``.
+    """
+
+    def __init__(
+        self,
+        connection: WebSocketConnection,
+        reconnect_fn: Callable[[], Awaitable[WebSocketConnection]],
+        *,
+        on_reconnect: Callable[[float, float], None] | None = None,
+    ) -> None:
+        self._connection = connection
+        self._reconnect_fn = reconnect_fn
+        self._on_reconnect = on_reconnect
+        self._reconnect_lock = asyncio.Lock()
+        self._generation = 0
+        self._closed = False
+
+    @property
+    def connection(self) -> WebSocketConnection:
+        return self._connection
+
+    def close(self) -> None:
+        self._closed = True
+        self._connection.abort()
+
+    async def _reconnect(self, failed_generation: int) -> None:
+        """Re-establish the socket once (deduplicated across concurrent ops)."""
+        import time
+
+        async with self._reconnect_lock:
+            if self._generation != failed_generation:
+                return  # another task already reconnected
+            if self._closed:
+                raise WebSocketClosed("Client is closed.")
+            lost_at = time.time()
+            self._connection.abort()
+            self._connection = await self._reconnect_fn()
+            self._generation += 1
+            if self._on_reconnect is not None:
+                self._on_reconnect(lost_at, time.time())
+            logger.info("Reconnected to master (generation %d).", self._generation)
+
+    async def _with_retries(self, op: Callable[[WebSocketConnection], Awaitable]):
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + OP_DEADLINE_SECONDS
+        reconnects = 0
+        while True:
+            connection = self._connection
+            generation = self._generation
+            try:
+                return await op(connection)
+            except WebSocketClosed:
+                if self._closed:
+                    raise
+                reconnects += 1
+                if reconnects > MAX_RECONNECTS_PER_OP or loop.time() > deadline:
+                    raise
+                await self._reconnect(generation)
+
+    async def send_text(self, text: str) -> None:
+        await self._with_retries(lambda c: c.send_text(text))
+
+    async def receive_text(self) -> str:
+        return await self._with_retries(lambda c: c.receive_text())
+
+
+class ReconnectableServerConnection:
+    """Master-side logical connection surviving socket swaps.
+
+    Send/receive operations block while the status is Disconnected and
+    resume when the accept loop swaps a fresh socket in via
+    ``replace_inner_connection`` (reference: master/src/cluster/mod.rs:61-231).
+    """
+
+    MAX_WAIT_FOR_RECONNECT = 30.0
+
+    def __init__(self, connection: WebSocketConnection) -> None:
+        self._connection = connection
+        self._connected = asyncio.Event()
+        self._connected.set()
+        self._closed = False
+        self.last_known_address = connection.peer_address()
+
+    @property
+    def is_connected(self) -> bool:
+        return self._connected.is_set()
+
+    def close(self) -> None:
+        self._closed = True
+        self._connected.set()  # release waiters; they'll observe _closed
+        self._connection.abort()
+
+    def replace_inner_connection(self, connection: WebSocketConnection) -> None:
+        """Swap a freshly-handshaked socket into this logical connection."""
+        self._connection.abort()
+        self._connection = connection
+        self.last_known_address = connection.peer_address()
+        self._connected.set()
+
+    def _mark_disconnected(self) -> None:
+        if not self._closed:
+            self._connected.clear()
+
+    async def _await_connection(self) -> WebSocketConnection:
+        if self._closed:
+            raise WebSocketClosed("Connection is closed.")
+        if not self._connected.is_set():
+            try:
+                await asyncio.wait_for(
+                    self._connected.wait(), self.MAX_WAIT_FOR_RECONNECT
+                )
+            except asyncio.TimeoutError:
+                raise WebSocketClosed(
+                    "Worker did not reconnect within the wait window."
+                ) from None
+            if self._closed:
+                raise WebSocketClosed("Connection is closed.")
+        return self._connection
+
+    async def send_text(self, text: str) -> None:
+        while True:
+            connection = await self._await_connection()
+            try:
+                await connection.send_text(text)
+                return
+            except WebSocketClosed:
+                if self._connection is connection:
+                    self._mark_disconnected()
+                if self._closed:
+                    raise
+
+    async def receive_text(self) -> str:
+        while True:
+            connection = await self._await_connection()
+            try:
+                return await connection.receive_text()
+            except WebSocketClosed:
+                if self._connection is connection:
+                    self._mark_disconnected()
+                if self._closed:
+                    raise

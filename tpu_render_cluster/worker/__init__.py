@@ -1,0 +1,3 @@
+from tpu_render_cluster.worker.runtime import Worker
+
+__all__ = ["Worker"]

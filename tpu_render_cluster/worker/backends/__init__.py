@@ -1,0 +1,31 @@
+"""Pluggable render backends.
+
+``blender`` reproduces the reference's subprocess + stdout-scrape contract
+(reference: worker/src/rendering/runner/); ``tpu-raytrace`` is the pure
+JAX/Pallas path tracer (new, the north-star backend); ``mock`` is the
+sleep-based fake renderer used by integration tests (SURVEY.md §4 test
+strategy). All emit identical 7-phase ``FrameRenderTime`` traces.
+"""
+
+from __future__ import annotations
+
+from tpu_render_cluster.worker.backends.base import RenderBackend
+
+
+def create_backend(name: str, **kwargs) -> RenderBackend:
+    if name == "blender":
+        from tpu_render_cluster.worker.backends.blender import BlenderBackend
+
+        return BlenderBackend(**kwargs)
+    if name == "tpu-raytrace":
+        from tpu_render_cluster.worker.backends.tpu_raytrace import TpuRaytraceBackend
+
+        return TpuRaytraceBackend(**kwargs)
+    if name == "mock":
+        from tpu_render_cluster.worker.backends.mock import MockBackend
+
+        return MockBackend(**kwargs)
+    raise ValueError(f"Unknown render backend: {name!r}")
+
+
+__all__ = ["RenderBackend", "create_backend"]

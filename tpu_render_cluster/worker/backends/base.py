@@ -1,0 +1,22 @@
+"""Render backend interface."""
+
+from __future__ import annotations
+
+import abc
+
+from tpu_render_cluster.jobs.models import BlenderJob
+from tpu_render_cluster.traces.worker_trace import FrameRenderTime
+
+
+class RenderBackend(abc.ABC):
+    """Renders one frame of a job and reports 7-phase timing.
+
+    Implementations must write the output file to the job's resolved output
+    directory and return a ``FrameRenderTime`` whose phases satisfy the
+    performance reducer's monotonicity requirements
+    (tpu_render_cluster/traces/performance.py).
+    """
+
+    @abc.abstractmethod
+    async def render_frame(self, job: BlenderJob, frame_index: int) -> FrameRenderTime:
+        ...

@@ -1,0 +1,178 @@
+"""Blender subprocess render backend.
+
+Byte-compatible with the reference worker's runner contract
+(reference: worker/src/rendering/runner/mod.rs:18-204):
+
+- CLI: ``blender <file> --background --python <render-script> --
+  --render-output <dir/name-format> --render-format <fmt>
+  --render-frame <n>`` with shlex-split prepend/append injection;
+- stdout scrape (reference: worker/src/rendering/runner/utilities.rs:105-203):
+  after the ``Saved: '`` line, a `` Time: mm:ss.ff (Saving: mm:ss.ff)`` line
+  yields the save duration and a ``RESULTS={json}`` line from the timing
+  script yields loaded/render-start/render-end unix timestamps; the save
+  duration is subtracted from render-end to get the true render finish.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import re
+import shlex
+import time
+from dataclasses import dataclass
+from pathlib import Path
+
+from tpu_render_cluster.jobs.models import BlenderJob
+from tpu_render_cluster.traces.worker_trace import FrameRenderTime
+from tpu_render_cluster.utils.paths import parse_with_base_directory_prefix
+from tpu_render_cluster.worker.backends.base import RenderBackend
+
+_TIME_SAVING_RE = re.compile(
+    r"Time: (?P<total_time>\d+:\d+\.\d+) \(Saving: (?P<saving_time>\d+:\d+\.\d+)\)"
+)
+_RESULTS_PREFIX = "RESULTS="
+
+
+def parse_blender_human_time(text: str) -> float:
+    """Parse Blender's ``mm:ss.ff`` duration into seconds."""
+    minutes, _, seconds = text.partition(":")
+    return int(minutes) * 60 + float(seconds)
+
+
+@dataclass(frozen=True)
+class PartialRenderStatistics:
+    loaded_at: float
+    started_rendering_at: float
+    finished_rendering_at: float
+    file_saving_started_at: float
+    file_saving_finished_at: float
+
+    def with_process_information(
+        self, process_started_at: float, process_exited_at: float
+    ) -> FrameRenderTime:
+        return FrameRenderTime(
+            started_process_at=process_started_at,
+            finished_loading_at=self.loaded_at,
+            started_rendering_at=self.started_rendering_at,
+            finished_rendering_at=self.finished_rendering_at,
+            file_saving_started_at=self.file_saving_started_at,
+            file_saving_finished_at=self.file_saving_finished_at,
+            exited_process_at=process_exited_at,
+        )
+
+
+def extract_blender_render_information(stdout_output: str) -> PartialRenderStatistics:
+    """Scrape phase timings from Blender's stdout (see module docstring)."""
+    import json
+
+    saving_time: float | None = None
+    raw_results: dict | None = None
+
+    lines = iter(stdout_output.splitlines())
+    # Skip until the `Saved: '<path>'` line; nothing relevant precedes it.
+    for line in lines:
+        if line.startswith("Saved: '"):
+            break
+    else:
+        raise ValueError("Invalid Blender output: no \"Saved: '\" line found.")
+
+    for line in lines:
+        if line.startswith(" Time:"):
+            match = _TIME_SAVING_RE.search(line)
+            if match is None:
+                continue
+            if saving_time is not None:
+                raise ValueError(
+                    "Invalid Blender output: Time/Saving line appears more than once."
+                )
+            saving_time = parse_blender_human_time(match.group("saving_time"))
+        elif line.startswith(_RESULTS_PREFIX):
+            raw_results = json.loads(line[len(_RESULTS_PREFIX):])
+
+    if raw_results is None or saving_time is None:
+        raise ValueError(
+            f"Invalid Blender output: missing data "
+            f"(results={raw_results is not None}, saving_time={saving_time})."
+        )
+
+    loaded_at = float(raw_results["project_loaded_at"])
+    started_rendering_at = float(raw_results["project_started_rendering_at"])
+    finished_with_saving = float(raw_results["project_finished_rendering_at"])
+    # The script's render-end includes file saving; subtract it out.
+    real_finished_rendering_at = finished_with_saving - saving_time
+
+    return PartialRenderStatistics(
+        loaded_at=loaded_at,
+        started_rendering_at=started_rendering_at,
+        finished_rendering_at=real_finished_rendering_at,
+        file_saving_started_at=real_finished_rendering_at,
+        file_saving_finished_at=finished_with_saving,
+    )
+
+
+class BlenderBackend(RenderBackend):
+    """Runs Blender with the render-timing script and scrapes its stdout."""
+
+    def __init__(
+        self,
+        *,
+        blender_binary: str,
+        base_directory: str | Path | None = None,
+        prepend_arguments: str | None = None,
+        append_arguments: str | None = None,
+    ) -> None:
+        self.blender_binary = blender_binary
+        self.base_directory = Path(base_directory) if base_directory else None
+        self.prepend_arguments = shlex.split(prepend_arguments) if prepend_arguments else []
+        self.append_arguments = shlex.split(append_arguments) if append_arguments else []
+
+    def _resolve(self, path: str) -> Path:
+        return parse_with_base_directory_prefix(path, self.base_directory)
+
+    def build_command(self, job: BlenderJob, frame_index: int) -> list[str]:
+        project_file = self._resolve(job.project_file_path)
+        render_script = self._resolve(job.render_script_path)
+        output_directory = self._resolve(job.output_directory_path)
+        render_output = output_directory / job.output_file_name_format
+        return [
+            self.blender_binary,
+            *self.prepend_arguments,
+            str(project_file),
+            "--background",
+            "--python",
+            str(render_script),
+            "--",
+            "--render-output",
+            str(render_output),
+            "--render-format",
+            job.output_file_format,
+            "--render-frame",
+            str(frame_index),
+            *self.append_arguments,
+        ]
+
+    async def render_frame(self, job: BlenderJob, frame_index: int) -> FrameRenderTime:
+        project_file = self._resolve(job.project_file_path)
+        render_script = self._resolve(job.render_script_path)
+        if not project_file.is_file():
+            raise FileNotFoundError(f"Project file not found: {project_file}")
+        if not render_script.is_file():
+            raise FileNotFoundError(f"Render script not found: {render_script}")
+        output_directory = self._resolve(job.output_directory_path)
+        output_directory.mkdir(parents=True, exist_ok=True)
+
+        command = self.build_command(job, frame_index)
+        process_started_at = time.time()
+        process = await asyncio.create_subprocess_exec(
+            *command,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.DEVNULL,
+        )
+        stdout, _ = await process.communicate()
+        process_exited_at = time.time()
+        if process.returncode != 0:
+            raise RuntimeError(
+                f"Blender exited with code {process.returncode} for frame {frame_index}."
+            )
+        statistics = extract_blender_render_information(stdout.decode("utf-8", "replace"))
+        return statistics.with_process_information(process_started_at, process_exited_at)

@@ -1,0 +1,55 @@
+"""Sleep-based fake renderer for integration tests.
+
+Fills the role of the in-process fake worker recommended by SURVEY.md §4:
+exercising strategies, steal races, reconnects, and trace collection with
+zero Blender and zero TPU.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from tpu_render_cluster.jobs.models import BlenderJob
+from tpu_render_cluster.traces.worker_trace import FrameRenderTime
+from tpu_render_cluster.worker.backends.base import RenderBackend
+
+
+class MockBackend(RenderBackend):
+    def __init__(
+        self,
+        *,
+        load_seconds: float = 0.005,
+        render_seconds: float = 0.02,
+        save_seconds: float = 0.005,
+        fail_frames: set[int] | None = None,
+    ) -> None:
+        self.load_seconds = load_seconds
+        self.render_seconds = render_seconds
+        self.save_seconds = save_seconds
+        self.fail_frames = fail_frames or set()
+        self.rendered_frames: list[int] = []
+
+    async def render_frame(self, job: BlenderJob, frame_index: int) -> FrameRenderTime:
+        started_process = time.time()
+        await asyncio.sleep(self.load_seconds)
+        finished_loading = time.time()
+        if frame_index in self.fail_frames:
+            self.fail_frames.discard(frame_index)  # fail once, then succeed
+            raise RuntimeError(f"mock render failure for frame {frame_index}")
+        started_rendering = time.time()
+        await asyncio.sleep(self.render_seconds)
+        finished_rendering = time.time()
+        saving_started = time.time()
+        await asyncio.sleep(self.save_seconds)
+        saving_finished = time.time()
+        self.rendered_frames.append(frame_index)
+        return FrameRenderTime(
+            started_process_at=started_process,
+            finished_loading_at=finished_loading,
+            started_rendering_at=started_rendering,
+            finished_rendering_at=finished_rendering,
+            file_saving_started_at=saving_started,
+            file_saving_finished_at=saving_finished,
+            exited_process_at=time.time(),
+        )

@@ -1,0 +1,122 @@
+"""The `tpu-raytrace` render backend: pure-JAX path tracing on TPU.
+
+Drop-in replacement for the Blender subprocess backend behind the same
+``RenderBackend`` interface — it emits the identical 7-phase
+``FrameRenderTime`` so traces and the analysis suite cannot tell the
+backends apart (BASELINE.md north star). Phase mapping:
+
+- started_process/finished_loading: scene + camera build (host->device);
+- started/finished_rendering: device compute (block_until_ready fenced);
+- file_saving: tonemap + PNG/JPEG encode + write;
+- exited_process: after the output file hits disk.
+
+The heavy work runs in a thread (`asyncio.to_thread`) so heartbeats and
+queue RPCs stay responsive while a frame renders.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from pathlib import Path
+
+from tpu_render_cluster.jobs.models import BlenderJob
+from tpu_render_cluster.traces.worker_trace import FrameRenderTime
+from tpu_render_cluster.utils.paths import parse_with_base_directory_prefix
+from tpu_render_cluster.worker.backends.base import RenderBackend
+
+
+class TpuRaytraceBackend(RenderBackend):
+    def __init__(
+        self,
+        *,
+        base_directory: str | Path | None = None,
+        width: int = 512,
+        height: int = 512,
+        samples: int = 8,
+        max_bounces: int = 4,
+        tile_size: int | None = None,
+        sharding: str | None = None,
+    ) -> None:
+        self.base_directory = Path(base_directory) if base_directory else None
+        self.width = width
+        self.height = height
+        self.samples = samples
+        self.max_bounces = max_bounces
+        self.tile_size = tile_size
+        # None = single device; "tile" / "spp" shard across the local mesh
+        # (tpu_render_cluster/parallel/sharded_render.py).
+        self.sharding = sharding
+
+    async def render_frame(self, job: BlenderJob, frame_index: int) -> FrameRenderTime:
+        return await asyncio.to_thread(self._render_sync, job, frame_index)
+
+    def _render_sync(self, job: BlenderJob, frame_index: int) -> FrameRenderTime:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tpu_render_cluster.render.camera import scene_camera
+        from tpu_render_cluster.render.image_io import output_path_for_frame, write_image
+        from tpu_render_cluster.render.integrator import render_frame, tonemap
+        from tpu_render_cluster.render.scene import build_scene, scene_for_job_name
+
+        started_process_at = time.time()
+
+        scene_name = scene_for_job_name(job.job_name)
+        # Build scene/camera eagerly so "loading" is observable, mirroring
+        # Blender's .blend load phase.
+        scene = build_scene(scene_name, frame_index)
+        camera = scene_camera(scene_name, frame_index)
+        for leaf in (*scene, *camera):
+            leaf.block_until_ready()
+        finished_loading_at = time.time()
+
+        started_rendering_at = time.time()
+        if self.sharding in ("tile", "spp"):
+            from tpu_render_cluster.parallel.sharded_render import render_frame_sharded
+
+            linear = render_frame_sharded(
+                scene_name,
+                frame_index,
+                width=self.width,
+                height=self.height,
+                samples=self.samples,
+                max_bounces=self.max_bounces,
+                mode=self.sharding,
+            )
+        else:
+            linear = render_frame(
+                scene_name,
+                frame_index,
+                width=self.width,
+                height=self.height,
+                samples=self.samples,
+                max_bounces=self.max_bounces,
+                tile_size=self.tile_size,
+            )
+        linear.block_until_ready()
+        finished_rendering_at = time.time()
+
+        file_saving_started_at = time.time()
+        pixels = np.asarray(tonemap(linear))
+        output_directory = parse_with_base_directory_prefix(
+            job.output_directory_path, self.base_directory
+        )
+        path = output_path_for_frame(
+            output_directory,
+            job.output_file_name_format,
+            job.output_file_format,
+            frame_index,
+        )
+        write_image(path, pixels, job.output_file_format)
+        file_saving_finished_at = time.time()
+
+        return FrameRenderTime(
+            started_process_at=started_process_at,
+            finished_loading_at=finished_loading_at,
+            started_rendering_at=started_rendering_at,
+            finished_rendering_at=finished_rendering_at,
+            file_saving_started_at=file_saving_started_at,
+            file_saving_finished_at=file_saving_finished_at,
+            exited_process_at=time.time(),
+        )

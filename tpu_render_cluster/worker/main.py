@@ -1,0 +1,63 @@
+"""Worker CLI entry point.
+
+Flag surface matches the reference's clap parser (reference:
+worker/src/cli.rs:5-45): ``worker --masterServerHost H --masterServerPort P
+--baseDirectory D --blenderBinary B [-p prependArgs] [-a appendArgs]
+[--logFilePath F]`` — plus the new ``--backend`` selector
+(``blender`` | ``tpu-raytrace`` | ``mock``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+from tpu_render_cluster.utils.logging import initialize_console_and_file_logging
+from tpu_render_cluster.worker.backends import create_backend
+from tpu_render_cluster.worker.runtime import Worker
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="trc-worker", description="Render cluster worker")
+    parser.add_argument("--masterServerHost", dest="master_host", required=True)
+    parser.add_argument("--masterServerPort", dest="master_port", type=int, required=True)
+    parser.add_argument("--baseDirectory", dest="base_directory", required=True)
+    parser.add_argument("--blenderBinary", dest="blender_binary", default="blender")
+    parser.add_argument("-p", "--blenderPrependArguments", dest="prepend_arguments", default=None)
+    parser.add_argument("-a", "--blenderAppendArguments", dest="append_arguments", default=None)
+    parser.add_argument("--logFilePath", dest="log_file_path", default=None)
+    parser.add_argument(
+        "--backend",
+        choices=["blender", "tpu-raytrace", "mock"],
+        default="blender",
+        help="Render backend (default: blender, matching the reference).",
+    )
+    return parser
+
+
+def make_backend(args: argparse.Namespace):
+    if args.backend == "blender":
+        return create_backend(
+            "blender",
+            blender_binary=args.blender_binary,
+            base_directory=args.base_directory,
+            prepend_arguments=args.prepend_arguments,
+            append_arguments=args.append_arguments,
+        )
+    if args.backend == "tpu-raytrace":
+        return create_backend("tpu-raytrace", base_directory=args.base_directory)
+    return create_backend("mock")
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    initialize_console_and_file_logging(args.log_file_path)
+    backend = make_backend(args)
+    worker = Worker(args.master_host, args.master_port, backend)
+    asyncio.run(worker.connect_and_run_to_job_completion())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

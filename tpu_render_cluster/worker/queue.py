@@ -1,0 +1,144 @@
+"""Worker-side automatic render queue.
+
+Reference: ``WorkerAutomaticQueue`` (worker/src/rendering/queue.rs:16-230) —
+a 100 ms poll loop takes the first Queued frame, marks it Rendering, renders
+one frame at a time, then emits the finished event and pops it.
+
+Two deliberate deviations (reference bugs fixed — SURVEY.md §7):
+- the ``event_frame-queue_item-started-rendering`` event IS emitted (the
+  reference defines and handles it but never sends it, §3.3);
+- a render failure emits ``event_frame-queue_item-finished`` with
+  ``errored`` instead of silently dropping the frame (which would hang the
+  reference master forever — worker/src/rendering/queue.rs:169-174).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import logging
+from dataclasses import dataclass
+
+from tpu_render_cluster.jobs.models import BlenderJob
+from tpu_render_cluster.protocol import messages as pm
+from tpu_render_cluster.transport.actors import SenderHandle
+from tpu_render_cluster.traces.worker_trace import WorkerTraceBuilder
+from tpu_render_cluster.utils.cancellation import CancellationToken
+from tpu_render_cluster.worker.backends.base import RenderBackend
+
+logger = logging.getLogger(__name__)
+
+QUEUE_POLL_SECONDS = 0.1  # reference: worker/src/rendering/queue.rs:74-96
+
+
+class FrameState(enum.Enum):
+    QUEUED = "queued"
+    RENDERING = "rendering"
+    FINISHED = "finished"
+
+
+@dataclass
+class QueuedFrame:
+    job: BlenderJob
+    frame_index: int
+    state: FrameState = FrameState.QUEUED
+
+
+class WorkerAutomaticQueue:
+    """Serial render queue polled every 100 ms."""
+
+    def __init__(
+        self,
+        backend: RenderBackend,
+        sender: SenderHandle,
+        tracer: WorkerTraceBuilder,
+        cancellation: CancellationToken,
+    ) -> None:
+        self._backend = backend
+        self._sender = sender
+        self._tracer = tracer
+        self._cancellation = cancellation
+        self._frames: list[QueuedFrame] = []
+        self._finished_indices: set[tuple[str, int]] = set()
+        self._task: asyncio.Task | None = None
+
+    # -- queue interface (called from the message manager) -------------------
+
+    def queue_frame(self, job: BlenderJob, frame_index: int) -> None:
+        self._frames.append(QueuedFrame(job, frame_index))
+
+    def unqueue_frame(self, job_name: str, frame_index: int) -> str:
+        """Returns the frame-queue-remove result enum wire value.
+
+        Reference: worker/src/rendering/queue.rs:192-229.
+        """
+        if (job_name, frame_index) in self._finished_indices:
+            return pm.FRAME_QUEUE_REMOVE_RESULT_ALREADY_FINISHED
+        for i, frame in enumerate(self._frames):
+            if frame.job.job_name == job_name and frame.frame_index == frame_index:
+                if frame.state is FrameState.RENDERING:
+                    return pm.FRAME_QUEUE_REMOVE_RESULT_ALREADY_RENDERING
+                if frame.state is FrameState.FINISHED:
+                    return pm.FRAME_QUEUE_REMOVE_RESULT_ALREADY_FINISHED
+                del self._frames[i]
+                return pm.FRAME_QUEUE_REMOVE_RESULT_REMOVED
+        return pm.FRAME_QUEUE_REMOVE_RESULT_ERRORED
+
+    def queue_size(self) -> int:
+        return len(self._frames)
+
+    # -- render loop ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._run(), name="render-queue")
+
+    async def join(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    def _next_queued(self) -> QueuedFrame | None:
+        for frame in self._frames:
+            if frame.state is FrameState.QUEUED:
+                return frame
+        return None
+
+    async def _run(self) -> None:
+        while not self._cancellation.is_cancelled():
+            frame = self._next_queued()
+            if frame is None:
+                await asyncio.sleep(QUEUE_POLL_SECONDS)
+                continue
+            await self._render_frame_and_report(frame)
+
+    async def _render_frame_and_report(self, frame: QueuedFrame) -> None:
+        frame.state = FrameState.RENDERING
+        job_name = frame.job.job_name
+        await self._sender.send_message(
+            pm.WorkerFrameQueueItemRenderingEvent(job_name, frame.frame_index)
+        )
+        try:
+            timing = await self._backend.render_frame(frame.job, frame.frame_index)
+        except Exception as e:  # noqa: BLE001 - report, don't hang the master
+            logger.error("Frame %d render failed: %s", frame.frame_index, e)
+            self._remove(frame)
+            self._finished_indices.add((job_name, frame.frame_index))
+            await self._sender.send_message(
+                pm.WorkerFrameQueueItemFinishedEvent.new_errored(
+                    job_name, frame.frame_index, str(e)
+                )
+            )
+            return
+        self._tracer.trace_new_rendered_frame(frame.frame_index, timing)
+        self._remove(frame)
+        self._finished_indices.add((job_name, frame.frame_index))
+        await self._sender.send_message(
+            pm.WorkerFrameQueueItemFinishedEvent.new_ok(job_name, frame.frame_index)
+        )
+
+    def _remove(self, frame: QueuedFrame) -> None:
+        if frame in self._frames:
+            self._frames.remove(frame)

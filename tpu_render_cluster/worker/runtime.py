@@ -1,0 +1,226 @@
+"""Worker runtime: reconnecting client + heartbeat responder + message manager.
+
+Reference: worker/src/connection/mod.rs:46-713. The worker connects with
+exponential backoff, performs the 3-step handshake (first-connection, or
+reconnecting after socket death), then runs three loops until the job
+finishes: the heartbeat responder (tracing every 8th ping —
+``TRACE_EVERY_NTH_PING`` at worker/src/connection/mod.rs:46), the message
+manager (queue add/remove, job started/finished), and the automatic render
+queue.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from tpu_render_cluster import PROTOCOL_VERSION
+from tpu_render_cluster.protocol import messages as pm
+from tpu_render_cluster.traces.worker_trace import WorkerTrace, WorkerTraceBuilder
+from tpu_render_cluster.transport.actors import MessageRouter, SenderHandle
+from tpu_render_cluster.transport.reconnect import (
+    ReconnectingClient,
+    connect_with_exponential_backoff,
+)
+from tpu_render_cluster.transport.ws import WebSocketClosed, WebSocketConnection
+from tpu_render_cluster.utils.cancellation import CancellationToken
+from tpu_render_cluster.worker.backends.base import RenderBackend
+from tpu_render_cluster.worker.queue import WorkerAutomaticQueue
+
+logger = logging.getLogger(__name__)
+
+TRACE_EVERY_NTH_PING = 8  # reference: worker/src/connection/mod.rs:46
+HANDSHAKE_TIMEOUT = 30.0
+
+
+async def _perform_handshake(
+    ws: WebSocketConnection, worker_id: int, *, is_reconnect: bool
+) -> None:
+    """Client side of the 3-step handshake.
+
+    Reference: worker/src/connection/mod.rs:402-454.
+    """
+    request = pm.decode_message(await ws.receive_text())
+    if not isinstance(request, pm.MasterHandshakeRequest):
+        raise WebSocketClosed(f"Expected handshake request, got {type(request)}")
+    handshake_type = (
+        pm.HANDSHAKE_TYPE_RECONNECTING if is_reconnect else pm.HANDSHAKE_TYPE_FIRST_CONNECTION
+    )
+    await ws.send_text(
+        pm.encode_message(
+            pm.WorkerHandshakeResponse(handshake_type, PROTOCOL_VERSION, worker_id)
+        )
+    )
+    ack = pm.decode_message(await ws.receive_text())
+    if not isinstance(ack, pm.MasterHandshakeAcknowledgement) or not ack.ok:
+        raise WebSocketClosed("Master refused the handshake.")
+
+
+class Worker:
+    """A single render node."""
+
+    def __init__(
+        self,
+        master_host: str,
+        master_port: int,
+        backend: RenderBackend,
+        *,
+        tracer: WorkerTraceBuilder | None = None,
+    ) -> None:
+        self.master_host = master_host
+        self.master_port = master_port
+        self.backend = backend
+        self.worker_id = pm.generate_worker_id()
+        self.tracer = tracer or WorkerTraceBuilder()
+        self.cancellation = CancellationToken()
+        self._client: ReconnectingClient | None = None
+        self._final_trace: WorkerTrace | None = None
+
+    async def connect_and_run_to_job_completion(self) -> WorkerTrace:
+        """Connect, serve the job protocol until job-finished, return the trace."""
+
+        async def fresh_connection(is_reconnect: bool) -> WebSocketConnection:
+            ws = await connect_with_exponential_backoff(
+                self.master_host, self.master_port
+            )
+            await asyncio.wait_for(
+                _perform_handshake(ws, self.worker_id, is_reconnect=is_reconnect),
+                HANDSHAKE_TIMEOUT,
+            )
+            return ws
+
+        first = await fresh_connection(False)
+        client = ReconnectingClient(
+            first,
+            lambda: fresh_connection(True),
+            on_reconnect=self.tracer.trace_new_reconnect,
+        )
+        self._client = client
+        logger.info(
+            "Worker %s connected to %s:%d",
+            pm.worker_id_to_string(self.worker_id),
+            self.master_host,
+            self.master_port,
+        )
+
+        sender = SenderHandle(lambda m: client.send_text(pm.encode_message(m)))
+        sender.start()
+
+        async def receive() -> pm.Message:
+            return pm.decode_message(await client.receive_text())
+
+        router = MessageRouter(receive)
+        router.start()
+
+        frame_queue = WorkerAutomaticQueue(
+            self.backend, sender, self.tracer, self.cancellation
+        )
+        frame_queue.start()
+
+        heartbeat_task = asyncio.create_task(
+            self._respond_to_heartbeats(router, sender), name="heartbeats"
+        )
+        try:
+            await self._manage_incoming_messages(router, sender, frame_queue)
+        finally:
+            self.cancellation.cancel()
+            heartbeat_task.cancel()
+            await frame_queue.join()
+            await router.stop()
+            await sender.stop()
+            client.close()
+        assert self._final_trace is not None
+        return self._final_trace
+
+    async def _respond_to_heartbeats(
+        self, router: MessageRouter, sender: SenderHandle
+    ) -> None:
+        """Answer pings; record every 8th as a ping trace.
+
+        Reference: worker/src/connection/mod.rs:503-599.
+        """
+        queue = router.subscribe(pm.MasterHeartbeatRequest)
+        ping_counter = 0
+        while True:
+            request = await queue.get()
+            received_at = time.time()
+            await sender.send_message(pm.WorkerHeartbeatResponse())
+            ping_counter += 1
+            if ping_counter % TRACE_EVERY_NTH_PING == 0:
+                self.tracer.trace_new_ping(request.request_time, received_at)
+
+    async def _manage_incoming_messages(
+        self,
+        router: MessageRouter,
+        sender: SenderHandle,
+        frame_queue: WorkerAutomaticQueue,
+    ) -> None:
+        """The select-loop over master requests/events.
+
+        Reference: worker/src/connection/mod.rs:601-713.
+        """
+        add_queue = router.subscribe(pm.MasterFrameQueueAddRequest)
+        remove_queue = router.subscribe(pm.MasterFrameQueueRemoveRequest)
+        started_queue = router.subscribe(pm.MasterJobStartedEvent)
+        finished_queue = router.subscribe(pm.MasterJobFinishedRequest)
+        job_done = asyncio.Event()
+
+        async def handle_adds() -> None:
+            while True:
+                request = await add_queue.get()
+                try:
+                    frame_queue.queue_frame(request.job, request.frame_index)
+                    self.tracer.increment_total_queued_frames()
+                    response = pm.WorkerFrameQueueAddResponse.new_ok(
+                        request.message_request_id
+                    )
+                except Exception as e:  # noqa: BLE001
+                    response = pm.WorkerFrameQueueAddResponse.new_errored(
+                        request.message_request_id, str(e)
+                    )
+                await sender.send_message(response)
+
+        async def handle_removes() -> None:
+            while True:
+                request = await remove_queue.get()
+                result = frame_queue.unqueue_frame(
+                    request.job_name, request.frame_index
+                )
+                if result == pm.FRAME_QUEUE_REMOVE_RESULT_REMOVED:
+                    self.tracer.increment_total_frames_removed_from_queue()
+                await sender.send_message(
+                    pm.WorkerFrameQueueRemoveResponse.new_with_result(
+                        request.message_request_id, result
+                    )
+                )
+
+        async def handle_job_started() -> None:
+            while True:
+                await started_queue.get()
+                logger.info("Job started.")
+                self.tracer.set_job_start_time(time.time())
+
+        async def handle_job_finished() -> None:
+            request = await finished_queue.get()
+            logger.info("Job finished; sending trace.")
+            self.tracer.set_job_finish_time(time.time())
+            trace = self.tracer.build()
+            self._final_trace = trace
+            await sender.send_message(
+                pm.WorkerJobFinishedResponse(request.message_request_id, trace)
+            )
+            job_done.set()
+
+        tasks = [
+            asyncio.create_task(handle_adds()),
+            asyncio.create_task(handle_removes()),
+            asyncio.create_task(handle_job_started()),
+            asyncio.create_task(handle_job_finished()),
+        ]
+        try:
+            await job_done.wait()
+        finally:
+            for task in tasks:
+                task.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
