@@ -8,6 +8,7 @@ variant of the same kernel lives in pallas_kernels.py.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from tpu_render_cluster.render.scene import Scene
@@ -24,6 +25,11 @@ def intersect_spheres(scene: Scene, origins, directions):
     Returns:
       (t [R], index [R] int32) — t = INF when no hit.
     """
+    # The barrier keeps XLA from fusing ray-producing broadcasts/iotas into
+    # the matmuls below: the v5e TpuPriorityFusionQueue cost model SIGILLs on
+    # that producer pattern (libtpu crash observed 2026-07; also materializes
+    # the rays once instead of recomputing them in all three contractions).
+    origins, directions = jax.lax.optimization_barrier((origins, directions))
     oc_dot_d = directions @ scene.centers.T - jnp.sum(
         directions * origins, axis=-1, keepdims=True
     )  # [R, N] = d . (c - o)
