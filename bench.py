@@ -75,6 +75,9 @@ def cpu_baseline_fps() -> float:
         return float(pinned)
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # The CPU baseline uses the pure-XLA path: Pallas interpret mode is a
+    # debugging path and would understate the baseline.
+    env["TRC_PALLAS"] = "0"
     # Keep the axon TPU plugin's sitecustomize out of the CPU probe: its
     # relay handshake can hang a process that never needs the TPU.
     env["PYTHONPATH"] = ""

@@ -15,8 +15,6 @@ work (the SP/DP analogs called for by SURVEY.md §2.7 / §5.7):
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -157,11 +155,14 @@ def render_frames_batched(
             max_bounces=max_bounces,
         )
 
+    # shard_map (not jit-level SPMD): the Pallas intersection kernel lowers
+    # to a Mosaic custom call the XLA partitioner cannot split, so each
+    # device must trace its own per-shard vmap.
     batch_sharding = NamedSharding(mesh, P("d"))
-
-    @functools.partial(jax.jit, out_shardings=batch_sharding)
-    def render_batch(frames):
-        frames = jax.lax.with_sharding_constraint(frames, batch_sharding)
-        return jax.vmap(render_one)(frames)
-
-    return render_batch(frames)
+    render_shard = _shard_map(
+        jax.vmap(render_one),
+        mesh=mesh,
+        in_specs=(P("d"),),
+        out_specs=P("d", None, None, None),
+    )
+    return jax.jit(render_shard)(jax.device_put(frames, batch_sharding))

@@ -25,6 +25,10 @@ def intersect_spheres(scene: Scene, origins, directions):
     Returns:
       (t [R], index [R] int32) — t = INF when no hit.
     """
+    from tpu_render_cluster.render import pallas_kernels
+
+    if pallas_kernels.pallas_enabled():
+        return pallas_kernels.intersect_spheres_pallas(scene, origins, directions)
     # The barrier keeps XLA from fusing ray-producing broadcasts/iotas into
     # the matmuls below: the v5e TpuPriorityFusionQueue cost model SIGILLs on
     # that producer pattern (libtpu crash observed 2026-07; also materializes

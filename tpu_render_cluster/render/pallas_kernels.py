@@ -1,0 +1,149 @@
+"""Pallas TPU kernel for the render engine's hot op: nearest-hit intersection.
+
+The path tracer spends its time in the rays x spheres intersection
+(reference analog: the per-frame render loop inside Blender that
+worker/src/rendering/runner/mod.rs shells out to; here the render engine is
+TPU-native so the hot loop is ours to own). The XLA version in
+``geometry.intersect_spheres`` materializes several [R, N] intermediates
+between HBM-level fusions; this kernel fuses quadratic solve, validity
+masking, and the min/argmin reduction into one VMEM-resident pass per ray
+block.
+
+Layout choices (see /opt/skills/guides/pallas_guide.md):
+- rays ride the *lane* axis (128-wide) as [3, BLOCK_R] blocks; the sphere
+  axis is the sublane axis, so the nearest-hit reduction is a sublane
+  reduction producing [1, BLOCK_R];
+- sphere data ([3, N] centers, [N, 1] radius^2 / |c|^2) is small enough to
+  sit whole in VMEM for every grid step;
+- the two contractions (d.c and o.c) are K=3 dot_generals on the MXU with
+  ``preferred_element_type=float32``.
+
+On non-TPU backends the kernel runs in interpret mode, so the same code
+path is exercised by CPU tests.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Plain Python floats: a jnp constant would be captured as a traced value,
+# which pallas_call rejects.
+INF = 1e30
+EPS = 1e-3
+
+BLOCK_R = 1024  # rays per grid step (8 f32 lane-tiles)
+_SUBLANE = 8  # f32 sublane tile; sphere count is padded to a multiple
+
+
+def pallas_enabled() -> bool:
+    """Whether intersect dispatches to the Pallas kernel.
+
+    Default: only on a real TPU backend (interpret mode is a debugging
+    path, much slower than XLA on CPU). ``TRC_PALLAS=1`` forces it on
+    anywhere (tests use this); ``TRC_PALLAS=0`` disables it.
+
+    Read at *trace* time: jitted callers bake the decision into their
+    compiled executable, so flipping the env var mid-process has no effect
+    on already-compiled functions (jax.clear_caches() to re-trace).
+    """
+    value = os.environ.get("TRC_PALLAS")
+    if value is None:
+        return jax.default_backend() == "tpu"
+    return value not in ("0", "false", "off")
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _nearest_hit_kernel(o_ref, d_ref, c_ref, r2_ref, csq_ref, t_ref, idx_ref):
+    """One ray block vs all spheres; writes min-t and argmin index."""
+    o = o_ref[:, :]  # [3, BR]
+    d = d_ref[:, :]  # [3, BR]
+    c = c_ref[:, :]  # [3, N]
+    contract_first = (((0,), (0,)), ((), ()))
+    # [N, BR] contractions on the MXU.
+    dc = jax.lax.dot_general(c, d, contract_first, preferred_element_type=jnp.float32)
+    oc = jax.lax.dot_general(c, o, contract_first, preferred_element_type=jnp.float32)
+    od = jnp.sum(o * d, axis=0, keepdims=True)  # [1, BR]
+    o_sq = jnp.sum(o * o, axis=0, keepdims=True)  # [1, BR]
+
+    r2 = r2_ref[:, :]  # [N, 1]
+    oc_dot_d = dc - od  # d . (c - o)
+    oc_sq = o_sq - 2.0 * oc + csq_ref[:, :]  # |o - c|^2
+    disc = oc_dot_d * oc_dot_d - (oc_sq - r2)
+    valid = (disc > 0.0) & (r2 > 0.0)
+    sqrt_disc = jnp.sqrt(jnp.maximum(disc, 0.0))
+    t0 = oc_dot_d - sqrt_disc
+    t1 = oc_dot_d + sqrt_disc
+    t = jnp.where(t0 > EPS, t0, jnp.where(t1 > EPS, t1, INF))
+    t = jnp.where(valid, t, INF)  # [N, BR]
+
+    n = t.shape[0]
+    t_min = jnp.min(t, axis=0, keepdims=True)  # [1, BR]
+    lanes = jax.lax.broadcasted_iota(jnp.int32, t.shape, 0)
+    # First index attaining the min (matches jnp.argmin tie-breaking).
+    idx = jnp.min(jnp.where(t == t_min, lanes, n), axis=0, keepdims=True)
+    t_ref[:, :] = t_min
+    idx_ref[:, :] = jnp.minimum(idx, n - 1)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _nearest_hit(origins, directions, centers, radii, *, interpret: bool):
+    rays = origins.shape[0]
+    padded_rays = -(-rays // BLOCK_R) * BLOCK_R
+    ray_pad = padded_rays - rays
+    o_t = jnp.pad(origins, ((0, ray_pad), (0, 0))).T  # [3, Rp]
+    d_t = jnp.pad(directions, ((0, ray_pad), (0, 0))).T  # [3, Rp]
+
+    n = centers.shape[0]
+    padded_n = -(-n // _SUBLANE) * _SUBLANE
+    sphere_pad = padded_n - n
+    c_t = jnp.pad(centers, ((0, sphere_pad), (0, 0))).T  # [3, Np]
+    radii = jnp.pad(radii, (0, sphere_pad))
+    r2 = (radii * radii)[:, None]  # [Np, 1]
+    csq = jnp.sum(c_t * c_t, axis=0)[:, None]  # [Np, 1]
+
+    grid = (padded_rays // BLOCK_R,)
+    t, idx = pl.pallas_call(
+        _nearest_hit_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((3, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, padded_n), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, padded_rays), jnp.float32),
+            jax.ShapeDtypeStruct((1, padded_rays), jnp.int32),
+        ],
+        interpret=interpret,
+    )(o_t, d_t, c_t, r2, csq)
+    return t[0, :rays], idx[0, :rays]
+
+
+def intersect_spheres_pallas(scene, origins, directions):
+    """Drop-in Pallas replacement for ``geometry.intersect_spheres``.
+
+    Returns (t [R] float32 with INF misses, index [R] int32).
+    """
+    # Padded ray slots (zero origin/direction) produce harmless garbage that
+    # the wrapper slices off; padded sphere slots have r2 == 0 -> never hit.
+    t, idx = _nearest_hit(
+        origins, directions, scene.centers, scene.radii, interpret=_interpret()
+    )
+    # Padded sphere indices can only appear for all-miss rays (t == INF);
+    # clamp into range like the jnp argmin would.
+    return t, jnp.minimum(idx, scene.centers.shape[0] - 1)
