@@ -1,0 +1,1541 @@
+// trc-worker: standalone C++ render-node daemon.
+//
+// Native counterpart of the reference's Rust `worker` crate
+// (reference: worker/src/ — CLI worker/src/cli.rs:5-45, runtime
+// worker/src/connection/mod.rs:46-713, render queue
+// worker/src/rendering/queue.rs:16-230, Blender runner
+// worker/src/rendering/runner/mod.rs:18-204). Speaks the same wire
+// protocol as the Python daemons (tpu_render_cluster/protocol/messages.py):
+// JSON text frames {"message_type": ..., "payload": {...}} over WebSocket.
+//
+// Build (linked with the codec):
+//   g++ -O2 -pthread -o native/trc-worker native/worker_daemon.cpp native/wscodec.cpp
+//
+// Backends:
+//   mock    - sleeps --mockRenderMs and writes a placeholder output file
+//   cli     - shells out to `python -m tpu_render_cluster.render.cli`
+//             (the TPU path tracer) and scrapes its RESULTS= line
+//   blender - runs `blender <file> --background --python <script> -- ...`
+//             exactly like the reference runner and scrapes RESULTS= +
+//             the " Time: mm:ss.ff (Saving: mm:ss.ff)" line
+//
+// Threading model: an IO thread owns the socket reads and all reconnects;
+// the render thread performs one frame at a time and retries sends through
+// reconnect windows. The reference's per-message-type broadcast channels
+// are a tokio idiom, not a protocol requirement — a single dispatch switch
+// has the same observable behavior.
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/time.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cctype>
+#include <cerrno>
+#include <chrono>
+#include <cmath>
+#include <condition_variable>
+#include <cstdarg>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <set>
+#include <string>
+#include <thread>
+#include <utility>
+#include <vector>
+
+extern "C" {
+size_t trc_accept_key(const char* key, char* out, size_t out_capacity);
+void trc_mask_payload(uint8_t* data, size_t len, const uint8_t mask[4]);
+size_t trc_encode_header(uint8_t opcode, int fin, int masked,
+                         uint64_t payload_len, const uint8_t mask[4],
+                         uint8_t* out, size_t out_capacity);
+int trc_parse_header(const uint8_t* buf, size_t len, uint8_t* opcode, int* fin,
+                     int* masked, uint64_t* payload_len, uint8_t mask_out[4]);
+}
+
+// ---------------------------------------------------------------------------
+// Small utilities
+
+static double now_ts() {
+    struct timeval tv;
+    gettimeofday(&tv, nullptr);
+    return double(tv.tv_sec) + double(tv.tv_usec) * 1e-6;
+}
+
+static FILE* g_log_file = nullptr;
+
+static void log_line(const char* level, const char* fmt, ...) {
+    char message[2048];
+    va_list args;
+    va_start(args, fmt);
+    vsnprintf(message, sizeof(message), fmt, args);
+    va_end(args);
+    char stamped[2304];
+    snprintf(stamped, sizeof(stamped), "%.3f [%s] trc-worker: %s\n", now_ts(),
+             level, message);
+    fputs(stamped, stderr);
+    if (g_log_file != nullptr) {
+        fputs(stamped, g_log_file);
+        fflush(g_log_file);
+    }
+}
+
+#define LOG_INFO(...) log_line("INFO", __VA_ARGS__)
+#define LOG_WARN(...) log_line("WARN", __VA_ARGS__)
+#define LOG_ERROR(...) log_line("ERROR", __VA_ARGS__)
+
+static std::mt19937_64& rng() {
+    static std::mt19937_64 engine(std::random_device{}());
+    return engine;
+}
+
+// ---------------------------------------------------------------------------
+// Minimal JSON (parse + serialise). Integers are kept exact: the protocol's
+// request ids are random u64s (shared/src/messages/utilities.rs:5-14) and
+// must be echoed back bit-perfect, which a double round-trip would corrupt.
+
+struct Json {
+    enum Type { NUL, BOOL, INT, UINT, DOUBLE, STR, ARR, OBJ };
+    Type type = NUL;
+    bool boolean = false;
+    int64_t integer = 0;
+    uint64_t uinteger = 0;
+    double number = 0.0;
+    std::string str;
+    std::vector<Json> arr;
+    std::vector<std::pair<std::string, Json>> obj;
+
+    static Json make_null() { return Json{}; }
+    static Json make_bool(bool v) {
+        Json j;
+        j.type = BOOL;
+        j.boolean = v;
+        return j;
+    }
+    static Json make_uint(uint64_t v) {
+        Json j;
+        j.type = UINT;
+        j.uinteger = v;
+        return j;
+    }
+    static Json make_int(int64_t v) {
+        Json j;
+        j.type = INT;
+        j.integer = v;
+        return j;
+    }
+    static Json make_double(double v) {
+        Json j;
+        j.type = DOUBLE;
+        j.number = v;
+        return j;
+    }
+    static Json make_string(std::string v) {
+        Json j;
+        j.type = STR;
+        j.str = std::move(v);
+        return j;
+    }
+    static Json make_object() {
+        Json j;
+        j.type = OBJ;
+        return j;
+    }
+    static Json make_array() {
+        Json j;
+        j.type = ARR;
+        return j;
+    }
+
+    void set(const std::string& key, Json value) {
+        for (auto& pair : obj) {
+            if (pair.first == key) {
+                pair.second = std::move(value);
+                return;
+            }
+        }
+        obj.emplace_back(key, std::move(value));
+    }
+
+    const Json* get(const std::string& key) const {
+        if (type != OBJ) return nullptr;
+        for (const auto& pair : obj) {
+            if (pair.first == key) return &pair.second;
+        }
+        return nullptr;
+    }
+
+    double as_double() const {
+        switch (type) {
+            case INT: return double(integer);
+            case UINT: return double(uinteger);
+            case DOUBLE: return number;
+            default: return 0.0;
+        }
+    }
+    uint64_t as_u64() const {
+        switch (type) {
+            case INT: return uint64_t(integer);
+            case UINT: return uinteger;
+            case DOUBLE: return uint64_t(number);
+            default: return 0;
+        }
+    }
+    int64_t as_i64() const {
+        switch (type) {
+            case INT: return integer;
+            case UINT: return int64_t(uinteger);
+            case DOUBLE: return int64_t(number);
+            default: return 0;
+        }
+    }
+    const std::string& as_string() const { return str; }
+};
+
+namespace jsonparse {
+
+struct Parser {
+    const char* p;
+    const char* end;
+    bool ok = true;
+
+    explicit Parser(const std::string& text)
+        : p(text.data()), end(text.data() + text.size()) {}
+
+    void skip_ws() {
+        while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+            p++;
+    }
+
+    bool consume(char c) {
+        skip_ws();
+        if (p < end && *p == c) {
+            p++;
+            return true;
+        }
+        return false;
+    }
+
+    Json parse_value() {
+        skip_ws();
+        if (p >= end) {
+            ok = false;
+            return Json::make_null();
+        }
+        char c = *p;
+        if (c == '{') return parse_object();
+        if (c == '[') return parse_array();
+        if (c == '"') return Json::make_string(parse_string());
+        if (c == 't' || c == 'f') return parse_bool();
+        if (c == 'n') {
+            if (end - p >= 4 && strncmp(p, "null", 4) == 0) {
+                p += 4;
+                return Json::make_null();
+            }
+            ok = false;
+            return Json::make_null();
+        }
+        return parse_number();
+    }
+
+    Json parse_bool() {
+        if (end - p >= 4 && strncmp(p, "true", 4) == 0) {
+            p += 4;
+            return Json::make_bool(true);
+        }
+        if (end - p >= 5 && strncmp(p, "false", 5) == 0) {
+            p += 5;
+            return Json::make_bool(false);
+        }
+        ok = false;
+        return Json::make_null();
+    }
+
+    std::string parse_string() {
+        std::string out;
+        if (!consume('"')) {
+            ok = false;
+            return out;
+        }
+        while (p < end && *p != '"') {
+            char c = *p++;
+            if (c != '\\') {
+                out.push_back(c);
+                continue;
+            }
+            if (p >= end) break;
+            char esc = *p++;
+            switch (esc) {
+                case '"': out.push_back('"'); break;
+                case '\\': out.push_back('\\'); break;
+                case '/': out.push_back('/'); break;
+                case 'b': out.push_back('\b'); break;
+                case 'f': out.push_back('\f'); break;
+                case 'n': out.push_back('\n'); break;
+                case 'r': out.push_back('\r'); break;
+                case 't': out.push_back('\t'); break;
+                case 'u': {
+                    if (end - p < 4) {
+                        ok = false;
+                        return out;
+                    }
+                    unsigned code = 0;
+                    for (int i = 0; i < 4; i++) {
+                        char h = *p++;
+                        code <<= 4;
+                        if (h >= '0' && h <= '9') code |= unsigned(h - '0');
+                        else if (h >= 'a' && h <= 'f') code |= unsigned(h - 'a' + 10);
+                        else if (h >= 'A' && h <= 'F') code |= unsigned(h - 'A' + 10);
+                        else {
+                            ok = false;
+                            return out;
+                        }
+                    }
+                    // UTF-8 encode (surrogate pairs folded to U+FFFD; the
+                    // protocol's strings are job names/paths — plain ASCII).
+                    if (code >= 0xD800 && code <= 0xDFFF) code = 0xFFFD;
+                    if (code < 0x80) {
+                        out.push_back(char(code));
+                    } else if (code < 0x800) {
+                        out.push_back(char(0xC0 | (code >> 6)));
+                        out.push_back(char(0x80 | (code & 0x3F)));
+                    } else {
+                        out.push_back(char(0xE0 | (code >> 12)));
+                        out.push_back(char(0x80 | ((code >> 6) & 0x3F)));
+                        out.push_back(char(0x80 | (code & 0x3F)));
+                    }
+                    break;
+                }
+                default:
+                    ok = false;
+                    return out;
+            }
+        }
+        if (!consume('"')) ok = false;
+        return out;
+    }
+
+    Json parse_number() {
+        const char* start = p;
+        bool negative = false;
+        bool is_double = false;
+        if (p < end && (*p == '-' || *p == '+')) {
+            negative = (*p == '-');
+            p++;
+        }
+        while (p < end &&
+               (isdigit(uint8_t(*p)) || *p == '.' || *p == 'e' || *p == 'E' ||
+                *p == '+' || *p == '-')) {
+            if (*p == '.' || *p == 'e' || *p == 'E') is_double = true;
+            p++;
+        }
+        std::string token(start, size_t(p - start));
+        if (token.empty()) {
+            ok = false;
+            return Json::make_null();
+        }
+        if (!is_double) {
+            errno = 0;
+            if (negative) {
+                int64_t v = strtoll(token.c_str(), nullptr, 10);
+                if (errno == 0) return Json::make_int(v);
+            } else {
+                uint64_t v = strtoull(token.c_str(), nullptr, 10);
+                if (errno == 0) return Json::make_uint(v);
+            }
+        }
+        return Json::make_double(strtod(token.c_str(), nullptr));
+    }
+
+    Json parse_array() {
+        Json out = Json::make_array();
+        consume('[');
+        skip_ws();
+        if (consume(']')) return out;
+        while (ok) {
+            out.arr.push_back(parse_value());
+            if (consume(']')) break;
+            if (!consume(',')) {
+                ok = false;
+                break;
+            }
+        }
+        return out;
+    }
+
+    Json parse_object() {
+        Json out = Json::make_object();
+        consume('{');
+        skip_ws();
+        if (consume('}')) return out;
+        while (ok) {
+            skip_ws();
+            std::string key = parse_string();
+            if (!ok || !consume(':')) {
+                ok = false;
+                break;
+            }
+            out.obj.emplace_back(std::move(key), parse_value());
+            if (consume('}')) break;
+            if (!consume(',')) {
+                ok = false;
+                break;
+            }
+        }
+        return out;
+    }
+};
+
+}  // namespace jsonparse
+
+static bool json_parse(const std::string& text, Json* out) {
+    jsonparse::Parser parser(text);
+    *out = parser.parse_value();
+    parser.skip_ws();
+    return parser.ok;
+}
+
+static void json_write(const Json& value, std::string* out) {
+    char buffer[64];
+    switch (value.type) {
+        case Json::NUL:
+            *out += "null";
+            break;
+        case Json::BOOL:
+            *out += value.boolean ? "true" : "false";
+            break;
+        case Json::INT:
+            snprintf(buffer, sizeof(buffer), "%lld", (long long)value.integer);
+            *out += buffer;
+            break;
+        case Json::UINT:
+            snprintf(buffer, sizeof(buffer), "%llu",
+                     (unsigned long long)value.uinteger);
+            *out += buffer;
+            break;
+        case Json::DOUBLE:
+            snprintf(buffer, sizeof(buffer), "%.17g", value.number);
+            *out += buffer;
+            break;
+        case Json::STR: {
+            *out += '"';
+            for (char c : value.str) {
+                switch (c) {
+                    case '"': *out += "\\\""; break;
+                    case '\\': *out += "\\\\"; break;
+                    case '\n': *out += "\\n"; break;
+                    case '\r': *out += "\\r"; break;
+                    case '\t': *out += "\\t"; break;
+                    default:
+                        if (uint8_t(c) < 0x20) {
+                            snprintf(buffer, sizeof(buffer), "\\u%04x", c);
+                            *out += buffer;
+                        } else {
+                            *out += c;
+                        }
+                }
+            }
+            *out += '"';
+            break;
+        }
+        case Json::ARR: {
+            *out += '[';
+            for (size_t i = 0; i < value.arr.size(); i++) {
+                if (i) *out += ',';
+                json_write(value.arr[i], out);
+            }
+            *out += ']';
+            break;
+        }
+        case Json::OBJ: {
+            *out += '{';
+            for (size_t i = 0; i < value.obj.size(); i++) {
+                if (i) *out += ',';
+                json_write(Json::make_string(value.obj[i].first), out);
+                *out += ':';
+                json_write(value.obj[i].second, out);
+            }
+            *out += '}';
+            break;
+        }
+    }
+}
+
+static std::string json_dumps(const Json& value) {
+    std::string out;
+    json_write(value, &out);
+    return out;
+}
+
+// ---------------------------------------------------------------------------
+// WebSocket client (RFC 6455 subset: text/ping/pong/close, client masking)
+
+class WsClient {
+  public:
+    ~WsClient() { close_socket(); }
+
+    bool connect_and_upgrade(const std::string& host, int port) {
+        close_socket();
+        struct addrinfo hints;
+        memset(&hints, 0, sizeof(hints));
+        hints.ai_family = AF_UNSPEC;
+        hints.ai_socktype = SOCK_STREAM;
+        char port_text[16];
+        snprintf(port_text, sizeof(port_text), "%d", port);
+        struct addrinfo* result = nullptr;
+        if (getaddrinfo(host.c_str(), port_text, &hints, &result) != 0) {
+            return false;
+        }
+        int sock = -1;
+        for (struct addrinfo* ai = result; ai != nullptr; ai = ai->ai_next) {
+            sock = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+            if (sock < 0) continue;
+            if (connect(sock, ai->ai_addr, ai->ai_addrlen) == 0) break;
+            ::close(sock);
+            sock = -1;
+        }
+        freeaddrinfo(result);
+        if (sock < 0) return false;
+        int one = 1;
+        setsockopt(sock, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        fd_ = sock;
+
+        // HTTP upgrade.
+        uint8_t key_bytes[16];
+        for (auto& b : key_bytes) b = uint8_t(rng()());
+        std::string key = base64(key_bytes, sizeof(key_bytes));
+        char request[512];
+        snprintf(request, sizeof(request),
+                 "GET / HTTP/1.1\r\n"
+                 "Host: %s:%d\r\n"
+                 "Upgrade: websocket\r\n"
+                 "Connection: Upgrade\r\n"
+                 "Sec-WebSocket-Key: %s\r\n"
+                 "Sec-WebSocket-Version: 13\r\n"
+                 "\r\n",
+                 host.c_str(), port, key.c_str());
+        if (!write_all(reinterpret_cast<const uint8_t*>(request),
+                       strlen(request))) {
+            close_socket();
+            return false;
+        }
+        std::string response;
+        if (!read_http_response(&response)) {
+            close_socket();
+            return false;
+        }
+        if (response.find(" 101 ") == std::string::npos) {
+            close_socket();
+            return false;
+        }
+        char expected[32];
+        if (trc_accept_key(key.c_str(), expected, sizeof(expected)) == 0 ||
+            response.find(expected) == std::string::npos) {
+            LOG_WARN("Sec-WebSocket-Accept mismatch.");
+            close_socket();
+            return false;
+        }
+        return true;
+    }
+
+    bool send_text(const std::string& payload) {
+        return send_frame(0x1, reinterpret_cast<const uint8_t*>(payload.data()),
+                          payload.size());
+    }
+
+    // Serializes all frame writes, including pongs sent from the read path
+    // while another thread is mid send_text.
+    std::mutex send_mutex_;
+
+    bool send_pong(const uint8_t* data, size_t len) {
+        return send_frame(0xA, data, len);
+    }
+
+    // Receives the next *message* (handles ping/pong/continuation inline).
+    // Returns false on socket error or close frame.
+    bool receive_text(std::string* out) {
+        std::string assembled;
+        bool in_fragmented = false;
+        for (;;) {
+            uint8_t opcode = 0;
+            int fin = 0;
+            std::string payload;
+            if (!receive_frame(&opcode, &fin, &payload)) return false;
+            switch (opcode) {
+                case 0x1:  // text
+                case 0x2:  // binary (treated as text; protocol is JSON text)
+                    if (fin) {
+                        *out = std::move(payload);
+                        return true;
+                    }
+                    assembled = std::move(payload);
+                    in_fragmented = true;
+                    break;
+                case 0x0:  // continuation
+                    if (!in_fragmented) return false;
+                    assembled += payload;
+                    if (fin) {
+                        *out = std::move(assembled);
+                        return true;
+                    }
+                    break;
+                case 0x8:  // close
+                    return false;
+                case 0x9:  // ping -> pong
+                    send_pong(reinterpret_cast<const uint8_t*>(payload.data()),
+                              payload.size());
+                    break;
+                case 0xA:  // pong: ignore
+                    break;
+                default:
+                    return false;
+            }
+        }
+    }
+
+    void shutdown_socket() {
+        if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
+    }
+
+    void close_socket() {
+        if (fd_ >= 0) {
+            ::close(fd_);
+            fd_ = -1;
+        }
+        buffer_.clear();
+    }
+
+    bool is_open() const { return fd_ >= 0; }
+
+  private:
+    int fd_ = -1;
+    std::string buffer_;
+
+    static std::string base64(const uint8_t* data, size_t len) {
+        static const char table[] =
+            "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+        std::string out;
+        size_t i = 0;
+        for (; i + 2 < len; i += 3) {
+            uint32_t chunk = (uint32_t(data[i]) << 16) |
+                             (uint32_t(data[i + 1]) << 8) | data[i + 2];
+            out += table[(chunk >> 18) & 63];
+            out += table[(chunk >> 12) & 63];
+            out += table[(chunk >> 6) & 63];
+            out += table[chunk & 63];
+        }
+        if (i < len) {
+            uint32_t chunk = uint32_t(data[i]) << 16;
+            bool two = i + 1 < len;
+            if (two) chunk |= uint32_t(data[i + 1]) << 8;
+            out += table[(chunk >> 18) & 63];
+            out += table[(chunk >> 12) & 63];
+            out += two ? table[(chunk >> 6) & 63] : '=';
+            out += '=';
+        }
+        return out;
+    }
+
+    bool write_all(const uint8_t* data, size_t len) {
+        size_t sent = 0;
+        while (sent < len) {
+            ssize_t n = ::send(fd_, data + sent, len - sent, MSG_NOSIGNAL);
+            if (n <= 0) {
+                if (n < 0 && (errno == EINTR)) continue;
+                return false;
+            }
+            sent += size_t(n);
+        }
+        return true;
+    }
+
+    bool read_http_response(std::string* out) {
+        out->clear();
+        char c;
+        while (out->size() < 16384) {
+            ssize_t n = ::recv(fd_, &c, 1, 0);
+            if (n <= 0) return false;
+            out->push_back(c);
+            if (out->size() >= 4 &&
+                out->compare(out->size() - 4, 4, "\r\n\r\n") == 0) {
+                return true;
+            }
+        }
+        return false;
+    }
+
+    bool fill_buffer(size_t needed) {
+        while (buffer_.size() < needed) {
+            uint8_t chunk[16384];
+            ssize_t n = ::recv(fd_, chunk, sizeof(chunk), 0);
+            if (n <= 0) {
+                if (n < 0 && errno == EINTR) continue;
+                return false;
+            }
+            buffer_.append(reinterpret_cast<char*>(chunk), size_t(n));
+        }
+        return true;
+    }
+
+    bool receive_frame(uint8_t* opcode, int* fin, std::string* payload) {
+        uint64_t payload_len = 0;
+        int masked = 0;
+        uint8_t mask[4];
+        int header_len = 0;
+        for (;;) {
+            header_len = trc_parse_header(
+                reinterpret_cast<const uint8_t*>(buffer_.data()),
+                buffer_.size(), opcode, fin, &masked, &payload_len, mask);
+            if (header_len < 0) return false;
+            if (header_len > 0) break;
+            if (!fill_buffer(buffer_.size() + 1)) return false;
+        }
+        if (payload_len > (256ull << 20)) return false;  // 256 MB limit (S12)
+        if (!fill_buffer(size_t(header_len) + size_t(payload_len))) return false;
+        payload->assign(buffer_, size_t(header_len), size_t(payload_len));
+        buffer_.erase(0, size_t(header_len) + size_t(payload_len));
+        if (masked) {
+            trc_mask_payload(reinterpret_cast<uint8_t*>(&(*payload)[0]),
+                             payload->size(), mask);
+        }
+        return true;
+    }
+
+    bool send_frame(uint8_t opcode, const uint8_t* data, size_t len) {
+        std::lock_guard<std::mutex> lock(send_mutex_);
+        if (fd_ < 0) return false;
+        uint8_t mask[4];
+        for (auto& b : mask) b = uint8_t(rng()());
+        uint8_t header[14];
+        size_t header_len =
+            trc_encode_header(opcode, 1, 1, len, mask, header, sizeof(header));
+        std::vector<uint8_t> frame(header_len + len);
+        memcpy(frame.data(), header, header_len);
+        memcpy(frame.data() + header_len, data, len);
+        trc_mask_payload(frame.data() + header_len, len, mask);
+        return write_all(frame.data(), frame.size());
+    }
+};
+
+// ---------------------------------------------------------------------------
+// Paths (reference: worker/src/utilities.rs:5-37)
+
+static std::string expand_path(const std::string& raw,
+                               const std::string& base_directory) {
+    std::string out = raw;
+    const std::string kBase = "%BASE%";
+    size_t at = out.find(kBase);
+    if (at != std::string::npos) {
+        out = out.substr(0, at) + base_directory + out.substr(at + kBase.size());
+    }
+    if (!out.empty() && out[0] == '~') {
+        const char* home = getenv("HOME");
+        if (home != nullptr) out = std::string(home) + out.substr(1);
+    }
+    return out;
+}
+
+static void make_directories(const std::string& path) {
+    std::string partial;
+    for (size_t i = 0; i < path.size(); i++) {
+        partial.push_back(path[i]);
+        if (path[i] == '/' || i + 1 == path.size()) {
+            if (partial != "/") mkdir(partial.c_str(), 0755);
+        }
+    }
+}
+
+static std::string format_frame_placeholders(const std::string& name_format,
+                                             int frame_index) {
+    size_t first = name_format.find('#');
+    if (first == std::string::npos) return name_format;
+    size_t count = 0;
+    while (first + count < name_format.size() && name_format[first + count] == '#')
+        count++;
+    char number[32];
+    snprintf(number, sizeof(number), "%0*d", int(count), frame_index);
+    return name_format.substr(0, first) + number +
+           name_format.substr(first + count);
+}
+
+static std::string lowercase(std::string s) {
+    for (auto& c : s) c = char(tolower(c));
+    return s;
+}
+
+// ---------------------------------------------------------------------------
+// Trace collection (schema: tpu_render_cluster/traces/worker_trace.py,
+// byte-compatible with shared/src/results/worker_trace.rs:103-126)
+
+struct FrameRenderTime {
+    double started_process_at = 0;
+    double finished_loading_at = 0;
+    double started_rendering_at = 0;
+    double finished_rendering_at = 0;
+    double file_saving_started_at = 0;
+    double file_saving_finished_at = 0;
+    double exited_process_at = 0;
+};
+
+struct TraceBuilder {
+    std::mutex mutex;
+    uint64_t total_queued_frames = 0;
+    uint64_t total_removed = 0;
+    double job_start_time = -1;
+    double job_finish_time = -1;
+    std::vector<std::pair<int, FrameRenderTime>> frames;
+    std::vector<std::pair<double, double>> pings;       // pinged_at, received_at
+    std::vector<std::pair<double, double>> reconnects;  // lost_at, reconnected_at
+
+    Json build() {
+        std::lock_guard<std::mutex> lock(mutex);
+        Json trace = Json::make_object();
+        trace.set("total_queued_frames", Json::make_uint(total_queued_frames));
+        trace.set("total_queued_frames_removed_from_queue",
+                  Json::make_uint(total_removed));
+        trace.set("job_start_time",
+                  Json::make_double(job_start_time < 0 ? now_ts() : job_start_time));
+        trace.set("job_finish_time",
+                  Json::make_double(job_finish_time < 0 ? now_ts() : job_finish_time));
+        Json frame_array = Json::make_array();
+        for (const auto& entry : frames) {
+            Json details = Json::make_object();
+            details.set("started_process_at",
+                        Json::make_double(entry.second.started_process_at));
+            details.set("finished_loading_at",
+                        Json::make_double(entry.second.finished_loading_at));
+            details.set("started_rendering_at",
+                        Json::make_double(entry.second.started_rendering_at));
+            details.set("finished_rendering_at",
+                        Json::make_double(entry.second.finished_rendering_at));
+            details.set("file_saving_started_at",
+                        Json::make_double(entry.second.file_saving_started_at));
+            details.set("file_saving_finished_at",
+                        Json::make_double(entry.second.file_saving_finished_at));
+            details.set("exited_process_at",
+                        Json::make_double(entry.second.exited_process_at));
+            Json frame = Json::make_object();
+            frame.set("frame_index", Json::make_int(entry.first));
+            frame.set("details", std::move(details));
+            frame_array.arr.push_back(std::move(frame));
+        }
+        trace.set("frame_render_traces", std::move(frame_array));
+        Json ping_array = Json::make_array();
+        for (const auto& entry : pings) {
+            Json ping = Json::make_object();
+            ping.set("pinged_at", Json::make_double(entry.first));
+            ping.set("received_at", Json::make_double(entry.second));
+            ping_array.arr.push_back(std::move(ping));
+        }
+        trace.set("ping_traces", std::move(ping_array));
+        Json reconnect_array = Json::make_array();
+        for (const auto& entry : reconnects) {
+            Json reconnect = Json::make_object();
+            reconnect.set("lost_connection_at", Json::make_double(entry.first));
+            reconnect.set("reconnected_at", Json::make_double(entry.second));
+            reconnect_array.arr.push_back(std::move(reconnect));
+        }
+        trace.set("reconnection_traces", std::move(reconnect_array));
+        return trace;
+    }
+};
+
+// ---------------------------------------------------------------------------
+// Render backends
+
+struct RenderRequest {
+    std::string job_name;
+    int frame_index = 0;
+    std::string project_file_path;
+    std::string render_script_path;
+    std::string output_directory_path;
+    std::string output_file_name_format;
+    std::string output_file_format;
+};
+
+struct Options {
+    std::string master_host = "127.0.0.1";
+    int master_port = 9901;
+    std::string base_directory = ".";
+    std::string backend = "mock";
+    std::string blender_binary = "blender";
+    std::string python_binary = "python3";
+    std::string prepend_arguments;
+    std::string append_arguments;
+    std::string log_file_path;
+    int mock_render_ms = 100;
+    int render_width = 256;
+    int render_height = 256;
+    int render_samples = 4;
+};
+
+// Scrapes `RESULTS={json}` from subprocess stdout (contract:
+// scripts/render-timing-script.py + tpu_render_cluster/render/cli.py).
+static bool parse_results_line(const std::string& stdout_text, Json* out) {
+    size_t pos = 0;
+    bool found = false;
+    while (pos < stdout_text.size()) {
+        size_t eol = stdout_text.find('\n', pos);
+        if (eol == std::string::npos) eol = stdout_text.size();
+        if (stdout_text.compare(pos, 8, "RESULTS=") == 0) {
+            std::string payload = stdout_text.substr(pos + 8, eol - pos - 8);
+            if (json_parse(payload, out)) found = true;
+        }
+        pos = eol + 1;
+    }
+    return found;
+}
+
+// Parses " Time: mm:ss.ff (Saving: mm:ss.ff)" after "Saved: '" (reference:
+// worker/src/rendering/runner/utilities.rs:105-203). Returns saving seconds
+// or a negative value when absent.
+static double parse_saving_seconds(const std::string& stdout_text) {
+    size_t saved_at = stdout_text.find("Saved: '");
+    if (saved_at == std::string::npos) return -1.0;
+    size_t time_at = stdout_text.find(" Time:", saved_at);
+    if (time_at == std::string::npos) return -1.0;
+    size_t saving_at = stdout_text.find("(Saving:", time_at);
+    if (saving_at == std::string::npos) return -1.0;
+    int minutes = 0;
+    double seconds = 0.0;
+    if (sscanf(stdout_text.c_str() + saving_at, "(Saving: %d:%lf)", &minutes,
+               &seconds) != 2) {
+        return -1.0;
+    }
+    return minutes * 60 + seconds;
+}
+
+static int run_subprocess(const std::string& command, std::string* stdout_text) {
+    FILE* pipe = popen((command + " 2>/dev/null").c_str(), "r");
+    if (pipe == nullptr) return -1;
+    char chunk[4096];
+    stdout_text->clear();
+    while (fgets(chunk, sizeof(chunk), pipe) != nullptr) {
+        *stdout_text += chunk;
+    }
+    return pclose(pipe);
+}
+
+static std::string shell_quote(const std::string& s) {
+    std::string out = "'";
+    for (char c : s) {
+        if (c == '\'') out += "'\\''";
+        else out += c;
+    }
+    out += "'";
+    return out;
+}
+
+// Scene selection for the cli backend: prefer the project file's stem (the
+// job payload's source of truth — e.g. ".../01_simple-animation.blend"),
+// falling back to the job-name prefix convention used across the repo's job
+// matrix (tpu_render_cluster/render/scene.py scene_for_job_name).
+static std::string scene_for_job(const RenderRequest& request) {
+    static const char* kScenes[] = {"01_simple-animation", "02_physics",
+                                    "03_physics-2", "04_very-simple"};
+    std::string stem = request.project_file_path;
+    size_t slash = stem.find_last_of('/');
+    if (slash != std::string::npos) stem = stem.substr(slash + 1);
+    for (const char* scene : kScenes) {
+        if (stem.rfind(scene, 0) == 0) return scene;
+    }
+    const std::string& name = request.job_name;
+    if (name.rfind("01", 0) == 0) return "01_simple-animation";
+    if (name.rfind("02", 0) == 0) return "02_physics";
+    if (name.rfind("03", 0) == 0) return "03_physics-2";
+    return "04_very-simple";
+}
+
+// Returns false (with *error set) on render failure.
+static bool render_frame(const Options& options, const RenderRequest& request,
+                         FrameRenderTime* timing, std::string* error) {
+    std::string output_directory =
+        expand_path(request.output_directory_path, options.base_directory);
+    make_directories(output_directory);
+    std::string file_name =
+        format_frame_placeholders(request.output_file_name_format,
+                                  request.frame_index);
+    std::string extension = lowercase(request.output_file_format);
+    if (extension == "jpeg") extension = "jpg";
+    std::string output_path = output_directory + "/" + file_name + "." + extension;
+
+    double t0 = now_ts();
+    if (options.backend == "mock") {
+        double duration = options.mock_render_ms / 1000.0;
+        std::this_thread::sleep_for(
+            std::chrono::milliseconds(options.mock_render_ms));
+        FILE* f = fopen(output_path.c_str(), "wb");
+        if (f != nullptr) {
+            fputs("trc-worker mock frame\n", f);
+            fclose(f);
+        }
+        double t1 = now_ts();
+        timing->started_process_at = t0;
+        timing->finished_loading_at = t0 + duration * 0.15;
+        timing->started_rendering_at = t0 + duration * 0.15;
+        timing->finished_rendering_at = t0 + duration * 0.85;
+        timing->file_saving_started_at = t0 + duration * 0.85;
+        timing->file_saving_finished_at = t1;
+        timing->exited_process_at = t1;
+        return true;
+    }
+
+    std::string command;
+    if (options.backend == "cli") {
+        char numbers[160];
+        snprintf(numbers, sizeof(numbers),
+                 " --frame %d --width %d --height %d --samples %d",
+                 request.frame_index, options.render_width,
+                 options.render_height, options.render_samples);
+        command = shell_quote(options.python_binary) +
+                  " -m tpu_render_cluster.render.cli --scene " +
+                  shell_quote(scene_for_job(request)) + numbers +
+                  " --out " + shell_quote(output_path);
+    } else if (options.backend == "blender") {
+        // Reference command shape: worker/src/rendering/runner/mod.rs:138-176.
+        std::string project =
+            expand_path(request.project_file_path, options.base_directory);
+        std::string script =
+            expand_path(request.render_script_path, options.base_directory);
+        std::string render_output =
+            output_directory + "/" + request.output_file_name_format;
+        command = shell_quote(options.blender_binary);
+        if (!options.prepend_arguments.empty())
+            command += " " + options.prepend_arguments;
+        command += " " + shell_quote(project) + " --background --python " +
+                   shell_quote(script) + " -- --render-output " +
+                   shell_quote(render_output) + " --render-format " +
+                   shell_quote(request.output_file_format) +
+                   " --render-frame " + std::to_string(request.frame_index);
+        if (!options.append_arguments.empty())
+            command += " " + options.append_arguments;
+    } else {
+        *error = "Unknown backend: " + options.backend;
+        return false;
+    }
+
+    std::string stdout_text;
+    int rc = run_subprocess(command, &stdout_text);
+    double t1 = now_ts();
+    if (rc != 0) {
+        *error = "Render subprocess exited with code " + std::to_string(rc);
+        return false;
+    }
+
+    timing->started_process_at = t0;
+    timing->exited_process_at = t1;
+    Json results;
+    if (parse_results_line(stdout_text, &results)) {
+        auto field = [&](const char* name, double fallback) {
+            const Json* v = results.get(name);
+            return v != nullptr ? v->as_double() : fallback;
+        };
+        double loaded = field("project_loaded_at", t0);
+        double render_start = field("project_started_rendering_at", loaded);
+        double render_end = field("project_finished_rendering_at", t1);
+        double save_start = field("file_saving_started_at", -1.0);
+        double save_end = field("file_saving_finished_at", -1.0);
+        if (save_start < 0 || save_end < 0) {
+            // Blender-script contract: render-end includes saving; the
+            // " Time: (Saving:)" stdout line carries the save duration.
+            double saving = parse_saving_seconds(stdout_text);
+            if (saving < 0) saving = 0.0;
+            save_end = render_end;
+            render_end -= saving;
+            save_start = render_end;
+        }
+        timing->finished_loading_at = loaded;
+        timing->started_rendering_at = render_start;
+        timing->finished_rendering_at = render_end;
+        timing->file_saving_started_at = save_start;
+        timing->file_saving_finished_at = save_end;
+    } else {
+        // No RESULTS contract in stdout: approximate phases by wall clock.
+        double span = t1 - t0;
+        timing->finished_loading_at = t0 + span * 0.1;
+        timing->started_rendering_at = t0 + span * 0.1;
+        timing->finished_rendering_at = t0 + span * 0.9;
+        timing->file_saving_started_at = t0 + span * 0.9;
+        timing->file_saving_finished_at = t1;
+    }
+    return true;
+}
+
+// ---------------------------------------------------------------------------
+// The worker daemon
+
+class WorkerDaemon {
+  public:
+    explicit WorkerDaemon(Options options)
+        : options_(std::move(options)),
+          worker_id_(uint32_t(rng()())) {}
+
+    int run() {
+        LOG_INFO("Worker %08x starting (backend=%s, master=%s:%d).", worker_id_,
+                 options_.backend.c_str(), options_.master_host.c_str(),
+                 options_.master_port);
+        if (!connect_with_backoff(false)) {
+            LOG_ERROR("Could not reach the master; giving up.");
+            return 1;
+        }
+        io_thread_id_ = std::this_thread::get_id();
+        std::thread render_thread(&WorkerDaemon::render_loop, this);
+        io_loop();
+        cancelled_.store(true);
+        queue_cv_.notify_all();
+        render_thread.join();
+        {
+            std::lock_guard<std::mutex> lock(ws_mutex_);
+            ws_.close_socket();
+        }
+        LOG_INFO("Worker %08x exiting (%s).", worker_id_,
+                 job_finished_.load() ? "job finished" : "connection lost");
+        return job_finished_.load() ? 0 : 1;
+    }
+
+  private:
+    Options options_;
+    uint32_t worker_id_;
+    WsClient ws_;
+    std::mutex ws_mutex_;  // guards sends + socket swaps
+    std::condition_variable reconnected_cv_;
+    std::atomic<bool> cancelled_{false};
+    std::atomic<bool> job_finished_{false};
+    std::thread::id io_thread_id_;
+
+    struct QueueEntry {
+        std::string job_name;
+        int frame_index;
+        RenderRequest request;
+        bool rendering = false;
+    };
+    std::mutex queue_mutex_;
+    std::condition_variable queue_cv_;
+    std::deque<QueueEntry> queue_;
+    std::set<std::pair<std::string, int>> finished_frames_;
+
+    TraceBuilder tracer_;
+    uint64_t ping_counter_ = 0;
+
+    // -- connection management (reference: worker/src/connection/mod.rs:360-487)
+
+    bool connect_with_backoff(bool is_reconnect) {
+        const int max_retries = 12;  // reference backoff parameters
+        for (int attempt = 0; attempt < max_retries && !cancelled_.load();
+             attempt++) {
+            if (attempt > 0) {
+                double delay = std::min(std::pow(2.0, attempt - 1), 30.0);
+                std::this_thread::sleep_for(
+                    std::chrono::milliseconds(int64_t(delay * 1000)));
+            }
+            if (try_connect_once(is_reconnect)) return true;
+            LOG_WARN("Connect attempt %d/%d failed.", attempt + 1, max_retries);
+        }
+        return false;
+    }
+
+    bool try_connect_once(bool is_reconnect) {
+        std::lock_guard<std::mutex> lock(ws_mutex_);
+        if (!ws_.connect_and_upgrade(options_.master_host, options_.master_port))
+            return false;
+        // 3-step application handshake (worker side:
+        // worker/src/connection/mod.rs:402-454).
+        std::string text;
+        if (!ws_.receive_text(&text)) return false;
+        Json request;
+        if (!json_parse(text, &request)) return false;
+        const Json* tag = request.get("message_type");
+        if (tag == nullptr || tag->as_string() != "handshake_request")
+            return false;
+
+        Json payload = Json::make_object();
+        payload.set("handshake_type",
+                    Json::make_string(is_reconnect ? "reconnecting"
+                                                   : "first-connection"));
+        payload.set("worker_version", Json::make_string("1.0.0"));
+        payload.set("worker_id", Json::make_uint(worker_id_));
+        Json envelope = Json::make_object();
+        envelope.set("message_type", Json::make_string("handshake_response"));
+        envelope.set("payload", std::move(payload));
+        if (!ws_.send_text(json_dumps(envelope))) return false;
+
+        if (!ws_.receive_text(&text)) return false;
+        Json ack;
+        if (!json_parse(text, &ack)) return false;
+        const Json* ack_tag = ack.get("message_type");
+        const Json* ack_payload = ack.get("payload");
+        if (ack_tag == nullptr ||
+            ack_tag->as_string() != "handshake_acknowledgement" ||
+            ack_payload == nullptr)
+            return false;
+        const Json* ok = ack_payload->get("ok");
+        if (ok == nullptr || ok->type != Json::BOOL || !ok->boolean) {
+            LOG_ERROR("Master refused the handshake.");
+            return false;
+        }
+        reconnected_cv_.notify_all();
+        return true;
+    }
+
+    // Called by the IO thread when the socket dies mid-job.
+    bool reconnect() {
+        double lost_at = now_ts();
+        {
+            std::lock_guard<std::mutex> lock(ws_mutex_);
+            ws_.close_socket();
+        }
+        LOG_WARN("Connection lost; reconnecting...");
+        if (!connect_with_backoff(true)) return false;
+        {
+            std::lock_guard<std::mutex> lock(tracer_.mutex);
+            tracer_.reconnects.emplace_back(lost_at, now_ts());
+        }
+        LOG_INFO("Reconnected.");
+        return true;
+    }
+
+    bool send_message(const std::string& type_name, Json payload) {
+        Json envelope = Json::make_object();
+        envelope.set("message_type", Json::make_string(type_name));
+        envelope.set("payload", std::move(payload));
+        std::string text = json_dumps(envelope);
+        // Retry through reconnect windows (bounded, reference: 30 s op
+        // deadline, worker/src/connection/mod.rs:133-274). The IO thread
+        // owns reconnection, so when *it* is the failing sender it
+        // reconnects inline; other threads shut the socket down to wake the
+        // IO thread's recv and wait for the swapped-in connection.
+        auto deadline =
+            std::chrono::steady_clock::now() + std::chrono::seconds(30);
+        bool on_io_thread = std::this_thread::get_id() == io_thread_id_;
+        for (;;) {
+            {
+                std::unique_lock<std::mutex> lock(ws_mutex_);
+                if (ws_.is_open() && ws_.send_text(text)) return true;
+            }
+            if (cancelled_.load() || job_finished_.load()) return false;
+            if (std::chrono::steady_clock::now() >= deadline) return false;
+            if (on_io_thread) {
+                if (!reconnect()) {
+                    cancelled_.store(true);
+                    return false;
+                }
+                continue;
+            }
+            std::unique_lock<std::mutex> lock(ws_mutex_);
+            ws_.shutdown_socket();  // wake the IO thread's recv
+            if (reconnected_cv_.wait_until(lock, deadline) ==
+                std::cv_status::timeout)
+                return false;
+        }
+    }
+
+    // -- IO loop -------------------------------------------------------------
+
+    void io_loop() {
+        while (!cancelled_.load() && !job_finished_.load()) {
+            std::string text;
+            bool received;
+            {
+                // Reads happen without the mutex (sends interleave fine on a
+                // SOCK_STREAM fd; frame writes are serialized by ws_mutex_).
+                received = ws_.receive_text(&text);
+            }
+            if (!received) {
+                if (job_finished_.load() || cancelled_.load()) return;
+                if (!reconnect()) {
+                    LOG_ERROR("Reconnect failed; shutting down.");
+                    cancelled_.store(true);
+                    return;
+                }
+                continue;
+            }
+            double received_at = now_ts();
+            Json message;
+            if (!json_parse(text, &message)) {
+                LOG_WARN("Dropping malformed frame (%zu bytes).", text.size());
+                continue;
+            }
+            const Json* tag = message.get("message_type");
+            const Json* payload = message.get("payload");
+            if (tag == nullptr) continue;
+            static const Json kEmpty = Json::make_object();
+            dispatch(tag->as_string(), payload != nullptr ? *payload : kEmpty,
+                     received_at);
+        }
+    }
+
+    void dispatch(const std::string& type, const Json& payload,
+                  double received_at) {
+        if (type == "request_heartbeat") {
+            handle_heartbeat(payload, received_at);
+        } else if (type == "request_frame-queue_add") {
+            handle_queue_add(payload);
+        } else if (type == "request_frame-queue_remove") {
+            handle_queue_remove(payload);
+        } else if (type == "event_job-started") {
+            LOG_INFO("Job started.");
+            std::lock_guard<std::mutex> lock(tracer_.mutex);
+            tracer_.job_start_time = now_ts();
+        } else if (type == "request_job-finished") {
+            handle_job_finished(payload);
+        } else {
+            LOG_WARN("Unhandled message type: %s", type.c_str());
+        }
+    }
+
+    // Heartbeats: every 8th ping is traced (reference:
+    // worker/src/connection/mod.rs:46,571-581).
+    void handle_heartbeat(const Json& payload, double received_at) {
+        send_message("response_heartbeat", Json::make_object());
+        ping_counter_++;
+        if (ping_counter_ % 8 == 0) {
+            const Json* request_time = payload.get("request_time");
+            double pinged_at =
+                request_time != nullptr ? request_time->as_double() : received_at;
+            std::lock_guard<std::mutex> lock(tracer_.mutex);
+            tracer_.pings.emplace_back(pinged_at, received_at);
+        }
+    }
+
+    void handle_queue_add(const Json& payload) {
+        const Json* request_id = payload.get("message_request_id");
+        const Json* job = payload.get("job");
+        const Json* frame_index = payload.get("frame_index");
+        Json response = Json::make_object();
+        response.set("message_request_context_id",
+                     request_id != nullptr ? *request_id : Json::make_uint(0));
+        Json result = Json::make_object();
+        if (job == nullptr || frame_index == nullptr) {
+            result.set("result", Json::make_string("errored"));
+            result.set("reason", Json::make_string("missing job/frame_index"));
+        } else {
+            QueueEntry entry;
+            auto text_field = [&](const char* name) {
+                const Json* v = job->get(name);
+                return v != nullptr ? v->as_string() : std::string();
+            };
+            entry.job_name = text_field("job_name");
+            entry.frame_index = int(frame_index->as_i64());
+            entry.request.job_name = entry.job_name;
+            entry.request.frame_index = entry.frame_index;
+            entry.request.project_file_path = text_field("project_file_path");
+            entry.request.render_script_path = text_field("render_script_path");
+            entry.request.output_directory_path =
+                text_field("output_directory_path");
+            entry.request.output_file_name_format =
+                text_field("output_file_name_format");
+            entry.request.output_file_format = text_field("output_file_format");
+            {
+                std::lock_guard<std::mutex> lock(queue_mutex_);
+                queue_.push_back(std::move(entry));
+            }
+            queue_cv_.notify_one();
+            {
+                std::lock_guard<std::mutex> lock(tracer_.mutex);
+                tracer_.total_queued_frames++;
+            }
+            result.set("result", Json::make_string("added-to-queue"));
+        }
+        response.set("result", std::move(result));
+        send_message("response_frame-queue-add", std::move(response));
+    }
+
+    // Remove result semantics: worker/src/rendering/queue.rs:192-229.
+    void handle_queue_remove(const Json& payload) {
+        const Json* request_id = payload.get("message_request_id");
+        const Json* job_name = payload.get("job_name");
+        const Json* frame_index = payload.get("frame_index");
+        std::string result_value = "errored";
+        if (job_name != nullptr && frame_index != nullptr) {
+            std::string name = job_name->as_string();
+            int index = int(frame_index->as_i64());
+            std::lock_guard<std::mutex> lock(queue_mutex_);
+            if (finished_frames_.count({name, index}) != 0) {
+                result_value = "already-finished";
+            } else {
+                result_value = "errored";
+                for (auto it = queue_.begin(); it != queue_.end(); ++it) {
+                    if (it->job_name == name && it->frame_index == index) {
+                        if (it->rendering) {
+                            result_value = "already-rendering";
+                        } else {
+                            queue_.erase(it);
+                            result_value = "removed-from-queue";
+                            std::lock_guard<std::mutex> tlock(tracer_.mutex);
+                            tracer_.total_removed++;
+                        }
+                        break;
+                    }
+                }
+            }
+        }
+        Json response = Json::make_object();
+        response.set("message_request_context_id",
+                     request_id != nullptr ? *request_id : Json::make_uint(0));
+        Json result = Json::make_object();
+        result.set("result", Json::make_string(result_value));
+        if (result_value == "errored") {
+            result.set("reason", Json::make_string("no such queued frame"));
+        }
+        response.set("result", std::move(result));
+        send_message("response_frame-queue_remove", std::move(response));
+    }
+
+    void handle_job_finished(const Json& payload) {
+        LOG_INFO("Job finished; sending trace.");
+        {
+            std::lock_guard<std::mutex> lock(tracer_.mutex);
+            tracer_.job_finish_time = now_ts();
+        }
+        const Json* request_id = payload.get("message_request_id");
+        Json response = Json::make_object();
+        response.set("message_request_context_id",
+                     request_id != nullptr ? *request_id : Json::make_uint(0));
+        response.set("trace", tracer_.build());
+        send_message("response_job-finished", std::move(response));
+        job_finished_.store(true);
+        std::lock_guard<std::mutex> lock(ws_mutex_);
+        ws_.shutdown_socket();
+    }
+
+    // -- render loop (reference: worker/src/rendering/queue.rs:74-186) -------
+
+    void render_loop() {
+        while (!cancelled_.load()) {
+            RenderRequest request;
+            bool have_frame = false;
+            {
+                std::unique_lock<std::mutex> lock(queue_mutex_);
+                queue_cv_.wait_for(lock, std::chrono::milliseconds(100), [&] {
+                    return cancelled_.load() || !queue_.empty();
+                });
+                if (cancelled_.load()) return;
+                for (auto& entry : queue_) {
+                    if (!entry.rendering) {
+                        entry.rendering = true;
+                        request = entry.request;
+                        have_frame = true;
+                        break;
+                    }
+                }
+            }
+            if (!have_frame) continue;
+
+            Json started = Json::make_object();
+            started.set("job_name", Json::make_string(request.job_name));
+            started.set("frame_index", Json::make_int(request.frame_index));
+            send_message("event_frame-queue_item-started-rendering",
+                         std::move(started));
+
+            FrameRenderTime timing;
+            std::string error;
+            bool rendered = render_frame(options_, request, &timing, &error);
+            if (rendered) {
+                std::lock_guard<std::mutex> lock(tracer_.mutex);
+                tracer_.frames.emplace_back(request.frame_index, timing);
+            } else {
+                LOG_ERROR("Frame %d failed: %s", request.frame_index,
+                          error.c_str());
+            }
+            {
+                std::lock_guard<std::mutex> lock(queue_mutex_);
+                for (auto it = queue_.begin(); it != queue_.end(); ++it) {
+                    if (it->job_name == request.job_name &&
+                        it->frame_index == request.frame_index) {
+                        queue_.erase(it);
+                        break;
+                    }
+                }
+                // Errored frames are NOT finished: the master returns them to
+                // the pending pool and may re-queue them here, so a later
+                // remove request must not answer "already-finished".
+                if (rendered) {
+                    finished_frames_.insert(
+                        {request.job_name, request.frame_index});
+                }
+            }
+            Json finished = Json::make_object();
+            finished.set("job_name", Json::make_string(request.job_name));
+            finished.set("frame_index", Json::make_int(request.frame_index));
+            Json result = Json::make_object();
+            // Render errors are *reported* (reference swallows them and the
+            // master hangs — worker/src/rendering/queue.rs:169-174).
+            result.set("result", Json::make_string(rendered ? "ok" : "errored"));
+            if (!rendered) result.set("reason", Json::make_string(error));
+            finished.set("result", std::move(result));
+            send_message("event_frame-queue_item-finished", std::move(finished));
+        }
+    }
+};
+
+// ---------------------------------------------------------------------------
+
+static void print_usage() {
+    fprintf(stderr,
+            "trc-worker: C++ render-node daemon for the tpu-render-cluster "
+            "protocol.\n"
+            "Flags (reference CLI: worker/src/cli.rs:5-45):\n"
+            "  --masterServerHost H   master hostname (default 127.0.0.1)\n"
+            "  --masterServerPort P   master port (default 9901)\n"
+            "  --baseDirectory D      %%BASE%% placeholder root (default .)\n"
+            "  --backend B            mock | cli | blender (default mock)\n"
+            "  --blenderBinary B      blender executable (blender backend)\n"
+            "  --pythonBinary B       python executable (cli backend)\n"
+            "  --prependArguments S   extra args before the blend file\n"
+            "  --appendArguments S    extra args at the end\n"
+            "  --mockRenderMs N       mock render duration (default 100)\n"
+            "  --renderWidth/Height/Samples N   cli backend quality knobs\n"
+            "  --logFilePath F        also append logs to this file\n");
+}
+
+int main(int argc, char** argv) {
+    Options options;
+    for (int i = 1; i < argc; i++) {
+        std::string flag = argv[i];
+        auto next = [&]() -> std::string {
+            if (i + 1 >= argc) {
+                fprintf(stderr, "Missing value for %s\n", flag.c_str());
+                exit(2);
+            }
+            return argv[++i];
+        };
+        if (flag == "--masterServerHost") options.master_host = next();
+        else if (flag == "--masterServerPort") options.master_port = atoi(next().c_str());
+        else if (flag == "--baseDirectory") options.base_directory = next();
+        else if (flag == "--backend") options.backend = next();
+        else if (flag == "--blenderBinary") options.blender_binary = next();
+        else if (flag == "--pythonBinary") options.python_binary = next();
+        else if (flag == "--prependArguments") options.prepend_arguments = next();
+        else if (flag == "--appendArguments") options.append_arguments = next();
+        else if (flag == "--mockRenderMs") options.mock_render_ms = atoi(next().c_str());
+        else if (flag == "--renderWidth") options.render_width = atoi(next().c_str());
+        else if (flag == "--renderHeight") options.render_height = atoi(next().c_str());
+        else if (flag == "--renderSamples") options.render_samples = atoi(next().c_str());
+        else if (flag == "--logFilePath") options.log_file_path = next();
+        else if (flag == "--help" || flag == "-h") {
+            print_usage();
+            return 0;
+        } else {
+            fprintf(stderr, "Unknown flag: %s\n", flag.c_str());
+            print_usage();
+            return 2;
+        }
+    }
+    if (!options.log_file_path.empty()) {
+        g_log_file = fopen(options.log_file_path.c_str(), "a");
+    }
+    WorkerDaemon daemon(std::move(options));
+    return daemon.run();
+}

@@ -97,6 +97,41 @@ def _build() -> bool:
         return False
 
 
+_DAEMON_SOURCE = _SOURCE.parent / "worker_daemon.cpp"
+_DAEMON_BINARY = _SOURCE.parent / "trc-worker"
+
+
+def build_worker_daemon() -> Path | None:
+    """Builds the standalone C++ worker daemon (native/worker_daemon.cpp).
+
+    Returns the binary path, or None when the toolchain/source is missing.
+    """
+    if not _DAEMON_SOURCE.is_file() or not _SOURCE.is_file():
+        return None
+    newest_source = max(_DAEMON_SOURCE.stat().st_mtime, _SOURCE.stat().st_mtime)
+    if _DAEMON_BINARY.is_file() and _DAEMON_BINARY.stat().st_mtime >= newest_source:
+        return _DAEMON_BINARY
+    try:
+        subprocess.run(
+            [
+                "g++",
+                "-O2",
+                "-pthread",
+                "-o",
+                str(_DAEMON_BINARY),
+                str(_DAEMON_SOURCE),
+                str(_SOURCE),
+            ],
+            check=True,
+            capture_output=True,
+            timeout=300,
+        )
+        return _DAEMON_BINARY
+    except (subprocess.SubprocessError, OSError) as e:
+        logger.debug("Worker daemon build failed: %s", e)
+        return None
+
+
 def load_codec() -> NativeCodec | None:
     """The built codec, or None when the toolchain/source is unavailable."""
     global _codec, _load_attempted

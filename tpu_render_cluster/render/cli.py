@@ -24,12 +24,14 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--out", required=True)
     args = parser.parse_args(argv)
 
+    import json
+
     import numpy as np
 
     from tpu_render_cluster.render.image_io import write_image
     from tpu_render_cluster.render.integrator import render_frame, tonemap
 
-    t0 = time.time()
+    loaded_at = time.time()  # imports above = the "project load" phase
     linear = render_frame(
         args.scene,
         args.frame,
@@ -39,13 +41,29 @@ def main(argv: list[str] | None = None) -> int:
         max_bounces=args.bounces,
     )
     linear.block_until_ready()
-    render_seconds = time.time() - t0
+    finished_rendering_at = time.time()
     path = Path(args.out)
     write_image(path, np.asarray(tonemap(linear)), path.suffix.lstrip(".").upper() or "PNG")
+    saved_at = time.time()
     print(
         f"Rendered {args.scene} frame {args.frame} "
         f"({args.width}x{args.height}, {args.samples} spp) "
-        f"in {render_seconds:.2f} s -> {path}"
+        f"in {finished_rendering_at - loaded_at:.2f} s -> {path}"
+    )
+    # Phase-timing contract consumed by worker daemons (same shape as the
+    # Blender timing script, scripts/render-timing-script.py, plus explicit
+    # save timestamps since we know them exactly).
+    print(
+        "RESULTS="
+        + json.dumps(
+            {
+                "project_loaded_at": loaded_at,
+                "project_started_rendering_at": loaded_at,
+                "project_finished_rendering_at": finished_rendering_at,
+                "file_saving_started_at": finished_rendering_at,
+                "file_saving_finished_at": saved_at,
+            }
+        )
     )
     return 0
 

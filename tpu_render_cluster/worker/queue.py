@@ -124,8 +124,10 @@ class WorkerAutomaticQueue:
             timing = await self._backend.render_frame(frame.job, frame.frame_index)
         except Exception as e:  # noqa: BLE001 - report, don't hang the master
             logger.error("Frame %d render failed: %s", frame.frame_index, e)
+            # NOT added to _finished_indices: the master returns errored
+            # frames to the pending pool and may re-queue them here; a later
+            # remove request must not answer "already-finished".
             self._remove(frame)
-            self._finished_indices.add((job_name, frame.frame_index))
             await self._sender.send_message(
                 pm.WorkerFrameQueueItemFinishedEvent.new_errored(
                     job_name, frame.frame_index, str(e)
