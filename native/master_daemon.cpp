@@ -1,0 +1,1615 @@
+// trc-master: standalone C++ cluster-coordinator daemon.
+//
+// Native counterpart of the reference's Rust `master` crate
+// (reference: master/src/ — CLI master/src/cli.rs:5-40, server + cluster
+// manager master/src/cluster/mod.rs:234-672, frame table
+// master/src/cluster/state.rs:13-130, the three distribution strategies
+// master/src/cluster/strategies.rs:16-405, queue mirror
+// master/src/connection/queue.rs:10-122, results persistence
+// master/src/main.rs:26-338). Speaks the same wire protocol as the Python
+// daemons (tpu_render_cluster/protocol/messages.py) and writes the same
+// raw-trace / processed-results JSON artifacts, so the analysis suite
+// (tpu_render_cluster/analysis/) parses its output unchanged.
+//
+// Build:
+//   g++ -std=gnu++17 -O2 -pthread -o native/trc-master \
+//       native/master_daemon.cpp native/wscodec.cpp
+//
+// Schedulers: naive-fine | eager-naive-coarse | dynamic (work stealing with
+// provenance + anti-thrash resteal timers) | tpu-batch. The tpu-batch
+// scheduler keeps the scheduling *math* in JAX on the accelerator: it feeds
+// per-tick cost matrices to a persistent
+// `python -m tpu_render_cluster.master.assignment_service` subprocess (the
+// vmapped auction solver from tpu_render_cluster/ops/assignment.py) over
+// line-delimited JSON pipes, and falls back to a greedy host solve until
+// the service reports ready (or if it dies).
+//
+// Beyond-reference behavior (documented deviations, all fixing SURVEY.md §7
+// "known reference bugs"): late-joining workers still receive the
+// job-started event; errored frames return to the pending pool; dead
+// workers (no heartbeat response for --evictAfterSeconds, default 120) are
+// evicted and their queued frames re-scheduled — the reference would wait
+// forever (master/src/cluster/mod.rs:616-617, §5.3).
+
+#include "trc_common.hpp"
+
+#include <algorithm>
+#include <csignal>
+#include <ctime>
+#include <limits>
+#include <map>
+#include <sys/select.h>
+#include <sys/wait.h>
+
+// ---------------------------------------------------------------------------
+// Minimal TOML subset parser for BlenderJob files
+// (reference: shared/src/jobs/mod.rs:84-100 loads the same schema with the
+// `toml` crate; job TOML keys map 1:1 onto the job JSON payload that rides
+// `request_frame-queue_add`).
+//
+// Supports: `key = value` pairs, one level of `[table]` headers, strings,
+// integers, floats, booleans, and `#` comments — the complete grammar used
+// by the blender-projects/*.toml job matrix.
+
+static std::string trim(const std::string& s) {
+    size_t a = s.find_first_not_of(" \t\r\n");
+    if (a == std::string::npos) return "";
+    size_t b = s.find_last_not_of(" \t\r\n");
+    return s.substr(a, b - a + 1);
+}
+
+static bool parse_toml_value(const std::string& raw, Json* out) {
+    std::string text = trim(raw);
+    if (text.empty()) return false;
+    if (text[0] == '"') {
+        size_t close = text.rfind('"');
+        if (close == 0) return false;
+        std::string inner = text.substr(1, close - 1);
+        std::string unescaped;
+        for (size_t i = 0; i < inner.size(); i++) {
+            if (inner[i] == '\\' && i + 1 < inner.size()) {
+                char esc = inner[++i];
+                switch (esc) {
+                    case 'n': unescaped += '\n'; break;
+                    case 't': unescaped += '\t'; break;
+                    case '"': unescaped += '"'; break;
+                    case '\\': unescaped += '\\'; break;
+                    default: unescaped += esc;
+                }
+            } else {
+                unescaped += inner[i];
+            }
+        }
+        *out = Json::make_string(unescaped);
+        return true;
+    }
+    if (text == "true") {
+        *out = Json::make_bool(true);
+        return true;
+    }
+    if (text == "false") {
+        *out = Json::make_bool(false);
+        return true;
+    }
+    if (text.find('.') != std::string::npos ||
+        text.find('e') != std::string::npos) {
+        *out = Json::make_double(strtod(text.c_str(), nullptr));
+        return true;
+    }
+    errno = 0;
+    long long v = strtoll(text.c_str(), nullptr, 10);
+    if (errno != 0) return false;
+    *out = Json::make_int(v);
+    return true;
+}
+
+// Parses the job TOML into the job JSON payload shape
+// (tpu_render_cluster/jobs/models.py BlenderJob.to_dict).
+static bool parse_job_toml(const std::string& path, Json* out) {
+    FILE* f = fopen(path.c_str(), "r");
+    if (f == nullptr) {
+        LOG_ERROR("No such job file: %s", path.c_str());
+        return false;
+    }
+    Json root = Json::make_object();
+    Json* current = &root;
+    char line_buffer[4096];
+    while (fgets(line_buffer, sizeof(line_buffer), f) != nullptr) {
+        std::string line = trim(line_buffer);
+        if (line.empty() || line[0] == '#') continue;
+        if (line[0] == '[') {
+            size_t close = line.find(']');
+            if (close == std::string::npos) {
+                fclose(f);
+                return false;
+            }
+            std::string table = trim(line.substr(1, close - 1));
+            root.set(table, Json::make_object());
+            // Re-find: set() may have reallocated.
+            for (auto& pair : root.obj) {
+                if (pair.first == table) current = &pair.second;
+            }
+            continue;
+        }
+        size_t eq = line.find('=');
+        if (eq == std::string::npos) continue;
+        std::string key = trim(line.substr(0, eq));
+        std::string value_text = line.substr(eq + 1);
+        // Strip trailing comments outside strings.
+        bool in_string = false;
+        for (size_t i = 0; i < value_text.size(); i++) {
+            if (value_text[i] == '"' && (i == 0 || value_text[i - 1] != '\\'))
+                in_string = !in_string;
+            else if (value_text[i] == '#' && !in_string) {
+                value_text = value_text.substr(0, i);
+                break;
+            }
+        }
+        Json value;
+        if (!parse_toml_value(value_text, &value)) {
+            LOG_ERROR("Bad TOML value for key '%s'", key.c_str());
+            fclose(f);
+            return false;
+        }
+        current->set(key, std::move(value));
+    }
+    fclose(f);
+    *out = std::move(root);
+    return true;
+}
+
+// ---------------------------------------------------------------------------
+// Job view (typed accessors over the job JSON)
+
+struct JobView {
+    Json json;  // the full job payload (rides every queue-add request)
+    std::string name;
+    int frame_from = 1;
+    int frame_to = 1;
+    int wait_for_workers = 1;
+    std::string strategy = "naive-fine";
+    int target_queue_size = 1;
+    int min_queue_size_to_steal = 0;
+    double resteal_elsewhere_s = 0;
+    double resteal_original_s = 0;
+    double cost_ema_alpha = 0.3;
+
+    static bool from_json(Json job, JobView* out) {
+        const Json* name = job.get("job_name");
+        const Json* from = job.get("frame_range_from");
+        const Json* to = job.get("frame_range_to");
+        const Json* wait = job.get("wait_for_number_of_workers");
+        const Json* strategy = job.get("frame_distribution_strategy");
+        if (name == nullptr || from == nullptr || to == nullptr ||
+            wait == nullptr || strategy == nullptr) {
+            LOG_ERROR("Job file is missing required keys.");
+            return false;
+        }
+        out->name = name->as_string();
+        out->frame_from = int(from->as_i64());
+        out->frame_to = int(to->as_i64());
+        out->wait_for_workers = int(wait->as_i64());
+        const Json* type = strategy->get("strategy_type");
+        out->strategy = type != nullptr ? type->as_string() : "naive-fine";
+        auto int_field = [&](const char* key, int fallback) {
+            const Json* v = strategy->get(key);
+            return v != nullptr ? int(v->as_i64()) : fallback;
+        };
+        out->target_queue_size = int_field("target_queue_size", 1);
+        out->min_queue_size_to_steal = int_field("min_queue_size_to_steal", 0);
+        out->resteal_elsewhere_s =
+            int_field("min_seconds_before_resteal_to_elsewhere", 0);
+        out->resteal_original_s =
+            int_field("min_seconds_before_resteal_to_original_worker", 0);
+        const Json* alpha = strategy->get("cost_ema_alpha");
+        if (alpha != nullptr) out->cost_ema_alpha = alpha->as_double();
+        out->json = std::move(job);
+        return true;
+    }
+
+    int frame_count() const { return frame_to - frame_from + 1; }
+};
+
+// ---------------------------------------------------------------------------
+// Cluster state (reference: master/src/cluster/state.rs:13-130)
+
+enum class FrameStatus { Pending, Queued, Rendering, Finished };
+
+struct FrameSlot {
+    int frame_index = 0;
+    FrameStatus status = FrameStatus::Pending;
+    uint32_t worker = 0;
+};
+
+// Master-side mirror of a worker's queue
+// (reference: master/src/connection/queue.rs:10-122).
+struct FrameOnWorker {
+    int frame_index = 0;
+    bool rendering = false;
+    double queued_at = 0;
+    double rendering_started_at = 0;
+    bool stolen = false;
+    uint32_t stolen_from_worker = 0;
+};
+
+struct WorkerConn {
+    uint32_t id = 0;
+    std::string address;
+    WsStream ws;
+    std::mutex ws_mutex;  // guards fd swaps; frame writes serialize internally
+    std::atomic<bool> connected{true};
+    std::atomic<bool> evicted{false};
+    std::atomic<int> generation{0};
+    std::atomic<double> last_heartbeat_response;
+    double last_heartbeat_sent = 0;  // scheduler-thread only
+    std::deque<FrameOnWorker> queue;  // guarded by the master's state mutex
+    std::thread reader;
+    Json trace;  // filled by collect_traces
+    bool trace_ok = false;
+
+    WorkerConn() { last_heartbeat_response.store(now_ts()); }
+};
+
+// ---------------------------------------------------------------------------
+// Assignment service client (the JAX auction solver subprocess; protocol:
+// tpu_render_cluster/master/assignment_service.py — one JSON object per
+// line on stdin, one per line on stdout).
+
+class AssignmentService {
+  public:
+    ~AssignmentService() { stop(); }
+
+    bool start(const std::string& python_binary) {
+        int to_child[2];
+        int from_child[2];
+        if (pipe(to_child) != 0 || pipe(from_child) != 0) return false;
+        pid_ = fork();
+        if (pid_ < 0) return false;
+        if (pid_ == 0) {
+            dup2(to_child[0], 0);
+            dup2(from_child[1], 1);
+            ::close(to_child[0]);
+            ::close(to_child[1]);
+            ::close(from_child[0]);
+            ::close(from_child[1]);
+            execlp(python_binary.c_str(), python_binary.c_str(), "-m",
+                   "tpu_render_cluster.master.assignment_service",
+                   (char*)nullptr);
+            _exit(127);
+        }
+        ::close(to_child[0]);
+        ::close(from_child[1]);
+        write_fd_ = to_child[1];
+        read_fd_ = from_child[0];
+        started_ = true;
+        LOG_INFO("Assignment service starting (pid %d).", int(pid_));
+        return true;
+    }
+
+    // Non-blocking readiness poll: the service prints {"ready": true} once
+    // the JAX solver is warmed up (first compile can take tens of seconds).
+    bool poll_ready() {
+        if (ready_) return true;
+        if (!started_ || dead_) return false;
+        std::string line;
+        while (read_line_nonblocking(&line)) {
+            Json message;
+            if (json_parse(line, &message)) {
+                const Json* ready = message.get("ready");
+                if (ready != nullptr && ready->boolean) {
+                    ready_ = true;
+                    LOG_INFO("Assignment service ready (TPU solver warm).");
+                    return true;
+                }
+            }
+        }
+        return false;
+    }
+
+    // Blocking solve with timeout; returns false on any failure (the caller
+    // falls back to the greedy host solve for THIS tick only). Requests are
+    // id-tagged so a late response to a timed-out solve is discarded rather
+    // than mis-paired with the next request; a timeout does NOT kill the
+    // service — only pipe errors do.
+    bool solve(const std::vector<std::vector<float>>& cost,
+               std::vector<int>* assignment, double timeout_s = 10.0) {
+        if (!ready_ || dead_) return false;
+        uint64_t request_id = next_request_id_++;
+        Json request = Json::make_object();
+        request.set("id", Json::make_uint(request_id));
+        Json rows = Json::make_array();
+        for (const auto& row : cost) {
+            Json r = Json::make_array();
+            for (float v : row) r.arr.push_back(Json::make_double(v));
+            rows.arr.push_back(std::move(r));
+        }
+        request.set("cost", std::move(rows));
+        std::string line = json_dumps(request) + "\n";
+        if (write(write_fd_, line.data(), line.size()) != ssize_t(line.size())) {
+            mark_dead();
+            return false;
+        }
+        double deadline = now_ts() + timeout_s;
+        std::string response;
+        while (now_ts() < deadline) {
+            if (!read_line_blocking(&response, deadline - now_ts())) {
+                return false;  // timeout: stale response discarded on arrival
+            }
+            Json parsed;
+            if (!json_parse(response, &parsed)) continue;
+            const Json* id = parsed.get("id");
+            if (id == nullptr || id->as_u64() != request_id) continue;  // stale
+            const Json* result = parsed.get("assignment");
+            if (result == nullptr || result->type != Json::ARR) return false;
+            assignment->clear();
+            for (const Json& v : result->arr)
+                assignment->push_back(int(v.as_i64()));
+            return true;
+        }
+        return false;
+    }
+
+    void stop() {
+        if (!started_) return;
+        if (write_fd_ >= 0) {
+            const char* bye = "{\"op\":\"exit\"}\n";
+            ssize_t ignored = write(write_fd_, bye, strlen(bye));
+            (void)ignored;
+            ::close(write_fd_);
+            write_fd_ = -1;
+        }
+        if (read_fd_ >= 0) {
+            ::close(read_fd_);
+            read_fd_ = -1;
+        }
+        if (pid_ > 0) {
+            int status = 0;
+            for (int i = 0; i < 20; i++) {
+                if (waitpid(pid_, &status, WNOHANG) == pid_) {
+                    pid_ = -1;
+                    break;
+                }
+                std::this_thread::sleep_for(std::chrono::milliseconds(100));
+            }
+            if (pid_ > 0) {
+                kill(pid_, SIGKILL);
+                waitpid(pid_, &status, 0);
+                pid_ = -1;
+            }
+        }
+        started_ = false;
+    }
+
+    bool ready() const { return ready_ && !dead_; }
+
+  private:
+    pid_t pid_ = -1;
+    int write_fd_ = -1;
+    int read_fd_ = -1;
+    bool started_ = false;
+    bool ready_ = false;
+    bool dead_ = false;
+    uint64_t next_request_id_ = 1;
+    std::string pending_;
+
+    void mark_dead() {
+        if (!dead_) LOG_WARN("Assignment service died; using greedy fallback.");
+        dead_ = true;
+    }
+
+    bool extract_line(std::string* line) {
+        size_t eol = pending_.find('\n');
+        if (eol == std::string::npos) return false;
+        *line = pending_.substr(0, eol);
+        pending_.erase(0, eol + 1);
+        return true;
+    }
+
+    bool read_line_nonblocking(std::string* line) {
+        if (extract_line(line)) return true;
+        fd_set fds;
+        FD_ZERO(&fds);
+        FD_SET(read_fd_, &fds);
+        struct timeval tv = {0, 0};
+        if (select(read_fd_ + 1, &fds, nullptr, nullptr, &tv) <= 0) return false;
+        char chunk[4096];
+        ssize_t n = read(read_fd_, chunk, sizeof(chunk));
+        if (n <= 0) {
+            mark_dead();
+            return false;
+        }
+        pending_.append(chunk, size_t(n));
+        return extract_line(line);
+    }
+
+    bool read_line_blocking(std::string* line, double timeout_s) {
+        double deadline = now_ts() + timeout_s;
+        for (;;) {
+            if (extract_line(line)) return true;
+            double remaining = deadline - now_ts();
+            if (remaining <= 0) return false;
+            fd_set fds;
+            FD_ZERO(&fds);
+            FD_SET(read_fd_, &fds);
+            struct timeval tv;
+            tv.tv_sec = long(remaining);
+            tv.tv_usec = long((remaining - double(tv.tv_sec)) * 1e6);
+            int rc = select(read_fd_ + 1, &fds, nullptr, nullptr, &tv);
+            if (rc <= 0) return false;
+            char chunk[4096];
+            ssize_t n = read(read_fd_, chunk, sizeof(chunk));
+            if (n <= 0) {
+                mark_dead();
+                return false;
+            }
+            pending_.append(chunk, size_t(n));
+        }
+    }
+};
+
+// Greedy host fallback, mirroring
+// tpu_render_cluster/ops/assignment.py _greedy_fallback.
+static std::vector<int> greedy_assignment(
+    const std::vector<std::vector<float>>& cost) {
+    size_t n_items = cost.size();
+    size_t n_slots = n_items > 0 ? cost[0].size() : 0;
+    std::vector<int> order(n_items);
+    for (size_t i = 0; i < n_items; i++) order[i] = int(i);
+    std::vector<float> row_min(n_items, 0.f);
+    for (size_t i = 0; i < n_items; i++) {
+        row_min[i] = *std::min_element(cost[i].begin(), cost[i].end());
+    }
+    std::sort(order.begin(), order.end(),
+              [&](int a, int b) { return row_min[a] < row_min[b]; });
+    std::vector<bool> taken(n_slots, false);
+    std::vector<int> out(n_items, -1);
+    for (int item : order) {
+        float best = std::numeric_limits<float>::infinity();
+        int best_slot = -1;
+        for (size_t s = 0; s < n_slots; s++) {
+            if (!taken[s] && cost[item][s] < best) {
+                best = cost[item][s];
+                best_slot = int(s);
+            }
+        }
+        out[item] = best_slot;
+        if (best_slot >= 0) taken[best_slot] = true;
+    }
+    return out;
+}
+
+// ---------------------------------------------------------------------------
+// Master daemon
+
+struct MasterOptions {
+    std::string host = "0.0.0.0";
+    int port = 9901;
+    std::string log_file_path;
+    std::string job_path;
+    std::string results_directory = "results";
+    std::string python_binary = "python3";
+    double evict_after_seconds = 120.0;  // 0 disables (reference behavior)
+    double heartbeat_interval_s = 10.0;  // reference: master/src/connection/mod.rs:36
+    double heartbeat_warn_s = 60.0;      // reference receiver default timeout
+};
+
+class MasterDaemon {
+  public:
+    MasterDaemon(MasterOptions options, JobView job)
+        : options_(std::move(options)), job_(std::move(job)) {
+        for (int i = job_.frame_from; i <= job_.frame_to; i++) {
+            FrameSlot slot;
+            slot.frame_index = i;
+            frames_.push_back(slot);
+        }
+    }
+
+    int run() {
+        if (!bind_and_listen()) return 1;
+        acceptor_ = std::thread(&MasterDaemon::accept_loop, this);
+
+        LOG_INFO("Waiting for %d workers...", job_.wait_for_workers);
+        // Barrier (reference: master/src/cluster/mod.rs:568-585, 1 s poll).
+        while (!cancelled_.load()) {
+            {
+                std::lock_guard<std::mutex> lock(workers_mutex_);
+                if (int(workers_.size()) >= job_.wait_for_workers) break;
+            }
+            std::this_thread::sleep_for(std::chrono::seconds(1));
+        }
+        LOG_INFO("Worker barrier met; starting job '%s' (%d frames, %s).",
+                 job_.name.c_str(), job_.frame_count(), job_.strategy.c_str());
+
+        job_start_time_ = now_ts();
+        job_started_.store(true);
+        broadcast_job_started();
+
+        heartbeat_thread_ = std::thread(&MasterDaemon::heartbeat_loop, this);
+
+        if (job_.strategy == "tpu-batch") {
+            assignment_.start(options_.python_binary);
+        }
+
+        bool completed = run_strategy();
+        job_finish_time_ = now_ts();
+
+        std::vector<std::pair<std::string, Json>> traces;
+        if (completed) collect_traces(&traces);
+
+        cancelled_.store(true);
+        assignment_.stop();
+        if (heartbeat_thread_.joinable()) heartbeat_thread_.join();
+        shutdown_listener();
+        if (acceptor_.joinable()) acceptor_.join();
+        {
+            // Bounded by the 15 s handshake receive timeout.
+            std::lock_guard<std::mutex> lock(handshake_mutex_);
+            for (auto& thread : handshake_threads_) {
+                if (thread.joinable()) thread.join();
+            }
+        }
+        join_readers();
+
+        if (!completed) {
+            LOG_ERROR("Job did not complete (all workers lost?).");
+            return 1;
+        }
+        persist_results(traces);
+        return 0;
+    }
+
+  private:
+    MasterOptions options_;
+    JobView job_;
+    int listen_fd_ = -1;
+    std::thread acceptor_;
+    std::thread heartbeat_thread_;
+    std::atomic<bool> cancelled_{false};
+    std::atomic<bool> job_started_{false};
+    double job_start_time_ = 0;
+    double job_finish_time_ = 0;
+
+    std::mutex state_mutex_;  // guards frames_ + every worker's queue mirror
+    std::vector<FrameSlot> frames_;
+    size_t next_pending_hint_ = 0;  // O(1) amortized scan (reference is O(n)
+                                    // per tick — state.rs:63-70, a known
+                                    // scaling bottleneck, SURVEY.md §5.7)
+    int finished_count_ = 0;
+
+    std::mutex workers_mutex_;
+    std::map<uint32_t, std::unique_ptr<WorkerConn>> workers_;
+
+    std::mutex handshake_mutex_;
+    std::vector<std::thread> handshake_threads_;
+
+    std::mutex responses_mutex_;
+    std::condition_variable responses_cv_;
+    std::map<uint64_t, Json> responses_;
+
+    AssignmentService assignment_;
+    // tpu-batch cost model: per-worker EMA of observed frame seconds
+    // (tpu_render_cluster/master/tpu_batch.py WorkerCostModel).
+    std::map<uint32_t, double> frame_time_ema_;
+    std::mutex observations_mutex_;
+    std::vector<std::pair<uint32_t, double>> completion_observations_;
+
+    // -- networking ----------------------------------------------------------
+
+    bool bind_and_listen() {
+        listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
+        if (listen_fd_ < 0) return false;
+        int one = 1;
+        setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+        struct sockaddr_in addr;
+        memset(&addr, 0, sizeof(addr));
+        addr.sin_family = AF_INET;
+        addr.sin_port = htons(uint16_t(options_.port));
+        if (options_.host == "0.0.0.0" || options_.host.empty()) {
+            addr.sin_addr.s_addr = INADDR_ANY;
+        } else if (inet_pton(AF_INET, options_.host.c_str(), &addr.sin_addr) != 1) {
+            LOG_ERROR("Bad --host: %s", options_.host.c_str());
+            return false;
+        }
+        if (bind(listen_fd_, reinterpret_cast<struct sockaddr*>(&addr),
+                 sizeof(addr)) != 0) {
+            LOG_ERROR("bind(%s:%d) failed: %s", options_.host.c_str(),
+                      options_.port, strerror(errno));
+            return false;
+        }
+        if (listen(listen_fd_, 64) != 0) return false;
+        LOG_INFO("Listening on %s:%d.", options_.host.c_str(), options_.port);
+        return true;
+    }
+
+    void shutdown_listener() {
+        if (listen_fd_ >= 0) {
+            ::shutdown(listen_fd_, SHUT_RDWR);
+            ::close(listen_fd_);
+            listen_fd_ = -1;
+        }
+    }
+
+    // Accept loop with 2 s cancellation poll
+    // (reference: master/src/cluster/mod.rs:280-318).
+    void accept_loop() {
+        while (!cancelled_.load()) {
+            fd_set fds;
+            FD_ZERO(&fds);
+            FD_SET(listen_fd_, &fds);
+            struct timeval tv = {2, 0};
+            int rc = select(listen_fd_ + 1, &fds, nullptr, nullptr, &tv);
+            if (rc < 0) {
+                if (errno == EINTR) continue;
+                return;
+            }
+            if (rc == 0) continue;
+            struct sockaddr_in peer;
+            socklen_t peer_len = sizeof(peer);
+            int fd = accept(listen_fd_, reinterpret_cast<struct sockaddr*>(&peer),
+                            &peer_len);
+            if (fd < 0) continue;
+            char ip[64];
+            inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof(ip));
+            std::string address =
+                std::string(ip) + ":" + std::to_string(ntohs(peer.sin_port));
+            // Handshakes run in their own bounded thread so a stalled client
+            // (connects, never upgrades) cannot wedge worker admission: a
+            // 15 s receive timeout caps each handshake, cleared again once
+            // the worker is admitted.
+            struct timeval handshake_timeout = {15, 0};
+            setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &handshake_timeout,
+                       sizeof(handshake_timeout));
+            std::lock_guard<std::mutex> lock(handshake_mutex_);
+            handshake_threads_.emplace_back(
+                &MasterDaemon::initialize_worker_connection, this, fd, address);
+        }
+    }
+
+    // WS upgrade + 3-step application handshake
+    // (reference: master/src/cluster/mod.rs:318-481).
+    void initialize_worker_connection(int fd, const std::string& address) {
+        auto conn = std::make_unique<WsStream>();
+        conn->adopt_fd(fd, /*mask_outgoing=*/false);  // RFC 6455 §5.1: servers
+                                                      // do not mask
+        std::string request;
+        if (!conn->read_http_headers(&request)) return;
+        std::string key;
+        {
+            // Case-insensitive Sec-WebSocket-Key lookup.
+            std::string lower = lowercase_ascii(request);
+            size_t at = lower.find("sec-websocket-key:");
+            if (at == std::string::npos) return;
+            size_t start = at + strlen("sec-websocket-key:");
+            size_t eol = request.find("\r\n", start);
+            key = trim(request.substr(start, eol - start));
+        }
+        char accept_value[32];
+        if (trc_accept_key(key.c_str(), accept_value, sizeof(accept_value)) == 0)
+            return;
+        char response[256];
+        snprintf(response, sizeof(response),
+                 "HTTP/1.1 101 Switching Protocols\r\n"
+                 "Upgrade: websocket\r\n"
+                 "Connection: Upgrade\r\n"
+                 "Sec-WebSocket-Accept: %s\r\n"
+                 "\r\n",
+                 accept_value);
+        if (!conn->write_all(reinterpret_cast<const uint8_t*>(response),
+                             strlen(response)))
+            return;
+
+        // App handshake: request -> response -> ack.
+        Json payload = Json::make_object();
+        payload.set("server_version", Json::make_string("1.0.0"));
+        if (!send_on(*conn, "handshake_request", std::move(payload))) return;
+
+        std::string text;
+        if (!conn->receive_text(&text)) return;
+        Json message;
+        if (!json_parse(text, &message)) return;
+        const Json* tag = message.get("message_type");
+        const Json* body = message.get("payload");
+        if (tag == nullptr || tag->as_string() != "handshake_response" ||
+            body == nullptr)
+            return;
+        const Json* type = body->get("handshake_type");
+        const Json* worker_id = body->get("worker_id");
+        if (type == nullptr || worker_id == nullptr) return;
+        uint32_t id = uint32_t(worker_id->as_u64());
+
+        if (type->as_string() == "reconnecting") {
+            // Socket swap into the existing worker
+            // (reference: master/src/cluster/mod.rs:453-477).
+            std::lock_guard<std::mutex> lock(workers_mutex_);
+            auto it = workers_.find(id);
+            bool known = it != workers_.end() && !it->second->evicted.load();
+            Json ack = Json::make_object();
+            ack.set("ok", Json::make_bool(known));
+            send_on(*conn, "handshake_acknowledgement", std::move(ack));
+            if (!known) {
+                LOG_WARN("Unknown/evicted worker %08x tried to reconnect.", id);
+                return;
+            }
+            WorkerConn& worker = *it->second;
+            {
+                std::lock_guard<std::mutex> ws_lock(worker.ws_mutex);
+                if (worker.reader.joinable()) {
+                    worker.ws.shutdown_socket();
+                }
+            }
+            if (worker.reader.joinable()) worker.reader.join();
+            {
+                std::lock_guard<std::mutex> ws_lock(worker.ws_mutex);
+                worker.ws.adopt_from(*conn, /*mask_outgoing=*/false);
+                clear_receive_timeout(worker.ws.fd());
+                worker.address = address;
+                worker.connected.store(true);
+                worker.last_heartbeat_response.store(now_ts());
+            }
+            int generation = worker.generation.fetch_add(1) + 1;
+            worker.reader =
+                std::thread(&MasterDaemon::reader_loop, this, &worker, generation);
+            LOG_INFO("Worker %08x reconnected from %s.", id, address.c_str());
+            return;
+        }
+
+        // First connection: build the worker façade
+        // (reference: master/src/connection/mod.rs:80-262).
+        Json ack = Json::make_object();
+        ack.set("ok", Json::make_bool(true));
+        if (!send_on(*conn, "handshake_acknowledgement", std::move(ack))) return;
+
+        auto worker = std::make_unique<WorkerConn>();
+        worker->id = id;
+        worker->address = address;
+        worker->ws.adopt_from(*conn, /*mask_outgoing=*/false);
+        clear_receive_timeout(worker->ws.fd());
+        WorkerConn* raw = worker.get();
+        {
+            std::lock_guard<std::mutex> lock(workers_mutex_);
+            workers_[id] = std::move(worker);
+        }
+        raw->reader = std::thread(&MasterDaemon::reader_loop, this, raw, 0);
+        LOG_INFO("Worker %08x connected from %s.", id, address.c_str());
+
+        // Beyond-reference: late joiners still get the job-started event
+        // (the reference acknowledges this hole — master/src/cluster/mod.rs:616).
+        if (job_started_.load()) {
+            send_to_worker(*raw, "event_job-started", Json::make_object());
+        }
+    }
+
+    static void clear_receive_timeout(int fd) {
+        struct timeval forever = {0, 0};
+        setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &forever, sizeof(forever));
+    }
+
+    bool send_on(WsStream& conn, const std::string& type, Json payload) {
+        Json envelope = Json::make_object();
+        envelope.set("message_type", Json::make_string(type));
+        envelope.set("payload", std::move(payload));
+        return conn.send_text(json_dumps(envelope));
+    }
+
+    bool send_to_worker(WorkerConn& worker, const std::string& type,
+                        Json payload) {
+        std::lock_guard<std::mutex> lock(worker.ws_mutex);
+        if (!worker.ws.is_open()) return false;
+        return send_on(worker.ws, type, std::move(payload));
+    }
+
+    // -- reader ---------------------------------------------------------------
+
+    void reader_loop(WorkerConn* worker, int generation) {
+        for (;;) {
+            std::string text;
+            if (!worker->ws.receive_text(&text)) {
+                if (worker->generation.load() != generation) return;  // swapped
+                worker->connected.store(false);
+                if (!cancelled_.load()) {
+                    LOG_WARN("Worker %08x disconnected.", worker->id);
+                }
+                return;  // a reconnect spawns a fresh reader
+            }
+            double received_at = now_ts();
+            Json message;
+            if (!json_parse(text, &message)) {
+                LOG_WARN("Dropping malformed frame from %08x.", worker->id);
+                continue;
+            }
+            const Json* tag = message.get("message_type");
+            const Json* payload = message.get("payload");
+            if (tag == nullptr) continue;
+            static const Json kEmpty = Json::make_object();
+            dispatch(worker, tag->as_string(),
+                     payload != nullptr ? *payload : kEmpty, received_at);
+        }
+    }
+
+    void dispatch(WorkerConn* worker, const std::string& type,
+                  const Json& payload, double received_at) {
+        if (type == "response_heartbeat") {
+            worker->last_heartbeat_response.store(received_at);
+        } else if (type == "response_frame-queue-add" ||
+                   type == "response_frame-queue_remove" ||
+                   type == "response_job-finished") {
+            const Json* context = payload.get("message_request_context_id");
+            if (context == nullptr) return;
+            std::lock_guard<std::mutex> lock(responses_mutex_);
+            responses_[context->as_u64()] = payload;
+            responses_cv_.notify_all();
+        } else if (type == "event_frame-queue_item-started-rendering") {
+            const Json* frame = payload.get("frame_index");
+            if (frame == nullptr) return;
+            mark_frame_rendering(worker, int(frame->as_i64()), received_at);
+        } else if (type == "event_frame-queue_item-finished") {
+            const Json* frame = payload.get("frame_index");
+            const Json* result = payload.get("result");
+            if (frame == nullptr) return;
+            bool ok = true;
+            if (result != nullptr) {
+                const Json* value = result->get("result");
+                ok = value != nullptr && value->as_string() == "ok";
+            }
+            mark_frame_finished(worker, int(frame->as_i64()), ok, received_at);
+        } else {
+            LOG_WARN("Unhandled message type from %08x: %s", worker->id,
+                     type.c_str());
+        }
+    }
+
+    // -- frame state transitions (reference: state.rs:82-128) ----------------
+
+    FrameSlot* slot_for(int frame_index) {
+        int offset = frame_index - job_.frame_from;
+        if (offset < 0 || offset >= int(frames_.size())) return nullptr;
+        return &frames_[size_t(offset)];
+    }
+
+    void mark_frame_rendering(WorkerConn* worker, int frame_index, double at) {
+        std::lock_guard<std::mutex> lock(state_mutex_);
+        FrameSlot* slot = slot_for(frame_index);
+        if (slot != nullptr && slot->status == FrameStatus::Queued) {
+            slot->status = FrameStatus::Rendering;
+        }
+        for (auto& entry : worker->queue) {
+            if (entry.frame_index == frame_index) {
+                entry.rendering = true;
+                entry.rendering_started_at = at;
+            }
+        }
+    }
+
+    void mark_frame_finished(WorkerConn* worker, int frame_index, bool ok,
+                             double at) {
+        std::lock_guard<std::mutex> lock(state_mutex_);
+        FrameSlot* slot = slot_for(frame_index);
+        double started_at = 0;
+        for (auto it = worker->queue.begin(); it != worker->queue.end(); ++it) {
+            if (it->frame_index == frame_index) {
+                started_at =
+                    it->rendering_started_at > 0 ? it->rendering_started_at
+                                                 : it->queued_at;
+                worker->queue.erase(it);
+                break;
+            }
+        }
+        if (slot == nullptr) return;
+        if (ok) {
+            if (slot->status != FrameStatus::Finished) {
+                slot->status = FrameStatus::Finished;
+                finished_count_++;
+            }
+            if (started_at > 0) {
+                std::lock_guard<std::mutex> obs_lock(observations_mutex_);
+                completion_observations_.emplace_back(worker->id,
+                                                      at - started_at);
+            }
+        } else {
+            // Beyond-reference: errored frames return to the pending pool
+            // instead of hanging the job (SURVEY.md §7 hard parts #6).
+            LOG_WARN("Frame %d errored on %08x; returning to pending.",
+                     frame_index, worker->id);
+            slot->status = FrameStatus::Pending;
+            slot->worker = 0;
+            next_pending_hint_ = 0;
+        }
+    }
+
+    bool all_frames_finished() {
+        std::lock_guard<std::mutex> lock(state_mutex_);
+        return finished_count_ == int(frames_.size());
+    }
+
+    // Returns up to `limit` pending frame indices (state scan with a moving
+    // hint; the errored/evicted requeue path resets the hint).
+    std::vector<int> pending_frames(size_t limit) {
+        std::vector<int> out;
+        std::lock_guard<std::mutex> lock(state_mutex_);
+        for (size_t i = next_pending_hint_; i < frames_.size() && out.size() < limit;
+             i++) {
+            if (frames_[i].status == FrameStatus::Pending) {
+                out.push_back(frames_[i].frame_index);
+            } else if (out.empty()) {
+                next_pending_hint_ = i + 1;
+            }
+        }
+        return out;
+    }
+
+    // -- RPC ------------------------------------------------------------------
+
+    // Waits in 500 ms slices so a dead peer can't pin the caller for the
+    // full protocol timeout: bails once the worker stays disconnected past
+    // the reference's 30 s max spin-wait delay
+    // (reference: master/src/cluster/mod.rs:125-223) or is evicted.
+    bool rpc(WorkerConn& worker, const std::string& type, Json payload,
+             uint64_t request_id, double timeout_s, Json* response) {
+        payload.set("message_request_id", Json::make_uint(request_id));
+        if (!send_to_worker(worker, type, std::move(payload))) return false;
+        double deadline = now_ts() + timeout_s;
+        double disconnected_since = -1;
+        std::unique_lock<std::mutex> lock(responses_mutex_);
+        for (;;) {
+            if (responses_.count(request_id) != 0) {
+                *response = responses_[request_id];
+                responses_.erase(request_id);
+                return true;
+            }
+            if (cancelled_.load() || worker.evicted.load()) return false;
+            double now = now_ts();
+            if (now >= deadline) return false;
+            if (!worker.connected.load()) {
+                if (disconnected_since < 0) {
+                    disconnected_since = now;
+                } else if (now - disconnected_since > 30.0) {
+                    return false;
+                }
+            } else {
+                disconnected_since = -1;
+            }
+            responses_cv_.wait_for(lock, std::chrono::milliseconds(500));
+        }
+    }
+
+    // queue_frame (reference: master/src/connection/mod.rs:139-168): mark
+    // queued optimistically, RPC, revert on failure.
+    bool queue_frame(WorkerConn& worker, int frame_index, bool stolen = false,
+                     uint32_t stolen_from = 0) {
+        {
+            std::lock_guard<std::mutex> lock(state_mutex_);
+            FrameSlot* slot = slot_for(frame_index);
+            if (slot == nullptr || (slot->status != FrameStatus::Pending &&
+                                    !stolen))
+                return false;
+            slot->status = FrameStatus::Queued;
+            slot->worker = worker.id;
+        }
+        Json payload = Json::make_object();
+        payload.set("job", job_.json);
+        payload.set("frame_index", Json::make_int(frame_index));
+        uint64_t request_id = rng()();
+        Json response;
+        bool ok = rpc(worker, "request_frame-queue_add", std::move(payload),
+                      request_id, 60.0, &response);
+        if (ok) {
+            const Json* result = response.get("result");
+            const Json* value =
+                result != nullptr ? result->get("result") : nullptr;
+            ok = value != nullptr && value->as_string() == "added-to-queue";
+        }
+        std::lock_guard<std::mutex> lock(state_mutex_);
+        FrameSlot* slot = slot_for(frame_index);
+        if (ok) {
+            FrameOnWorker entry;
+            entry.frame_index = frame_index;
+            entry.queued_at = now_ts();
+            entry.stolen = stolen;
+            entry.stolen_from_worker = stolen_from;
+            worker.queue.push_back(entry);
+        } else if (slot != nullptr && slot->status == FrameStatus::Queued &&
+                   slot->worker == worker.id) {
+            slot->status = FrameStatus::Pending;
+            slot->worker = 0;
+            next_pending_hint_ = 0;
+        }
+        return ok;
+    }
+
+    // -- job lifecycle --------------------------------------------------------
+
+    void broadcast_job_started() {
+        std::lock_guard<std::mutex> lock(workers_mutex_);
+        for (auto& pair : workers_) {
+            send_to_worker(*pair.second, "event_job-started",
+                           Json::make_object());
+        }
+    }
+
+    std::vector<WorkerConn*> live_workers() {
+        std::vector<WorkerConn*> out;
+        std::lock_guard<std::mutex> lock(workers_mutex_);
+        for (auto& pair : workers_) {
+            if (!pair.second->evicted.load()) out.push_back(pair.second.get());
+        }
+        return out;
+    }
+
+    // Heartbeat loop: ping every worker every 10 s, 2 s check interval
+    // (reference: master/src/connection/mod.rs:327-370); evict after
+    // --evictAfterSeconds without a response (beyond-reference, §5.3).
+    void heartbeat_loop() {
+        // A short eviction window needs a proportionally faster ping cadence,
+        // or healthy workers would accrue >window "silence" between pings.
+        double interval = options_.heartbeat_interval_s;
+        if (options_.evict_after_seconds > 0) {
+            interval = std::max(0.5, std::min(interval,
+                                              options_.evict_after_seconds / 3));
+        }
+        double check_every = std::min(2.0, interval);
+        while (!cancelled_.load()) {
+            double now = now_ts();
+            for (WorkerConn* worker : live_workers()) {
+                if (now - worker->last_heartbeat_sent >= interval) {
+                    worker->last_heartbeat_sent = now;
+                    Json payload = Json::make_object();
+                    payload.set("request_time", Json::make_double(now));
+                    send_to_worker(*worker, "request_heartbeat",
+                                   std::move(payload));
+                }
+                // Silence counts from whichever is latest: the last response
+                // or the job start (workers idle through the barrier wait
+                // were never pinged and must not be evicted for it).
+                double silence =
+                    now - std::max(worker->last_heartbeat_response.load(),
+                                   job_start_time_);
+                if (silence > options_.heartbeat_warn_s) {
+                    LOG_WARN("Worker %08x silent for %.0f s.", worker->id,
+                             silence);
+                }
+                if (options_.evict_after_seconds > 0 &&
+                    silence > options_.evict_after_seconds) {
+                    evict_worker(worker);
+                }
+            }
+            std::this_thread::sleep_for(
+                std::chrono::milliseconds(int64_t(check_every * 1000)));
+        }
+    }
+
+    void evict_worker(WorkerConn* worker) {
+        LOG_ERROR("Evicting dead worker %08x; requeueing its frames.",
+                  worker->id);
+        worker->evicted.store(true);
+        worker->connected.store(false);
+        {
+            std::lock_guard<std::mutex> lock(worker->ws_mutex);
+            worker->ws.shutdown_socket();
+        }
+        std::lock_guard<std::mutex> lock(state_mutex_);
+        for (const auto& entry : worker->queue) {
+            FrameSlot* slot = slot_for(entry.frame_index);
+            if (slot != nullptr && slot->status != FrameStatus::Finished) {
+                slot->status = FrameStatus::Pending;
+                slot->worker = 0;
+            }
+        }
+        worker->queue.clear();
+        next_pending_hint_ = 0;
+    }
+
+    // -- strategies (reference: master/src/cluster/strategies.rs:16-405) -----
+
+    bool run_strategy() {
+        if (job_.strategy == "naive-fine") return naive_fine_loop();
+        if (job_.strategy == "eager-naive-coarse") return eager_loop();
+        if (job_.strategy == "dynamic") return dynamic_loop(false);
+        if (job_.strategy == "tpu-batch") return tpu_batch_loop();
+        LOG_ERROR("Unknown strategy '%s'.", job_.strategy.c_str());
+        return false;
+    }
+
+    bool cluster_alive() {
+        for (WorkerConn* worker : live_workers()) {
+            (void)worker;
+            return true;
+        }
+        return false;
+    }
+
+    size_t queue_size(WorkerConn* worker) {
+        std::lock_guard<std::mutex> lock(state_mutex_);
+        return worker->queue.size();
+    }
+
+    // naive-fine: 50 ms tick, 1 frame to any empty worker (strategies.rs:16-68).
+    bool naive_fine_loop() {
+        while (!cancelled_.load()) {
+            if (all_frames_finished()) return true;
+            if (!cluster_alive()) return false;
+            for (WorkerConn* worker : live_workers()) {
+                if (queue_size(worker) > 0) continue;
+                std::vector<int> pending = pending_frames(1);
+                if (pending.empty()) break;
+                queue_frame(*worker, pending[0]);
+            }
+            std::this_thread::sleep_for(std::chrono::milliseconds(50));
+        }
+        return false;
+    }
+
+    // eager-naive-coarse: 100 ms tick, top up to target (strategies.rs:70-150).
+    bool eager_loop() {
+        while (!cancelled_.load()) {
+            if (all_frames_finished()) return true;
+            if (!cluster_alive()) return false;
+            for (WorkerConn* worker : live_workers()) {
+                size_t size = queue_size(worker);
+                while (int(size) < job_.target_queue_size) {
+                    std::vector<int> pending = pending_frames(1);
+                    if (pending.empty()) break;
+                    if (!queue_frame(*worker, pending[0])) break;
+                    size++;
+                }
+            }
+            std::this_thread::sleep_for(std::chrono::milliseconds(100));
+        }
+        return false;
+    }
+
+    // Finds (victim, frame) per the dynamic strategy's rules: skip the first
+    // min_queue_size_to_steal entries, respect both resteal timers, prefer
+    // the longest-queued candidate, busiest victim first
+    // (reference: strategies.rs:155-248).
+    bool find_frame_to_steal(WorkerConn* thief,
+                             const std::vector<WorkerConn*>& workers,
+                             WorkerConn** victim_out, int* frame_out) {
+        std::lock_guard<std::mutex> lock(state_mutex_);
+        std::vector<WorkerConn*> by_size(workers);
+        std::sort(by_size.begin(), by_size.end(),
+                  [](WorkerConn* a, WorkerConn* b) {
+                      return a->queue.size() > b->queue.size();
+                  });
+        double now = now_ts();
+        for (WorkerConn* victim : by_size) {
+            if (victim == thief) continue;
+            if (int(victim->queue.size()) <= job_.min_queue_size_to_steal)
+                continue;
+            const FrameOnWorker* best = nullptr;
+            for (size_t i = size_t(job_.min_queue_size_to_steal);
+                 i < victim->queue.size(); i++) {
+                const FrameOnWorker& candidate = victim->queue[i];
+                if (candidate.rendering) continue;
+                if (candidate.stolen) {
+                    double age = now - candidate.queued_at;
+                    bool to_original =
+                        candidate.stolen_from_worker == thief->id;
+                    double required = to_original ? job_.resteal_original_s
+                                                  : job_.resteal_elsewhere_s;
+                    if (age < required) continue;
+                }
+                if (best == nullptr || candidate.queued_at < best->queued_at) {
+                    best = &candidate;
+                }
+            }
+            if (best != nullptr) {
+                *victim_out = victim;
+                *frame_out = best->frame_index;
+                return true;
+            }
+        }
+        return false;
+    }
+
+    // Steal: remove-RPC on the victim (tolerating AlreadyRendering /
+    // AlreadyFinished races), then queue on the thief with provenance
+    // (reference: strategies.rs:340-396).
+    void steal_frame(WorkerConn* thief, WorkerConn* victim, int frame_index) {
+        Json payload = Json::make_object();
+        payload.set("job_name", Json::make_string(job_.name));
+        payload.set("frame_index", Json::make_int(frame_index));
+        uint64_t request_id = rng()();
+        Json response;
+        if (!rpc(*victim, "request_frame-queue_remove", std::move(payload),
+                 request_id, 60.0, &response)) {
+            return;
+        }
+        const Json* result = response.get("result");
+        const Json* value = result != nullptr ? result->get("result") : nullptr;
+        std::string outcome = value != nullptr ? value->as_string() : "errored";
+        if (outcome == "removed-from-queue") {
+            {
+                std::lock_guard<std::mutex> lock(state_mutex_);
+                for (auto it = victim->queue.begin(); it != victim->queue.end();
+                     ++it) {
+                    if (it->frame_index == frame_index) {
+                        victim->queue.erase(it);
+                        break;
+                    }
+                }
+            }
+            queue_frame(*thief, frame_index, /*stolen=*/true,
+                        /*stolen_from=*/victim->id);
+        } else if (outcome == "already-rendering") {
+            std::lock_guard<std::mutex> lock(state_mutex_);
+            for (auto& entry : victim->queue) {
+                if (entry.frame_index == frame_index) entry.rendering = true;
+            }
+        }
+        // already-finished / errored: the finished event reconciles state.
+    }
+
+    // dynamic: 50 ms tick, emptiest-first top-up, steal when pending is dry
+    // (reference: strategies.rs:250-405).
+    bool dynamic_loop(bool tpu_assign) {
+        while (!cancelled_.load()) {
+            if (all_frames_finished()) return true;
+            if (!cluster_alive()) return false;
+            std::vector<WorkerConn*> workers = live_workers();
+            std::sort(workers.begin(), workers.end(),
+                      [this](WorkerConn* a, WorkerConn* b) {
+                          return queue_size(a) < queue_size(b);
+                      });
+            for (WorkerConn* worker : workers) {
+                if (int(queue_size(worker)) >= job_.target_queue_size) continue;
+                std::vector<int> pending = pending_frames(1);
+                if (!pending.empty()) {
+                    queue_frame(*worker, pending[0]);
+                    continue;
+                }
+                WorkerConn* victim = nullptr;
+                int frame_index = 0;
+                if (find_frame_to_steal(worker, workers, &victim, &frame_index)) {
+                    steal_frame(worker, victim, frame_index);
+                }
+            }
+            std::this_thread::sleep_for(std::chrono::milliseconds(50));
+        }
+        (void)tpu_assign;
+        return false;
+    }
+
+    // tpu-batch: cost-matrix assignment each 100 ms tick; stealing fallback
+    // when the pending pool is dry (tpu_render_cluster/master/tpu_batch.py).
+    bool tpu_batch_loop() {
+        const double kDefaultFrameGuess = 5.0;
+        while (!cancelled_.load()) {
+            if (all_frames_finished()) return true;
+            if (!cluster_alive()) return false;
+            assignment_.poll_ready();
+
+            // Feed the EMA cost model from completion observations.
+            {
+                std::lock_guard<std::mutex> lock(observations_mutex_);
+                for (const auto& obs : completion_observations_) {
+                    auto it = frame_time_ema_.find(obs.first);
+                    if (it == frame_time_ema_.end()) {
+                        frame_time_ema_[obs.first] = obs.second;
+                    } else {
+                        it->second = job_.cost_ema_alpha * obs.second +
+                                     (1 - job_.cost_ema_alpha) * it->second;
+                    }
+                }
+                completion_observations_.clear();
+            }
+
+            std::vector<WorkerConn*> workers = live_workers();
+            // Slots = queue deficits: (worker, position).
+            std::vector<std::pair<WorkerConn*, int>> slots;
+            for (WorkerConn* worker : workers) {
+                int deficit = job_.target_queue_size - int(queue_size(worker));
+                for (int position = 0; position < deficit; position++) {
+                    slots.emplace_back(worker, position);
+                }
+            }
+            if (!slots.empty()) {
+                std::vector<int> frames = pending_frames(slots.size());
+                if (!frames.empty()) {
+                    // cost[i][j] = (queue_len + position + 1) * EMA(worker)
+                    // (tpu_batch.py build_cost_matrix).
+                    double median = kDefaultFrameGuess;
+                    if (!frame_time_ema_.empty()) {
+                        std::vector<double> values;
+                        for (auto& pair : frame_time_ema_)
+                            values.push_back(pair.second);
+                        std::sort(values.begin(), values.end());
+                        median = values[values.size() / 2];
+                    }
+                    std::vector<float> slot_cost(slots.size());
+                    for (size_t j = 0; j < slots.size(); j++) {
+                        WorkerConn* worker = slots[j].first;
+                        auto it = frame_time_ema_.find(worker->id);
+                        double predicted =
+                            it != frame_time_ema_.end() ? it->second : median;
+                        slot_cost[j] = float(
+                            double(queue_size(worker) + size_t(slots[j].second) +
+                                   1) *
+                            predicted);
+                    }
+                    std::vector<std::vector<float>> cost(
+                        frames.size(), std::vector<float>(slots.size()));
+                    for (size_t i = 0; i < frames.size(); i++) cost[i] = slot_cost;
+
+                    std::vector<int> result;
+                    if (!assignment_.solve(cost, &result) ||
+                        result.size() != frames.size()) {
+                        result = greedy_assignment(cost);
+                    }
+                    for (size_t i = 0; i < frames.size(); i++) {
+                        if (result[i] < 0 || result[i] >= int(slots.size()))
+                            continue;
+                        queue_frame(*slots[size_t(result[i])].first, frames[i]);
+                    }
+                    std::this_thread::sleep_for(
+                        std::chrono::milliseconds(100));
+                    continue;
+                }
+                // Pending dry -> dynamic-style stealing.
+                std::sort(workers.begin(), workers.end(),
+                          [this](WorkerConn* a, WorkerConn* b) {
+                              return queue_size(a) < queue_size(b);
+                          });
+                for (WorkerConn* thief : workers) {
+                    if (int(queue_size(thief)) >= job_.target_queue_size)
+                        continue;
+                    WorkerConn* victim = nullptr;
+                    int frame_index = 0;
+                    if (!find_frame_to_steal(thief, workers, &victim,
+                                             &frame_index))
+                        break;
+                    steal_frame(thief, victim, frame_index);
+                }
+            }
+            std::this_thread::sleep_for(std::chrono::milliseconds(100));
+        }
+        return false;
+    }
+
+    // -- trace collection + persistence (reference: master/src/main.rs) ------
+
+    void collect_traces(std::vector<std::pair<std::string, Json>>* traces) {
+        for (WorkerConn* worker : live_workers()) {
+            uint64_t request_id = rng()();
+            Json response;
+            // 600 s collect timeout (reference: requester.rs:97); rpc() bails
+            // early if the worker stays disconnected past the 30 s grace.
+            if (rpc(*worker, "request_job-finished", Json::make_object(),
+                    request_id, 600.0, &response)) {
+                const Json* trace = response.get("trace");
+                if (trace != nullptr) {
+                    worker->trace = *trace;
+                    worker->trace_ok = true;
+                }
+            } else {
+                LOG_ERROR("Failed to collect trace from %08x.", worker->id);
+            }
+            std::string address;
+            {
+                // address is rewritten by the acceptor on reconnect.
+                std::lock_guard<std::mutex> lock(worker->ws_mutex);
+                address = worker->address;
+            }
+            char key[128];
+            snprintf(key, sizeof(key), "%08x-%s", worker->id, address.c_str());
+            if (worker->trace_ok) {
+                traces->emplace_back(key, worker->trace);
+            }
+        }
+    }
+
+    void join_readers() {
+        std::lock_guard<std::mutex> lock(workers_mutex_);
+        for (auto& pair : workers_) {
+            {
+                std::lock_guard<std::mutex> ws_lock(pair.second->ws_mutex);
+                pair.second->ws.shutdown_socket();
+            }
+            if (pair.second->reader.joinable()) pair.second->reader.join();
+        }
+    }
+
+    // Per-worker performance reducer
+    // (reference: shared/src/results/performance.rs:12-144; schema:
+    // tpu_render_cluster/traces/performance.py — including its idle-time
+    // branch ordering, which skips the last frame's inter-frame gap).
+    Json reduce_performance(const Json& trace) {
+        Json out = Json::make_object();
+        const Json* frames = trace.get("frame_render_traces");
+        const Json* reconnects = trace.get("reconnection_traces");
+        double job_start =
+            trace.get("job_start_time") != nullptr
+                ? trace.get("job_start_time")->as_double()
+                : 0;
+        double job_finish =
+            trace.get("job_finish_time") != nullptr
+                ? trace.get("job_finish_time")->as_double()
+                : 0;
+        double reading = 0, rendering = 0, saving = 0, idle = 0;
+        size_t n = frames != nullptr ? frames->arr.size() : 0;
+        auto detail = [&](size_t i, const char* key) {
+            const Json* d = frames->arr[i].get("details");
+            const Json* v = d != nullptr ? d->get(key) : nullptr;
+            return v != nullptr ? v->as_double() : 0.0;
+        };
+        for (size_t i = 0; i < n; i++) {
+            reading += std::max(
+                0.0, detail(i, "finished_loading_at") -
+                         detail(i, "started_process_at"));
+            rendering += std::max(
+                0.0, detail(i, "finished_rendering_at") -
+                         detail(i, "started_rendering_at"));
+            saving += std::max(
+                0.0, detail(i, "file_saving_finished_at") -
+                         detail(i, "file_saving_started_at"));
+            if (i == 0) {
+                idle += std::max(0.0,
+                                 detail(i, "started_process_at") - job_start);
+            } else if (i == n - 1) {
+                idle += std::max(0.0,
+                                 job_finish - detail(i, "exited_process_at"));
+            } else {
+                idle += std::max(0.0, detail(i, "started_process_at") -
+                                          detail(i - 1, "exited_process_at"));
+            }
+        }
+        uint64_t queued =
+            trace.get("total_queued_frames") != nullptr
+                ? trace.get("total_queued_frames")->as_u64()
+                : 0;
+        uint64_t removed =
+            trace.get("total_queued_frames_removed_from_queue") != nullptr
+                ? trace.get("total_queued_frames_removed_from_queue")->as_u64()
+                : 0;
+        out.set("total_frames_rendered", Json::make_uint(n));
+        out.set("total_frames_queued", Json::make_uint(queued));
+        out.set("total_frames_stolen_from_queue", Json::make_uint(removed));
+        out.set("total_times_reconnected",
+                Json::make_uint(reconnects != nullptr ? reconnects->arr.size()
+                                                      : 0));
+        out.set("total_time", Json::make_double(job_finish - job_start));
+        out.set("total_blend_file_reading_time", Json::make_double(reading));
+        out.set("total_rendering_time", Json::make_double(rendering));
+        out.set("total_image_saving_time", Json::make_double(saving));
+        out.set("total_idle_time", Json::make_double(idle));
+        return out;
+    }
+
+    void persist_results(const std::vector<std::pair<std::string, Json>>& traces) {
+        make_directories(options_.results_directory);
+        // Timestamp prefix (reference: master/src/main.rs:71-75).
+        time_t start_seconds = time_t(job_start_time_);
+        struct tm tm_buffer;
+        localtime_r(&start_seconds, &tm_buffer);
+        char stamp[64];
+        strftime(stamp, sizeof(stamp), "%Y-%m-%d_%H-%M-%S", &tm_buffer);
+        std::string safe_name = job_.name;
+        for (auto& c : safe_name) {
+            if (c == ' ') c = '_';
+        }
+        std::string prefix = options_.results_directory + "/" +
+                             std::string(stamp) + "_job-" + safe_name;
+
+        Json master_trace = Json::make_object();
+        master_trace.set("job_start_time", Json::make_double(job_start_time_));
+        master_trace.set("job_finish_time", Json::make_double(job_finish_time_));
+
+        Json raw = Json::make_object();
+        raw.set("job", job_.json);
+        raw.set("master_trace", master_trace);
+        Json worker_traces = Json::make_object();
+        for (const auto& pair : traces) {
+            worker_traces.set(pair.first, pair.second);
+        }
+        raw.set("worker_traces", std::move(worker_traces));
+        std::string raw_path = prefix + "_raw-trace.json";
+        write_file(raw_path, json_dumps(raw));
+        LOG_INFO("Raw traces saved to %s", raw_path.c_str());
+
+        Json processed = Json::make_object();
+        Json performance = Json::make_object();
+        printf("============================================================\n");
+        printf("Job complete.\n");
+        printf("  Total job duration: %.2f s\n\n",
+               job_finish_time_ - job_start_time_);
+        uint64_t total_frames = 0;
+        for (const auto& pair : traces) {
+            Json reduced = reduce_performance(pair.second);
+            total_frames += reduced.get("total_frames_rendered")->as_u64();
+            printf("Worker %s:\n", pair.first.c_str());
+            printf("  frames rendered : %llu\n",
+                   (unsigned long long)reduced.get("total_frames_rendered")
+                       ->as_u64());
+            printf("  total time      : %.2f s\n",
+                   reduced.get("total_time")->as_double());
+            printf("  idle time       : %.2f s\n\n",
+                   reduced.get("total_idle_time")->as_double());
+            performance.set(pair.first, std::move(reduced));
+        }
+        processed.set("worker_performance", std::move(performance));
+        std::string processed_path = prefix + "_processed-results.json";
+        write_file(processed_path, json_dumps(processed));
+        double duration = job_finish_time_ - job_start_time_;
+        printf("Cumulative frames rendered: %llu\n",
+               (unsigned long long)total_frames);
+        if (duration > 0) {
+            printf("Throughput: %.3f frames/s\n",
+                   double(total_frames) / duration);
+        }
+        printf("============================================================\n");
+        LOG_INFO("Processed results saved to %s", processed_path.c_str());
+    }
+
+    static void write_file(const std::string& path, const std::string& content) {
+        FILE* f = fopen(path.c_str(), "wb");
+        if (f == nullptr) {
+            LOG_ERROR("Cannot write %s", path.c_str());
+            return;
+        }
+        fwrite(content.data(), 1, content.size(), f);
+        fclose(f);
+    }
+};
+
+// ---------------------------------------------------------------------------
+
+static void print_usage() {
+    fprintf(stderr,
+            "trc-master: C++ coordinator daemon for the tpu-render-cluster "
+            "protocol.\n"
+            "Usage (reference CLI: master/src/cli.rs:5-40):\n"
+            "  trc-master --host H --port P [--logFilePath F] \\\n"
+            "      run-job <job.toml> --resultsDirectory <dir>\n"
+            "Extra flags:\n"
+            "  --evictAfterSeconds N   evict workers silent for N s and requeue\n"
+            "                          their frames (0 = reference behavior:\n"
+            "                          never; default 120)\n"
+            "  --pythonBinary B        python for the tpu-batch assignment\n"
+            "                          service (default python3)\n");
+}
+
+int main(int argc, char** argv) {
+    g_log_tag = "trc-master";
+    MasterOptions options;
+    bool run_job = false;
+    for (int i = 1; i < argc; i++) {
+        std::string flag = argv[i];
+        auto next = [&]() -> std::string {
+            if (i + 1 >= argc) {
+                fprintf(stderr, "Missing value for %s\n", flag.c_str());
+                exit(2);
+            }
+            return argv[++i];
+        };
+        if (flag == "--host") options.host = next();
+        else if (flag == "--port") options.port = atoi(next().c_str());
+        else if (flag == "--logFilePath") options.log_file_path = next();
+        else if (flag == "run-job") {
+            run_job = true;
+            options.job_path = next();
+        } else if (flag == "--resultsDirectory") options.results_directory = next();
+        else if (flag == "--evictAfterSeconds")
+            options.evict_after_seconds = atof(next().c_str());
+        else if (flag == "--pythonBinary") options.python_binary = next();
+        else if (flag == "--help" || flag == "-h") {
+            print_usage();
+            return 0;
+        } else {
+            fprintf(stderr, "Unknown flag: %s\n", flag.c_str());
+            print_usage();
+            return 2;
+        }
+    }
+    if (!run_job || options.job_path.empty()) {
+        print_usage();
+        return 2;
+    }
+    if (!options.log_file_path.empty()) {
+        g_log_file = fopen(options.log_file_path.c_str(), "a");
+    }
+    Json job_json;
+    if (!parse_job_toml(options.job_path, &job_json)) return 1;
+    JobView job;
+    if (!JobView::from_json(std::move(job_json), &job)) return 1;
+    MasterDaemon daemon(std::move(options), std::move(job));
+    return daemon.run();
+}

@@ -1,0 +1,743 @@
+// Shared native runtime pieces for the trc daemons (worker + master).
+//
+// The reference keeps its common code in a Rust `shared` crate
+// (reference: shared/src/ — messages, cancellation, websockets config);
+// this header is the C++ equivalent for the daemons: exact-integer JSON
+// (protocol request ids are random u64s, shared/src/messages/utilities.rs:5-14),
+// logging, and the RFC 6455 framing core used by both the client (worker)
+// and server (master) sides. The SHA-1/base64 accept-key and frame
+// header/masking primitives live in wscodec.cpp (also exposed to Python
+// via ctypes — tpu_render_cluster/native/__init__.py).
+
+#pragma once
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/time.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cctype>
+#include <cerrno>
+#include <chrono>
+#include <cmath>
+#include <condition_variable>
+#include <cstdarg>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <set>
+#include <string>
+#include <thread>
+#include <utility>
+#include <vector>
+
+extern "C" {
+size_t trc_accept_key(const char* key, char* out, size_t out_capacity);
+void trc_mask_payload(uint8_t* data, size_t len, const uint8_t mask[4]);
+size_t trc_encode_header(uint8_t opcode, int fin, int masked,
+                         uint64_t payload_len, const uint8_t mask[4],
+                         uint8_t* out, size_t out_capacity);
+int trc_parse_header(const uint8_t* buf, size_t len, uint8_t* opcode, int* fin,
+                     int* masked, uint64_t* payload_len, uint8_t mask_out[4]);
+}
+
+// ---------------------------------------------------------------------------
+// Small utilities
+
+inline double now_ts() {
+    struct timeval tv;
+    gettimeofday(&tv, nullptr);
+    return double(tv.tv_sec) + double(tv.tv_usec) * 1e-6;
+}
+
+// Each daemon sets its tag before logging (e.g. "trc-worker" / "trc-master").
+inline const char* g_log_tag = "trc";
+inline FILE* g_log_file = nullptr;
+
+inline void log_line(const char* level, const char* fmt, ...) {
+    char message[2048];
+    va_list args;
+    va_start(args, fmt);
+    vsnprintf(message, sizeof(message), fmt, args);
+    va_end(args);
+    char stamped[2304];
+    snprintf(stamped, sizeof(stamped), "%.3f [%s] %s: %s\n", now_ts(), level,
+             g_log_tag, message);
+    fputs(stamped, stderr);
+    if (g_log_file != nullptr) {
+        fputs(stamped, g_log_file);
+        fflush(g_log_file);
+    }
+}
+
+#define LOG_INFO(...) log_line("INFO", __VA_ARGS__)
+#define LOG_WARN(...) log_line("WARN", __VA_ARGS__)
+#define LOG_ERROR(...) log_line("ERROR", __VA_ARGS__)
+
+inline std::mt19937_64& rng() {
+    static std::mt19937_64 engine(std::random_device{}());
+    return engine;
+}
+
+inline std::string base64_encode(const uint8_t* data, size_t len) {
+    static const char table[] =
+        "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+    std::string out;
+    size_t i = 0;
+    for (; i + 2 < len; i += 3) {
+        uint32_t chunk = (uint32_t(data[i]) << 16) |
+                         (uint32_t(data[i + 1]) << 8) | data[i + 2];
+        out += table[(chunk >> 18) & 63];
+        out += table[(chunk >> 12) & 63];
+        out += table[(chunk >> 6) & 63];
+        out += table[chunk & 63];
+    }
+    if (i < len) {
+        uint32_t chunk = uint32_t(data[i]) << 16;
+        bool two = i + 1 < len;
+        if (two) chunk |= uint32_t(data[i + 1]) << 8;
+        out += table[(chunk >> 18) & 63];
+        out += table[(chunk >> 12) & 63];
+        out += two ? table[(chunk >> 6) & 63] : '=';
+        out += '=';
+    }
+    return out;
+}
+
+// ---------------------------------------------------------------------------
+// Minimal JSON (parse + serialise). Integers are kept exact: the protocol's
+// request ids are random u64s (shared/src/messages/utilities.rs:5-14) and
+// must be echoed back bit-perfect, which a double round-trip would corrupt.
+
+struct Json {
+    enum Type { NUL, BOOL, INT, UINT, DOUBLE, STR, ARR, OBJ };
+    Type type = NUL;
+    bool boolean = false;
+    int64_t integer = 0;
+    uint64_t uinteger = 0;
+    double number = 0.0;
+    std::string str;
+    std::vector<Json> arr;
+    std::vector<std::pair<std::string, Json>> obj;
+
+    static Json make_null() { return Json{}; }
+    static Json make_bool(bool v) {
+        Json j;
+        j.type = BOOL;
+        j.boolean = v;
+        return j;
+    }
+    static Json make_uint(uint64_t v) {
+        Json j;
+        j.type = UINT;
+        j.uinteger = v;
+        return j;
+    }
+    static Json make_int(int64_t v) {
+        Json j;
+        j.type = INT;
+        j.integer = v;
+        return j;
+    }
+    static Json make_double(double v) {
+        Json j;
+        j.type = DOUBLE;
+        j.number = v;
+        return j;
+    }
+    static Json make_string(std::string v) {
+        Json j;
+        j.type = STR;
+        j.str = std::move(v);
+        return j;
+    }
+    static Json make_object() {
+        Json j;
+        j.type = OBJ;
+        return j;
+    }
+    static Json make_array() {
+        Json j;
+        j.type = ARR;
+        return j;
+    }
+
+    void set(const std::string& key, Json value) {
+        for (auto& pair : obj) {
+            if (pair.first == key) {
+                pair.second = std::move(value);
+                return;
+            }
+        }
+        obj.emplace_back(key, std::move(value));
+    }
+
+    const Json* get(const std::string& key) const {
+        if (type != OBJ) return nullptr;
+        for (const auto& pair : obj) {
+            if (pair.first == key) return &pair.second;
+        }
+        return nullptr;
+    }
+
+    double as_double() const {
+        switch (type) {
+            case INT: return double(integer);
+            case UINT: return double(uinteger);
+            case DOUBLE: return number;
+            default: return 0.0;
+        }
+    }
+    uint64_t as_u64() const {
+        switch (type) {
+            case INT: return uint64_t(integer);
+            case UINT: return uinteger;
+            case DOUBLE: return uint64_t(number);
+            default: return 0;
+        }
+    }
+    int64_t as_i64() const {
+        switch (type) {
+            case INT: return integer;
+            case UINT: return int64_t(uinteger);
+            case DOUBLE: return int64_t(number);
+            default: return 0;
+        }
+    }
+    const std::string& as_string() const { return str; }
+};
+
+namespace jsonparse {
+
+struct Parser {
+    const char* p;
+    const char* end;
+    bool ok = true;
+
+    explicit Parser(const std::string& text)
+        : p(text.data()), end(text.data() + text.size()) {}
+
+    void skip_ws() {
+        while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+            p++;
+    }
+
+    bool consume(char c) {
+        skip_ws();
+        if (p < end && *p == c) {
+            p++;
+            return true;
+        }
+        return false;
+    }
+
+    Json parse_value() {
+        skip_ws();
+        if (p >= end) {
+            ok = false;
+            return Json::make_null();
+        }
+        char c = *p;
+        if (c == '{') return parse_object();
+        if (c == '[') return parse_array();
+        if (c == '"') return Json::make_string(parse_string());
+        if (c == 't' || c == 'f') return parse_bool();
+        if (c == 'n') {
+            if (end - p >= 4 && strncmp(p, "null", 4) == 0) {
+                p += 4;
+                return Json::make_null();
+            }
+            ok = false;
+            return Json::make_null();
+        }
+        return parse_number();
+    }
+
+    Json parse_bool() {
+        if (end - p >= 4 && strncmp(p, "true", 4) == 0) {
+            p += 4;
+            return Json::make_bool(true);
+        }
+        if (end - p >= 5 && strncmp(p, "false", 5) == 0) {
+            p += 5;
+            return Json::make_bool(false);
+        }
+        ok = false;
+        return Json::make_null();
+    }
+
+    std::string parse_string() {
+        std::string out;
+        if (!consume('"')) {
+            ok = false;
+            return out;
+        }
+        while (p < end && *p != '"') {
+            char c = *p++;
+            if (c != '\\') {
+                out.push_back(c);
+                continue;
+            }
+            if (p >= end) break;
+            char esc = *p++;
+            switch (esc) {
+                case '"': out.push_back('"'); break;
+                case '\\': out.push_back('\\'); break;
+                case '/': out.push_back('/'); break;
+                case 'b': out.push_back('\b'); break;
+                case 'f': out.push_back('\f'); break;
+                case 'n': out.push_back('\n'); break;
+                case 'r': out.push_back('\r'); break;
+                case 't': out.push_back('\t'); break;
+                case 'u': {
+                    if (end - p < 4) {
+                        ok = false;
+                        return out;
+                    }
+                    unsigned code = 0;
+                    for (int i = 0; i < 4; i++) {
+                        char h = *p++;
+                        code <<= 4;
+                        if (h >= '0' && h <= '9') code |= unsigned(h - '0');
+                        else if (h >= 'a' && h <= 'f') code |= unsigned(h - 'a' + 10);
+                        else if (h >= 'A' && h <= 'F') code |= unsigned(h - 'A' + 10);
+                        else {
+                            ok = false;
+                            return out;
+                        }
+                    }
+                    // UTF-8 encode (surrogate pairs folded to U+FFFD; the
+                    // protocol's strings are job names/paths — plain ASCII).
+                    if (code >= 0xD800 && code <= 0xDFFF) code = 0xFFFD;
+                    if (code < 0x80) {
+                        out.push_back(char(code));
+                    } else if (code < 0x800) {
+                        out.push_back(char(0xC0 | (code >> 6)));
+                        out.push_back(char(0x80 | (code & 0x3F)));
+                    } else {
+                        out.push_back(char(0xE0 | (code >> 12)));
+                        out.push_back(char(0x80 | ((code >> 6) & 0x3F)));
+                        out.push_back(char(0x80 | (code & 0x3F)));
+                    }
+                    break;
+                }
+                default:
+                    ok = false;
+                    return out;
+            }
+        }
+        if (!consume('"')) ok = false;
+        return out;
+    }
+
+    Json parse_number() {
+        const char* start = p;
+        bool negative = false;
+        bool is_double = false;
+        if (p < end && (*p == '-' || *p == '+')) {
+            negative = (*p == '-');
+            p++;
+        }
+        while (p < end &&
+               (isdigit(uint8_t(*p)) || *p == '.' || *p == 'e' || *p == 'E' ||
+                *p == '+' || *p == '-')) {
+            if (*p == '.' || *p == 'e' || *p == 'E') is_double = true;
+            p++;
+        }
+        std::string token(start, size_t(p - start));
+        if (token.empty()) {
+            ok = false;
+            return Json::make_null();
+        }
+        if (!is_double) {
+            errno = 0;
+            if (negative) {
+                int64_t v = strtoll(token.c_str(), nullptr, 10);
+                if (errno == 0) return Json::make_int(v);
+            } else {
+                uint64_t v = strtoull(token.c_str(), nullptr, 10);
+                if (errno == 0) return Json::make_uint(v);
+            }
+        }
+        return Json::make_double(strtod(token.c_str(), nullptr));
+    }
+
+    Json parse_array() {
+        Json out = Json::make_array();
+        consume('[');
+        skip_ws();
+        if (consume(']')) return out;
+        while (ok) {
+            out.arr.push_back(parse_value());
+            if (consume(']')) break;
+            if (!consume(',')) {
+                ok = false;
+                break;
+            }
+        }
+        return out;
+    }
+
+    Json parse_object() {
+        Json out = Json::make_object();
+        consume('{');
+        skip_ws();
+        if (consume('}')) return out;
+        while (ok) {
+            skip_ws();
+            std::string key = parse_string();
+            if (!ok || !consume(':')) {
+                ok = false;
+                break;
+            }
+            out.obj.emplace_back(std::move(key), parse_value());
+            if (consume('}')) break;
+            if (!consume(',')) {
+                ok = false;
+                break;
+            }
+        }
+        return out;
+    }
+};
+
+}  // namespace jsonparse
+
+inline bool json_parse(const std::string& text, Json* out) {
+    jsonparse::Parser parser(text);
+    *out = parser.parse_value();
+    parser.skip_ws();
+    return parser.ok;
+}
+
+inline void json_write(const Json& value, std::string* out) {
+    char buffer[64];
+    switch (value.type) {
+        case Json::NUL:
+            *out += "null";
+            break;
+        case Json::BOOL:
+            *out += value.boolean ? "true" : "false";
+            break;
+        case Json::INT:
+            snprintf(buffer, sizeof(buffer), "%lld", (long long)value.integer);
+            *out += buffer;
+            break;
+        case Json::UINT:
+            snprintf(buffer, sizeof(buffer), "%llu",
+                     (unsigned long long)value.uinteger);
+            *out += buffer;
+            break;
+        case Json::DOUBLE:
+            snprintf(buffer, sizeof(buffer), "%.17g", value.number);
+            *out += buffer;
+            break;
+        case Json::STR: {
+            *out += '"';
+            for (char c : value.str) {
+                switch (c) {
+                    case '"': *out += "\\\""; break;
+                    case '\\': *out += "\\\\"; break;
+                    case '\n': *out += "\\n"; break;
+                    case '\r': *out += "\\r"; break;
+                    case '\t': *out += "\\t"; break;
+                    default:
+                        if (uint8_t(c) < 0x20) {
+                            snprintf(buffer, sizeof(buffer), "\\u%04x", c);
+                            *out += buffer;
+                        } else {
+                            *out += c;
+                        }
+                }
+            }
+            *out += '"';
+            break;
+        }
+        case Json::ARR: {
+            *out += '[';
+            for (size_t i = 0; i < value.arr.size(); i++) {
+                if (i) *out += ',';
+                json_write(value.arr[i], out);
+            }
+            *out += ']';
+            break;
+        }
+        case Json::OBJ: {
+            *out += '{';
+            for (size_t i = 0; i < value.obj.size(); i++) {
+                if (i) *out += ',';
+                json_write(Json::make_string(value.obj[i].first), out);
+                *out += ':';
+                json_write(value.obj[i].second, out);
+            }
+            *out += '}';
+            break;
+        }
+    }
+}
+
+inline std::string json_dumps(const Json& value) {
+    std::string out;
+    json_write(value, &out);
+    return out;
+}
+
+// ---------------------------------------------------------------------------
+// WebSocket stream core (RFC 6455 subset: text/ping/pong/close). The client
+// side masks outgoing frames, the server side does not (RFC 6455 §5.1); both
+// unmask incoming frames per the header's mask bit. Message size cap is the
+// protocol's 256 MB limit (reference: shared/src/websockets.rs:3-9).
+
+class WsStream {
+  public:
+    ~WsStream() { close_socket(); }
+
+    // Serializes all frame writes, including pongs sent from the read path
+    // while another thread is mid send_text.
+    std::mutex send_mutex_;
+
+    void adopt_fd(int fd, bool mask_outgoing) {
+        close_socket();
+        fd_ = fd;
+        mask_outgoing_ = mask_outgoing;
+        if (fd_ >= 0) {
+            int one = 1;
+            setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        }
+    }
+
+    // Transfers the other stream's socket AND any already-buffered bytes
+    // (frames read into userspace but not yet consumed) without closing it.
+    void adopt_from(WsStream& other, bool mask_outgoing) {
+        close_socket();
+        fd_ = other.fd_;
+        buffer_ = std::move(other.buffer_);
+        mask_outgoing_ = mask_outgoing;
+        other.fd_ = -1;
+        other.buffer_.clear();
+    }
+
+    bool send_text(const std::string& payload) {
+        return send_frame(0x1, reinterpret_cast<const uint8_t*>(payload.data()),
+                          payload.size());
+    }
+
+    bool send_pong(const uint8_t* data, size_t len) {
+        return send_frame(0xA, data, len);
+    }
+
+    // Receives the next *message* (handles ping/pong/continuation inline).
+    // Returns false on socket error or close frame.
+    bool receive_text(std::string* out) {
+        std::string assembled;
+        bool in_fragmented = false;
+        for (;;) {
+            uint8_t opcode = 0;
+            int fin = 0;
+            std::string payload;
+            if (!receive_frame(&opcode, &fin, &payload)) return false;
+            switch (opcode) {
+                case 0x1:  // text
+                case 0x2:  // binary (treated as text; protocol is JSON text)
+                    if (fin) {
+                        *out = std::move(payload);
+                        return true;
+                    }
+                    assembled = std::move(payload);
+                    in_fragmented = true;
+                    break;
+                case 0x0:  // continuation
+                    if (!in_fragmented) return false;
+                    assembled += payload;
+                    if (fin) {
+                        *out = std::move(assembled);
+                        return true;
+                    }
+                    break;
+                case 0x8:  // close
+                    return false;
+                case 0x9:  // ping -> pong
+                    send_pong(reinterpret_cast<const uint8_t*>(payload.data()),
+                              payload.size());
+                    break;
+                case 0xA:  // pong: ignore
+                    break;
+                default:
+                    return false;
+            }
+        }
+    }
+
+    void shutdown_socket() {
+        if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
+    }
+
+    void close_socket() {
+        if (fd_ >= 0) {
+            ::close(fd_);
+            fd_ = -1;
+        }
+        buffer_.clear();
+    }
+
+    bool is_open() const { return fd_ >= 0; }
+    int fd() const { return fd_; }
+
+    bool write_all(const uint8_t* data, size_t len) {
+        size_t sent = 0;
+        while (sent < len) {
+            ssize_t n = ::send(fd_, data + sent, len - sent, MSG_NOSIGNAL);
+            if (n <= 0) {
+                if (n < 0 && (errno == EINTR)) continue;
+                return false;
+            }
+            sent += size_t(n);
+        }
+        return true;
+    }
+
+    // Reads raw bytes until a blank line terminates the HTTP header block
+    // (used for the upgrade request on the server and response on the client).
+    bool read_http_headers(std::string* out) {
+        out->clear();
+        char c;
+        while (out->size() < 16384) {
+            ssize_t n = ::recv(fd_, &c, 1, 0);
+            if (n <= 0) return false;
+            out->push_back(c);
+            if (out->size() >= 4 &&
+                out->compare(out->size() - 4, 4, "\r\n\r\n") == 0) {
+                return true;
+            }
+        }
+        return false;
+    }
+
+  protected:
+    int fd_ = -1;
+    bool mask_outgoing_ = true;
+    std::string buffer_;
+
+    bool fill_buffer(size_t needed) {
+        while (buffer_.size() < needed) {
+            uint8_t chunk[16384];
+            ssize_t n = ::recv(fd_, chunk, sizeof(chunk), 0);
+            if (n <= 0) {
+                if (n < 0 && errno == EINTR) continue;
+                return false;
+            }
+            buffer_.append(reinterpret_cast<char*>(chunk), size_t(n));
+        }
+        return true;
+    }
+
+    bool receive_frame(uint8_t* opcode, int* fin, std::string* payload) {
+        uint64_t payload_len = 0;
+        int masked = 0;
+        uint8_t mask[4];
+        int header_len = 0;
+        for (;;) {
+            header_len = trc_parse_header(
+                reinterpret_cast<const uint8_t*>(buffer_.data()),
+                buffer_.size(), opcode, fin, &masked, &payload_len, mask);
+            if (header_len < 0) return false;
+            if (header_len > 0) break;
+            if (!fill_buffer(buffer_.size() + 1)) return false;
+        }
+        if (payload_len > (256ull << 20)) return false;  // 256 MB limit (S12)
+        if (!fill_buffer(size_t(header_len) + size_t(payload_len))) return false;
+        payload->assign(buffer_, size_t(header_len), size_t(payload_len));
+        buffer_.erase(0, size_t(header_len) + size_t(payload_len));
+        if (masked) {
+            trc_mask_payload(reinterpret_cast<uint8_t*>(&(*payload)[0]),
+                             payload->size(), mask);
+        }
+        return true;
+    }
+
+    bool send_frame(uint8_t opcode, const uint8_t* data, size_t len) {
+        std::lock_guard<std::mutex> lock(send_mutex_);
+        if (fd_ < 0) return false;
+        uint8_t mask[4] = {0, 0, 0, 0};
+        if (mask_outgoing_) {
+            for (auto& b : mask) b = uint8_t(rng()());
+        }
+        uint8_t header[14];
+        size_t header_len = trc_encode_header(opcode, 1, mask_outgoing_ ? 1 : 0,
+                                              len, mask, header, sizeof(header));
+        std::vector<uint8_t> frame(header_len + len);
+        memcpy(frame.data(), header, header_len);
+        if (len > 0) memcpy(frame.data() + header_len, data, len);
+        if (mask_outgoing_) {
+            trc_mask_payload(frame.data() + header_len, len, mask);
+        }
+        return write_all(frame.data(), frame.size());
+    }
+};
+
+// ---------------------------------------------------------------------------
+// Paths (reference: worker/src/utilities.rs:5-37)
+
+inline std::string expand_path(const std::string& raw,
+                               const std::string& base_directory) {
+    std::string out = raw;
+    const std::string kBase = "%BASE%";
+    size_t at = out.find(kBase);
+    if (at != std::string::npos) {
+        out = out.substr(0, at) + base_directory + out.substr(at + kBase.size());
+    }
+    if (!out.empty() && out[0] == '~') {
+        const char* home = getenv("HOME");
+        if (home != nullptr) out = std::string(home) + out.substr(1);
+    }
+    return out;
+}
+
+inline void make_directories(const std::string& path) {
+    std::string partial;
+    for (size_t i = 0; i < path.size(); i++) {
+        partial.push_back(path[i]);
+        if (path[i] == '/' || i + 1 == path.size()) {
+            if (partial != "/") mkdir(partial.c_str(), 0755);
+        }
+    }
+}
+
+inline std::string format_frame_placeholders(const std::string& name_format,
+                                             int frame_index) {
+    size_t first = name_format.find('#');
+    if (first == std::string::npos) return name_format;
+    size_t count = 0;
+    while (first + count < name_format.size() && name_format[first + count] == '#')
+        count++;
+    char number[32];
+    snprintf(number, sizeof(number), "%0*d", int(count), frame_index);
+    return name_format.substr(0, first) + number +
+           name_format.substr(first + count);
+}
+
+inline std::string lowercase_ascii(std::string s) {
+    for (auto& c : s) c = char(tolower(c));
+    return s;
+}
+
+inline std::string shell_quote(const std::string& s) {
+    std::string out = "'";
+    for (char c : s) {
+        if (c == '\'') out += "'\\''";
+        else out += c;
+    }
+    out += "'";
+    return out;
+}

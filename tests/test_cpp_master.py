@@ -1,0 +1,229 @@
+"""Cross-language integration: C++ master daemon <-> C++/Python workers.
+
+Runs the compiled ``native/trc-master`` coordinator (the native counterpart
+of the reference's Rust master crate — reference: master/src/) against both
+the compiled C++ worker and the Python worker daemon, asserting the job
+completes, the raw-trace artifact stays analysis-compatible, and the
+beyond-reference eviction path reschedules a killed worker's frames.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from tpu_render_cluster.analysis.models import JobTrace
+from tpu_render_cluster.native import build_master_daemon, build_worker_daemon
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None, reason="g++ unavailable"
+)
+
+
+def test_master_daemon_builds():
+    assert build_master_daemon() is not None, "master daemon failed to compile"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _write_job(
+    tmp_path: Path,
+    *,
+    name: str,
+    frames: int,
+    workers: int,
+    strategy_lines: str,
+) -> Path:
+    job_path = tmp_path / "job.toml"
+    job_path.write_text(
+        f'''
+job_name = "{name}"
+job_description = "cpp master integration job"
+project_file_path = "%BASE%/project.blend"
+render_script_path = "%BASE%/script.py"
+frame_range_from = 1
+frame_range_to = {frames}
+wait_for_number_of_workers = {workers}
+output_directory_path = "{tmp_path / 'frames'}"
+output_file_name_format = "rendered-####"
+output_file_format = "PNG"
+
+[frame_distribution_strategy]
+{strategy_lines}
+'''
+    )
+    return job_path
+
+
+DYNAMIC = """strategy_type = "dynamic"
+target_queue_size = 4
+min_queue_size_to_steal = 2
+min_seconds_before_resteal_to_elsewhere = 40
+min_seconds_before_resteal_to_original_worker = 80"""
+
+
+def _spawn_master(
+    master: Path, port: int, job_path: Path, results: Path, *extra: str
+) -> subprocess.Popen:
+    return subprocess.Popen(
+        [
+            str(master),
+            "--host",
+            "127.0.0.1",
+            "--port",
+            str(port),
+            "run-job",
+            str(job_path),
+            "--resultsDirectory",
+            str(results),
+            *extra,
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def _spawn_cpp_worker(worker: Path, port: int, mock_ms: int = 30) -> subprocess.Popen:
+    return subprocess.Popen(
+        [
+            str(worker),
+            "--masterServerHost",
+            "127.0.0.1",
+            "--masterServerPort",
+            str(port),
+            "--mockRenderMs",
+            str(mock_ms),
+        ],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+
+
+def _wait(process: subprocess.Popen, timeout: float) -> int:
+    try:
+        return process.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        process.kill()
+        process.wait()
+        pytest.fail("process did not finish in time")
+
+
+@pytest.mark.parametrize(
+    "strategy_lines",
+    [
+        'strategy_type = "naive-fine"',
+        'strategy_type = "eager-naive-coarse"\ntarget_queue_size = 3',
+        DYNAMIC,
+    ],
+    ids=["naive-fine", "eager-naive-coarse", "dynamic"],
+)
+def test_native_cluster_completes(tmp_path, strategy_lines):
+    master = build_master_daemon()
+    worker = build_worker_daemon()
+    assert master is not None and worker is not None
+    port = _free_port()
+    job_path = _write_job(
+        tmp_path, name="cppmaster", frames=12, workers=2, strategy_lines=strategy_lines
+    )
+    results = tmp_path / "results"
+    master_proc = _spawn_master(master, port, job_path, results)
+    time.sleep(0.3)
+    workers = [_spawn_cpp_worker(worker, port) for _ in range(2)]
+    assert _wait(master_proc, 60) == 0
+    for proc in workers:
+        _wait(proc, 20)
+
+    rendered = sorted((tmp_path / "frames").glob("rendered-*.png"))
+    assert len(rendered) == 12
+
+    trace_path = next(results.glob("*_raw-trace.json"))
+    trace = JobTrace.load_from_trace_file(trace_path)
+    assert len(trace.worker_traces) == 2
+    assert (
+        sum(len(w.frame_render_traces) for w in trace.worker_traces.values()) == 12
+    )
+    assert next(results.glob("*_processed-results.json")).is_file()
+
+
+def test_cpp_master_with_python_workers(tmp_path):
+    master = build_master_daemon()
+    assert master is not None
+    port = _free_port()
+    job_path = _write_job(
+        tmp_path, name="cppmaster-pyworker", frames=8, workers=2,
+        strategy_lines='strategy_type = "naive-fine"',
+    )
+    results = tmp_path / "results"
+    master_proc = _spawn_master(master, port, job_path, results)
+    time.sleep(0.3)
+    workers = [
+        subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "tpu_render_cluster.worker.main",
+                "--masterServerHost",
+                "127.0.0.1",
+                "--masterServerPort",
+                str(port),
+                "--baseDirectory",
+                str(tmp_path),
+                "--backend",
+                "mock",
+            ],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        for _ in range(2)
+    ]
+    assert _wait(master_proc, 90) == 0
+    for proc in workers:
+        _wait(proc, 30)
+    trace = JobTrace.load_from_trace_file(next(results.glob("*_raw-trace.json")))
+    assert len(trace.worker_traces) == 2
+
+
+def test_eviction_requeues_dead_workers_frames(tmp_path):
+    """Beyond-reference: a SIGKILLed worker's frames are rescheduled.
+
+    The reference never evicts dead workers — their queued frames stay
+    QueuedOnWorker forever and naive strategies hang the job
+    (reference: master/src/cluster/mod.rs:616-617, SURVEY.md §5.3).
+    """
+    master = build_master_daemon()
+    worker = build_worker_daemon()
+    assert master is not None and worker is not None
+    port = _free_port()
+    job_path = _write_job(
+        tmp_path, name="cppmaster-evict", frames=10, workers=2,
+        strategy_lines='strategy_type = "eager-naive-coarse"\ntarget_queue_size = 5',
+    )
+    results = tmp_path / "results"
+    master_proc = _spawn_master(
+        master, port, job_path, results, "--evictAfterSeconds", "3"
+    )
+    time.sleep(0.3)
+    survivor = _spawn_cpp_worker(worker, port, mock_ms=400)
+    casualty = _spawn_cpp_worker(worker, port, mock_ms=400)
+    # Let the barrier pass and queues fill, then kill one worker outright.
+    time.sleep(2.0)
+    casualty.send_signal(signal.SIGKILL)
+    casualty.wait()
+    assert _wait(master_proc, 120) == 0
+    _wait(survivor, 30)
+    # All 10 frames rendered despite losing a worker mid-job.
+    rendered = sorted((tmp_path / "frames").glob("rendered-*.png"))
+    assert len(rendered) == 10

@@ -97,39 +97,51 @@ def _build() -> bool:
         return False
 
 
-_DAEMON_SOURCE = _SOURCE.parent / "worker_daemon.cpp"
-_DAEMON_BINARY = _SOURCE.parent / "trc-worker"
+_COMMON_HEADER = _SOURCE.parent / "trc_common.hpp"
 
 
-def build_worker_daemon() -> Path | None:
-    """Builds the standalone C++ worker daemon (native/worker_daemon.cpp).
+def _build_daemon(source: Path, binary: Path) -> Path | None:
+    """Builds a standalone C++ daemon (worker or master) against the codec.
 
     Returns the binary path, or None when the toolchain/source is missing.
     """
-    if not _DAEMON_SOURCE.is_file() or not _SOURCE.is_file():
+    if not source.is_file() or not _SOURCE.is_file():
         return None
-    newest_source = max(_DAEMON_SOURCE.stat().st_mtime, _SOURCE.stat().st_mtime)
-    if _DAEMON_BINARY.is_file() and _DAEMON_BINARY.stat().st_mtime >= newest_source:
-        return _DAEMON_BINARY
+    newest_source = max(source.stat().st_mtime, _SOURCE.stat().st_mtime)
+    if _COMMON_HEADER.is_file():
+        newest_source = max(newest_source, _COMMON_HEADER.stat().st_mtime)
+    if binary.is_file() and binary.stat().st_mtime >= newest_source:
+        return binary
     try:
         subprocess.run(
             [
                 "g++",
+                "-std=gnu++17",
                 "-O2",
                 "-pthread",
                 "-o",
-                str(_DAEMON_BINARY),
-                str(_DAEMON_SOURCE),
+                str(binary),
+                str(source),
                 str(_SOURCE),
             ],
             check=True,
             capture_output=True,
             timeout=300,
         )
-        return _DAEMON_BINARY
+        return binary
     except (subprocess.SubprocessError, OSError) as e:
-        logger.debug("Worker daemon build failed: %s", e)
+        logger.debug("Daemon build failed (%s): %s", source.name, e)
         return None
+
+
+def build_worker_daemon() -> Path | None:
+    """Builds the standalone C++ worker daemon (native/worker_daemon.cpp)."""
+    return _build_daemon(_SOURCE.parent / "worker_daemon.cpp", _SOURCE.parent / "trc-worker")
+
+
+def build_master_daemon() -> Path | None:
+    """Builds the standalone C++ master daemon (native/master_daemon.cpp)."""
+    return _build_daemon(_SOURCE.parent / "master_daemon.cpp", _SOURCE.parent / "trc-master")
 
 
 def load_codec() -> NativeCodec | None:
