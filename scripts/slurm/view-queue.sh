@@ -1,0 +1,4 @@
+#!/usr/bin/env bash
+# Formatted view of your SLURM queue
+# (reference: scripts/{arnes,nsc}/view-queue.sh).
+squeue --me --format="%.10i %.24j %.8T %.10M %.6D %.4C %R" "$@"

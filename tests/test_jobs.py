@@ -69,3 +69,30 @@ def test_tilde_expansion(monkeypatch, tmp_path):
     monkeypatch.setenv("HOME", str(tmp_path))
     resolved = parse_with_base_directory_prefix("~/x.blend", None)
     assert resolved == tmp_path / "x.blend"
+
+
+def test_full_job_matrix_parses():
+    """Every committed job TOML in the experiment grid loads.
+
+    The grid mirrors the reference's matrix (reference: blender-projects/*/
+    *.toml, ~60 files; SURVEY.md §2.6 H5) plus tpu-batch variants.
+    """
+    from pathlib import Path
+
+    root = Path(__file__).resolve().parent.parent / "blender-projects"
+    tomls = sorted(root.glob("*/*.toml"))
+    assert len(tomls) >= 50, f"expected the full grid, found {len(tomls)}"
+    names = set()
+    for path in tomls:
+        job = BlenderJob.load_from_file(path)
+        assert job.frame_count() >= 1
+        assert job.job_name not in names, f"duplicate job_name: {job.job_name}"
+        names.add(job.job_name)
+    # All four project families are present (02_physics included).
+    families = {p.parent.name for p in tomls}
+    assert families == {
+        "01_simple-animation",
+        "02_physics",
+        "03_physics-2",
+        "04_very-simple",
+    }

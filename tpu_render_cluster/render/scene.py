@@ -194,9 +194,15 @@ def build_scene(name: str, frame) -> Scene:
 
 
 def scene_for_job_name(job_name: str) -> str:
-    """Map a job name (reference TOML convention) to a scene family."""
+    """Map a job name to a scene family.
+
+    Covers the reference TOML convention ("01-simple-animation_...",
+    "04_very-simple_...") and this repo's generated grid labels
+    ("01sa_...", "02ph_...", "03ph2_...", "04vs_..."): the two-digit
+    project number prefix is unique across families.
+    """
     for name in SCENE_NAMES:
         key = name.split("_", 1)[0]  # "04", "01", ...
-        if job_name.startswith(name) or job_name.startswith(key + "_") or job_name.startswith(key + "-"):
+        if job_name.startswith(name) or job_name.startswith(key):
             return name
     return "04_very-simple"
