@@ -35,6 +35,7 @@
 
 #include <algorithm>
 #include <csignal>
+#include <dirent.h>
 #include <ctime>
 #include <limits>
 #include <map>
@@ -488,6 +489,8 @@ struct MasterOptions {
     std::string job_path;
     std::string results_directory = "results";
     std::string python_binary = "python3";
+    std::string base_directory = ".";    // %BASE% root for --resume
+    bool resume = false;                 // skip frames whose outputs exist
     double evict_after_seconds = 120.0;  // 0 disables (reference behavior)
     double heartbeat_interval_s = 10.0;  // reference: master/src/connection/mod.rs:36
     double heartbeat_warn_s = 60.0;      // reference receiver default timeout
@@ -505,6 +508,16 @@ class MasterDaemon {
     }
 
     int run() {
+        if (options_.resume) apply_resume();
+        if (all_frames_finished()) {
+            // Fully-resumed job: nothing to schedule, so don't block on the
+            // worker barrier. Results carry zero worker traces.
+            LOG_INFO("All frames already rendered; nothing to do.");
+            job_start_time_ = now_ts();
+            job_finish_time_ = job_start_time_;
+            persist_results({});
+            return 0;
+        }
         if (!bind_and_listen()) return 1;
         acceptor_ = std::thread(&MasterDaemon::accept_loop, this);
 
@@ -592,6 +605,70 @@ class MasterDaemon {
     std::map<uint32_t, double> frame_time_ema_;
     std::mutex observations_mutex_;
     std::vector<std::pair<uint32_t, double>> completion_observations_;
+
+    // Resume-by-scanning-output-dir (beyond-reference, SURVEY.md §5.4;
+    // Python counterpart: tpu_render_cluster/master/resume.py): mark frames
+    // whose non-empty output files already exist as finished.
+    void apply_resume() {
+        const Json* dir_value = job_.json.get("output_directory_path");
+        const Json* name_value = job_.json.get("output_file_name_format");
+        const Json* format_value = job_.json.get("output_file_format");
+        if (dir_value == nullptr || name_value == nullptr ||
+            format_value == nullptr)
+            return;
+        std::string directory =
+            expand_path(dir_value->as_string(), options_.base_directory);
+        std::string name_format = name_value->as_string();
+        std::string extension = lowercase_ascii(format_value->as_string());
+        if (extension == "jpeg") extension = "jpg";
+        size_t hash_start = name_format.find('#');
+        if (hash_start == std::string::npos) return;
+        size_t hash_count = 0;
+        while (hash_start + hash_count < name_format.size() &&
+               name_format[hash_start + hash_count] == '#')
+            hash_count++;
+        std::string prefix = name_format.substr(0, hash_start);
+        std::string suffix =
+            name_format.substr(hash_start + hash_count) + "." + extension;
+
+        DIR* handle = opendir(directory.c_str());
+        if (handle == nullptr) return;
+        int skipped = 0;
+        struct dirent* entry;
+        while ((entry = readdir(handle)) != nullptr) {
+            std::string file_name = entry->d_name;
+            if (file_name.size() <= prefix.size() + suffix.size()) continue;
+            if (file_name.compare(0, prefix.size(), prefix) != 0) continue;
+            if (file_name.compare(file_name.size() - suffix.size(),
+                                  suffix.size(), suffix) != 0)
+                continue;
+            std::string digits = file_name.substr(
+                prefix.size(), file_name.size() - prefix.size() - suffix.size());
+            // Width must be at least the # run's (matches resume.py's
+            // \d{width,}) so foreign short-numbered files are rejected.
+            if (digits.size() < hash_count ||
+                digits.find_first_not_of("0123456789") != std::string::npos)
+                continue;
+            struct stat info;
+            std::string full_path = directory + "/" + file_name;
+            if (stat(full_path.c_str(), &info) != 0 || info.st_size == 0)
+                continue;  // truncated output from a killed render
+            int frame_index = atoi(digits.c_str());
+            std::lock_guard<std::mutex> lock(state_mutex_);
+            FrameSlot* slot = slot_for(frame_index);
+            if (slot != nullptr && slot->status == FrameStatus::Pending) {
+                slot->status = FrameStatus::Finished;
+                finished_count_++;
+                skipped++;
+            }
+        }
+        closedir(handle);
+        if (skipped > 0) {
+            LOG_INFO("Resume: %d/%d frames already rendered; %d remain.",
+                     skipped, int(frames_.size()),
+                     int(frames_.size()) - skipped);
+        }
+    }
 
     // -- networking ----------------------------------------------------------
 
@@ -1564,7 +1641,9 @@ static void print_usage() {
             "                          their frames (0 = reference behavior:\n"
             "                          never; default 120)\n"
             "  --pythonBinary B        python for the tpu-batch assignment\n"
-            "                          service (default python3)\n");
+            "                          service (default python3)\n"
+            "  --resume                skip frames whose output files exist\n"
+            "  --baseDirectory D       %%BASE%% root for --resume (default .)\n");
 }
 
 int main(int argc, char** argv) {
@@ -1590,6 +1669,8 @@ int main(int argc, char** argv) {
         else if (flag == "--evictAfterSeconds")
             options.evict_after_seconds = atof(next().c_str());
         else if (flag == "--pythonBinary") options.python_binary = next();
+        else if (flag == "--resume") options.resume = true;
+        else if (flag == "--baseDirectory") options.base_directory = next();
         else if (flag == "--help" || flag == "-h") {
             print_usage();
             return 0;
@@ -1603,6 +1684,9 @@ int main(int argc, char** argv) {
         print_usage();
         return 2;
     }
+    // A dead assignment-service pipe must surface as write()==-1 (EPIPE) so
+    // the greedy fallback engages, not as a process-killing SIGPIPE.
+    signal(SIGPIPE, SIG_IGN);
     if (!options.log_file_path.empty()) {
         g_log_file = fopen(options.log_file_path.c_str(), "a");
     }

@@ -1,0 +1,89 @@
+"""Resume-by-scanning-output-dir (beyond-reference, SURVEY.md §5.4)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from tpu_render_cluster.jobs.models import BlenderJob, DistributionStrategy
+from tpu_render_cluster.master.resume import apply_resume, scan_rendered_frames
+from tpu_render_cluster.master.state import ClusterManagerState
+
+
+def _job(tmp_path: Path, *, name_format="rendered-####", file_format="PNG", frames=10):
+    return BlenderJob(
+        job_name="resume-test",
+        job_description=None,
+        project_file_path="%BASE%/p.blend",
+        render_script_path="%BASE%/s.py",
+        frame_range_from=1,
+        frame_range_to=frames,
+        wait_for_number_of_workers=1,
+        frame_distribution_strategy=DistributionStrategy.naive_fine(),
+        output_directory_path=str(tmp_path / "frames"),
+        output_file_name_format=name_format,
+        output_file_format=file_format,
+    )
+
+
+def _touch(directory: Path, name: str, content: bytes = b"x") -> None:
+    directory.mkdir(parents=True, exist_ok=True)
+    (directory / name).write_bytes(content)
+
+
+def test_scan_finds_rendered_frames(tmp_path):
+    job = _job(tmp_path)
+    frames = tmp_path / "frames"
+    for i in (1, 3, 7):
+        _touch(frames, f"rendered-{i:04d}.png")
+    assert scan_rendered_frames(job) == {1, 3, 7}
+
+
+def test_scan_skips_empty_and_foreign_files(tmp_path):
+    job = _job(tmp_path)
+    frames = tmp_path / "frames"
+    _touch(frames, "rendered-0002.png")
+    _touch(frames, "rendered-0004.png", content=b"")  # truncated: not done
+    _touch(frames, "rendered-9999.png")  # out of range
+    _touch(frames, "other-0005.png")  # wrong prefix
+    _touch(frames, "rendered-0006.jpg")  # wrong extension
+    assert scan_rendered_frames(job) == {2}
+
+
+def test_scan_jpeg_uses_jpg_extension(tmp_path):
+    job = _job(tmp_path, file_format="JPEG")
+    _touch(tmp_path / "frames", "rendered-0005.jpg")
+    assert scan_rendered_frames(job) == {5}
+
+
+def test_scan_base_placeholder(tmp_path):
+    job = _job(tmp_path)
+    job = BlenderJob.from_dict(
+        {**job.to_dict(), "output_directory_path": "%BASE%/frames"}
+    )
+    _touch(tmp_path / "frames", "rendered-0008.png")
+    assert scan_rendered_frames(job, tmp_path) == {8}
+
+
+def test_apply_resume_marks_finished_and_strategy_skips(tmp_path):
+    job = _job(tmp_path, frames=6)
+    frames = tmp_path / "frames"
+    for i in (1, 2, 5):
+        _touch(frames, f"rendered-{i:04d}.png")
+    state = ClusterManagerState(job)
+    skipped = apply_resume(state, job)
+    assert skipped == 3
+    assert state.pending_frames() == [3, 4, 6]
+    assert not state.all_frames_finished()
+    for i in (3, 4, 6):
+        state.mark_frame_as_finished(i)
+    assert state.all_frames_finished()
+
+
+def test_apply_resume_full_job_short_circuits(tmp_path):
+    job = _job(tmp_path, frames=4)
+    frames = tmp_path / "frames"
+    for i in range(1, 5):
+        _touch(frames, f"rendered-{i:04d}.png")
+    state = ClusterManagerState(job)
+    assert apply_resume(state, job) == 4
+    assert state.all_frames_finished()

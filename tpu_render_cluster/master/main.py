@@ -11,6 +11,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import sys
+import time
 from datetime import datetime
 from pathlib import Path
 
@@ -36,6 +37,18 @@ def build_parser() -> argparse.ArgumentParser:
     run_job.add_argument(
         "--resultsDirectory", dest="results_directory", required=True
     )
+    run_job.add_argument(
+        "--resume",
+        action="store_true",
+        help="Skip frames whose output files already exist (resume-by-scan; "
+        "beyond-reference, SURVEY.md §5.4).",
+    )
+    run_job.add_argument(
+        "--baseDirectory",
+        dest="base_directory",
+        default=".",
+        help="%%BASE%% root used to resolve the output directory for --resume.",
+    )
     return parser
 
 
@@ -43,6 +56,21 @@ async def run_job_command(args: argparse.Namespace) -> int:
     job = BlenderJob.load_from_file(args.job_file_path)
     start_time = datetime.now()
     manager = ClusterManager(args.host, args.port, job)
+    if args.resume:
+        from tpu_render_cluster.master.resume import apply_resume
+
+        apply_resume(manager.state, job, args.base_directory)
+        if manager.state.all_frames_finished():
+            # Fully-resumed job: don't block on the worker barrier.
+            from tpu_render_cluster.traces.master_trace import MasterTrace
+
+            print("All frames already rendered; nothing to do.")
+            now = time.time()
+            trace = MasterTrace(job_start_time=now, job_finish_time=now)
+            results_directory = Path(args.results_directory)
+            save_raw_traces(start_time, job, results_directory, trace, [])
+            save_processed_results(start_time, job, results_directory, [])
+            return 0
     master_trace, worker_traces = await manager.initialize_server_and_run_job()
 
     results_directory = Path(args.results_directory)

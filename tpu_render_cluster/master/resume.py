@@ -1,0 +1,106 @@
+"""Resume-by-scanning-output-dir.
+
+The reference has no job-level checkpointing — a killed master loses all
+frame state and a rerun re-renders everything, relying only on each frame
+being an independent, cleanly-overwritten output file
+(reference: SURVEY.md §5.4, scripts/render-timing-script.py:69-82). This
+module adds the trivial-but-useful resume the reference suggests: before
+scheduling, scan the job's output directory for frames that already exist
+and mark them finished, so a restarted master only renders the remainder.
+
+Enabled with ``master ... run-job <job.toml> --resume [--baseDirectory D]``.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from pathlib import Path
+
+from tpu_render_cluster.jobs.models import BlenderJob
+from tpu_render_cluster.master.state import ClusterManagerState
+from tpu_render_cluster.utils.paths import parse_with_base_directory_prefix
+
+logger = logging.getLogger(__name__)
+
+
+def _output_pattern(job: BlenderJob) -> re.Pattern[str]:
+    """Regex matching rendered file names, with the frame number captured.
+
+    ``#####`` runs in ``output_file_name_format`` become zero-padded frame
+    numbers (same placeholder contract as the render script —
+    scripts/render-timing-script.py / reference R1).
+    """
+    name_format = job.output_file_name_format
+    match = re.search(r"#+", name_format)
+    extension = job.output_file_format.lower()
+    if extension == "jpeg":
+        extension = "jpg"
+    if match is None:
+        # No placeholder: a fixed name can only cover a single-frame job;
+        # capture nothing — the caller maps a hit to frame_range_from.
+        return re.compile(
+            re.escape(name_format) + r"\." + re.escape(extension) + r"$"
+        )
+    width = len(match.group(0))
+    prefix = re.escape(name_format[: match.start()])
+    suffix = re.escape(name_format[match.end() :])
+    return re.compile(
+        rf"{prefix}(\d{{{width},}}){suffix}\.{re.escape(extension)}$"
+    )
+
+
+def scan_rendered_frames(
+    job: BlenderJob, base_directory: Path | str | None = None
+) -> set[int]:
+    """Frame indices whose output files already exist (and are non-empty)."""
+    try:
+        output_directory = parse_with_base_directory_prefix(
+            job.output_directory_path, base_directory
+        )
+    except ValueError as e:
+        logger.warning("Cannot resolve output directory for resume: %s", e)
+        return set()
+    if not output_directory.is_dir():
+        return set()
+    pattern = _output_pattern(job)
+    valid = set(job.frame_indices())
+    found: set[int] = set()
+    for entry in output_directory.iterdir():
+        match = pattern.fullmatch(entry.name)
+        if match is None:
+            continue
+        try:
+            if entry.stat().st_size == 0:
+                continue  # truncated output from a killed render
+        except OSError:
+            continue
+        if match.groups():
+            frame_index = int(match.group(1))
+        elif job.frame_count() == 1:
+            # Fixed-name output: the one file IS the one frame.
+            frame_index = job.frame_range_from
+        else:
+            continue  # ambiguous: fixed name cannot cover multiple frames
+        if frame_index in valid:
+            found.add(frame_index)
+    return found
+
+
+def apply_resume(
+    state: ClusterManagerState,
+    job: BlenderJob,
+    base_directory: Path | str | None = None,
+) -> int:
+    """Marks already-rendered frames finished; returns how many were skipped."""
+    rendered = scan_rendered_frames(job, base_directory)
+    for frame_index in sorted(rendered):
+        state.mark_frame_as_finished(frame_index)
+    if rendered:
+        logger.info(
+            "Resume: %d/%d frames already rendered; %d remain.",
+            len(rendered),
+            job.frame_count(),
+            job.frame_count() - len(rendered),
+        )
+    return len(rendered)
