@@ -79,6 +79,30 @@ def occluded(scene: Scene, origins, directions, max_t) -> jnp.ndarray:
     return t_sphere < max_t
 
 
+def occluded_sun(scene: Scene, origins, directions) -> jnp.ndarray:
+    """Unbounded any-hit shadow query (the sun is a delta light at infinity).
+
+    Cheaper than ``occluded``: no nearest-hit ordering or argmin is needed,
+    just "does any sphere lie in front" — on TPU this runs a dedicated
+    Pallas any-hit kernel with a single OR-reduction over spheres.
+    """
+    from tpu_render_cluster.render import pallas_kernels
+
+    if pallas_kernels.pallas_enabled():
+        return pallas_kernels.occluded_pallas(scene, origins, directions)
+    origins, directions = jax.lax.optimization_barrier((origins, directions))
+    oc_dot_d = directions @ scene.centers.T - jnp.sum(
+        directions * origins, axis=-1, keepdims=True
+    )
+    o_sq = jnp.sum(origins * origins, axis=-1, keepdims=True)
+    c_sq = jnp.sum(scene.centers * scene.centers, axis=-1)[None, :]
+    oc_sq = o_sq - 2.0 * (origins @ scene.centers.T) + c_sq
+    disc = oc_dot_d**2 - (oc_sq - scene.radii[None, :] ** 2)
+    valid = (disc > 0.0) & (scene.radii[None, :] > 0.0)
+    t1 = oc_dot_d + jnp.sqrt(jnp.maximum(disc, 0.0))
+    return jnp.any(valid & (t1 > EPS), axis=-1)
+
+
 def checker_albedo(scene: Scene, points) -> jnp.ndarray:
     """Checkerboard albedo for plane hit points [R, 3]."""
     checker = (

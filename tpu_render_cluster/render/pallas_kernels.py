@@ -147,3 +147,381 @@ def intersect_spheres_pallas(scene, origins, directions):
     # Padded sphere indices can only appear for all-miss rays (t == INF);
     # clamp into range like the jnp argmin would.
     return t, jnp.minimum(idx, scene.centers.shape[0] - 1)
+
+
+def _any_hit_kernel(o_ref, d_ref, c_ref, r2_ref, csq_ref, hit_ref):
+    """Shadow query: does ANY sphere intersect the ray (t > EPS)?
+
+    Same quadratic solve as _nearest_hit_kernel but no argmin and no min-t:
+    the reduction is a single boolean OR over the sublane (sphere) axis —
+    about a third less VMEM traffic per block than the nearest-hit pass.
+    """
+    o = o_ref[:, :]  # [3, BR]
+    d = d_ref[:, :]  # [3, BR]
+    c = c_ref[:, :]  # [3, N]
+    contract_first = (((0,), (0,)), ((), ()))
+    dc = jax.lax.dot_general(c, d, contract_first, preferred_element_type=jnp.float32)
+    oc = jax.lax.dot_general(c, o, contract_first, preferred_element_type=jnp.float32)
+    od = jnp.sum(o * d, axis=0, keepdims=True)
+    o_sq = jnp.sum(o * o, axis=0, keepdims=True)
+
+    r2 = r2_ref[:, :]
+    oc_dot_d = dc - od
+    oc_sq = o_sq - 2.0 * oc + csq_ref[:, :]
+    disc = oc_dot_d * oc_dot_d - (oc_sq - r2)
+    valid = (disc > 0.0) & (r2 > 0.0)
+    sqrt_disc = jnp.sqrt(jnp.maximum(disc, 0.0))
+    # Hit iff the far root is in front and the near root isn't past EPS
+    # behind us: equivalent to (t0 > EPS) | (t1 > EPS) with t = min valid.
+    t1 = oc_dot_d + sqrt_disc
+    hit = valid & (t1 > EPS)
+    hit_ref[:, :] = jnp.max(
+        jnp.where(hit, 1.0, 0.0), axis=0, keepdims=True
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _any_hit(origins, directions, centers, radii, *, interpret: bool):
+    rays = origins.shape[0]
+    padded_rays = -(-rays // BLOCK_R) * BLOCK_R
+    ray_pad = padded_rays - rays
+    o_t = jnp.pad(origins, ((0, ray_pad), (0, 0))).T
+    d_t = jnp.pad(directions, ((0, ray_pad), (0, 0))).T
+
+    n = centers.shape[0]
+    padded_n = -(-n // _SUBLANE) * _SUBLANE
+    sphere_pad = padded_n - n
+    c_t = jnp.pad(centers, ((0, sphere_pad), (0, 0))).T
+    radii = jnp.pad(radii, (0, sphere_pad))
+    r2 = (radii * radii)[:, None]
+    csq = jnp.sum(c_t * c_t, axis=0)[:, None]
+
+    grid = (padded_rays // BLOCK_R,)
+    hit = pl.pallas_call(
+        _any_hit_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((3, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, padded_n), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((1, padded_rays), jnp.float32)],
+        interpret=interpret,
+    )(o_t, d_t, c_t, r2, csq)[0]
+    return hit[0, :rays] > 0.5
+
+
+def occluded_pallas(scene, origins, directions):
+    """Any-hit shadow query (Pallas). Matches ``geometry.occluded`` for the
+    sun case: unbounded max_t, plane excluded."""
+    return _any_hit(
+        origins, directions, scene.centers, scene.radii, interpret=_interpret()
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fused path-trace megakernel: the WHOLE bounce loop in one pallas_call.
+#
+# The per-bounce XLA pipeline round-trips the path state (origins,
+# directions, throughput, radiance, alive — ~5 x [R, 3] f32) through HBM on
+# every bounce, which makes the tracer HBM-bound once intersection runs in
+# VMEM. This kernel keeps the state resident in VMEM for a block of rays
+# across ALL bounces: rays are read once, radiance is written once, and the
+# per-bounce sphere pass ([N, BR] intermediates), shading, shadow test, and
+# cosine resampling never touch HBM. RNG is a counter-based PCG hash of
+# (global ray index, bounce, stream) — no sequential state, so any ray
+# block computes identically regardless of grid position or device.
+
+
+def _pcg_hash(x):
+    """PCG output permutation on uint32 (Jarzynski & Olano, GPU RNG survey)."""
+    state = x * jnp.uint32(747796405) + jnp.uint32(2891336453)
+    shift = (state >> jnp.uint32(28)) + jnp.uint32(4)
+    word = ((state >> shift) ^ state) * jnp.uint32(277803737)
+    return (word >> jnp.uint32(22)) ^ word
+
+
+def _uniform_from_hash(h):
+    """uint32 -> float32 in [0, 1) using the top 24 bits."""
+    return (h >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+
+
+def _trace_kernel_factory(max_bounces: int, n_padded: int):
+    contract_first = (((0,), (0,)), ((), ()))
+
+    def kernel(seed_ref, o_ref, d_ref, c_ref, r2_ref, csq_ref, rad_ref,
+               albedo_ref, emission_ref, dcsun_ref, params_ref, out_ref):
+        o = o_ref[:, :]  # [3, BR] ray origins
+        d = d_ref[:, :]  # [3, BR] ray directions
+        c = c_ref[:, :]  # [3, N] sphere centers
+        r2 = r2_ref[:, :]  # [N, 1] radius^2 (0 for padding -> never hits)
+        csq = csq_ref[:, :]  # [N, 1] |c|^2
+        radius = rad_ref[:, :]  # [N, 1]
+        albedo_t = albedo_ref[:, :]  # [3, N]
+        emission_t = emission_ref[:, :]  # [3, N]
+        dc_sun = dcsun_ref[:, :]  # [N, 1] c . sun
+        # params rows: 0 sun_dir, 1 sun_color, 2 sky_horizon, 3 sky_zenith,
+        # 4 plane_albedo_a, 5 plane_albedo_b   (each [1, 3] -> column vecs)
+        params = params_ref[:, :]  # [8, 3]
+        sun = params[0:1, :].T  # [3, 1]
+        sun_color = params[1:2, :].T
+        sky_horizon = params[2:3, :].T
+        sky_zenith = params[3:4, :].T
+        plane_a = params[4:5, :].T
+        plane_b = params[5:6, :].T
+
+        block = o.shape[1]
+        seed = seed_ref[0, 0].astype(jnp.uint32)
+        ray_index = (
+            jax.lax.broadcasted_iota(jnp.int32, (1, block), 1).astype(jnp.uint32)
+            + jnp.uint32(pl.program_id(0) * block)
+        )
+        sphere_iota = jax.lax.broadcasted_iota(jnp.int32, (n_padded, block), 0)
+
+        throughput = jnp.ones((3, block), jnp.float32)
+        radiance = jnp.zeros((3, block), jnp.float32)
+        alive = jnp.ones((1, block), jnp.float32)
+
+        def bounce_step(bounce, carry):
+            o, d, throughput, radiance, alive = carry
+            # -- nearest sphere hit (same math as _nearest_hit_kernel) ----
+            dc = jax.lax.dot_general(
+                c, d, contract_first, preferred_element_type=jnp.float32
+            )
+            oc = jax.lax.dot_general(
+                c, o, contract_first, preferred_element_type=jnp.float32
+            )
+            od = jnp.sum(o * d, axis=0, keepdims=True)
+            o_sq = jnp.sum(o * o, axis=0, keepdims=True)
+            oc_dot_d = dc - od
+            oc_sq = o_sq - 2.0 * oc + csq
+            disc = oc_dot_d * oc_dot_d - (oc_sq - r2)
+            valid = (disc > 0.0) & (r2 > 0.0)
+            sqrt_disc = jnp.sqrt(jnp.maximum(disc, 0.0))
+            t0 = oc_dot_d - sqrt_disc
+            t1 = oc_dot_d + sqrt_disc
+            t_all = jnp.where(t0 > EPS, t0, jnp.where(t1 > EPS, t1, INF))
+            t_all = jnp.where(valid, t_all, INF)  # [N, BR]
+            t_sphere = jnp.min(t_all, axis=0, keepdims=True)  # [1, BR]
+            idx = jnp.min(
+                jnp.where(t_all == t_sphere, sphere_iota, n_padded),
+                axis=0,
+                keepdims=True,
+            )
+            idx = jnp.minimum(idx, n_padded - 1)
+
+            # -- ground plane y = 0 ---------------------------------------
+            d_y = d[1:2, :]
+            o_y = o[1:2, :]
+            denom = jnp.where(jnp.abs(d_y) < 1e-8, 1e-8, d_y)
+            t_plane = -o_y / denom
+            t_plane = jnp.where(
+                (t_plane > EPS) & (jnp.abs(d_y) >= 1e-8), t_plane, INF
+            )
+            is_plane = (t_plane < t_sphere).astype(jnp.float32)  # [1, BR]
+            t = jnp.minimum(t_sphere, t_plane)
+            hit = (t < INF).astype(jnp.float32)
+
+            # -- sky on escape --------------------------------------------
+            blend = jnp.clip(d[1:2, :], 0.0, 1.0)
+            sun_cos_dir = jnp.sum(d * sun, axis=0, keepdims=True)
+            sun_disc = jnp.where(sun_cos_dir > 0.9995, 8.0, 0.0)
+            sky = (1.0 - blend) * sky_horizon + blend * sky_zenith
+            sky = sky + sun_disc * sun_color
+            radiance = radiance + throughput * sky * (alive * (1.0 - hit))
+
+            alive = alive * hit
+            p = o + d * t  # [3, BR]
+
+            # -- gathers as one-hot matmuls (N is small, MXU-friendly) ----
+            one_hot = (sphere_iota == idx).astype(jnp.float32)  # [N, BR]
+            c_hit = jax.lax.dot_general(
+                c, one_hot, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [3, BR]
+            r_hit = jnp.sum(radius * one_hot, axis=0, keepdims=True)  # [1, BR]
+            albedo_hit = jax.lax.dot_general(
+                albedo_t, one_hot, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            emission_hit = jax.lax.dot_general(
+                emission_t, one_hot, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+            sphere_normal = (p - c_hit) / jnp.maximum(r_hit, 1e-6)
+            plane_normal = jnp.concatenate(
+                [
+                    jnp.zeros((1, block), jnp.float32),
+                    jnp.ones((1, block), jnp.float32),
+                    jnp.zeros((1, block), jnp.float32),
+                ],
+                axis=0,
+            )
+            normal = is_plane * plane_normal + (1.0 - is_plane) * sphere_normal
+
+            checker = (
+                jnp.floor(p[0:1, :]).astype(jnp.int32)
+                + jnp.floor(p[2:3, :]).astype(jnp.int32)
+            ) % 2
+            checker_rgb = jnp.where(checker == 0, plane_a, plane_b)
+            albedo = is_plane * checker_rgb + (1.0 - is_plane) * albedo_hit
+            emission = (1.0 - is_plane) * emission_hit
+            radiance = radiance + throughput * emission * alive
+
+            # -- sun NEE: one any-hit shadow dot (sun dir is uniform) -----
+            shadow_o = p + normal * (EPS * 4.0)
+            oc_s = jax.lax.dot_general(
+                c, shadow_o, contract_first, preferred_element_type=jnp.float32
+            )
+            od_s = jnp.sum(shadow_o * sun, axis=0, keepdims=True)
+            osq_s = jnp.sum(shadow_o * shadow_o, axis=0, keepdims=True)
+            ocd_s = dc_sun - od_s
+            ocsq_s = osq_s - 2.0 * oc_s + csq
+            disc_s = ocd_s * ocd_s - (ocsq_s - r2)
+            valid_s = (disc_s > 0.0) & (r2 > 0.0)
+            t1_s = ocd_s + jnp.sqrt(jnp.maximum(disc_s, 0.0))
+            shadowed = jnp.max(
+                jnp.where(valid_s & (t1_s > EPS), 1.0, 0.0),
+                axis=0,
+                keepdims=True,
+            )
+            cos_sun = jnp.maximum(jnp.sum(normal * sun, axis=0, keepdims=True), 0.0)
+            direct = (
+                albedo * sun_color * (cos_sun * (1.0 - shadowed) * alive)
+                / jnp.float32(jnp.pi)
+            )
+            radiance = radiance + throughput * direct
+
+            # -- continue the path: cosine-weighted resample --------------
+            throughput = throughput * (alive * albedo + (1.0 - alive))
+            counter = ray_index * jnp.uint32(2 * max_bounces + 2) + jnp.uint32(2) * bounce.astype(jnp.uint32)
+            u1 = _uniform_from_hash(_pcg_hash(counter ^ seed))
+            u2 = _uniform_from_hash(_pcg_hash((counter + jnp.uint32(1)) ^ seed))
+            r = jnp.sqrt(u1)
+            phi = jnp.float32(2.0 * jnp.pi) * u2
+            x = r * jnp.cos(phi)
+            y = r * jnp.sin(phi)
+            z = jnp.sqrt(jnp.maximum(0.0, 1.0 - u1))
+            helper_x = jnp.where(jnp.abs(normal[0:1, :]) > 0.9, 0.0, 1.0)
+            helper_y = 1.0 - helper_x
+            # tangent = helper x normal (helper is (hx, hy, 0))
+            tx = helper_y * normal[2:3, :]
+            ty = -helper_x * normal[2:3, :]
+            tz = helper_x * normal[1:2, :] - helper_y * normal[0:1, :]
+            tangent = jnp.concatenate([tx, ty, tz], axis=0)
+            tangent = tangent / jnp.maximum(
+                jnp.sqrt(jnp.sum(tangent * tangent, axis=0, keepdims=True)), 1e-8
+            )
+            # bitangent = normal x tangent
+            bx = normal[1:2, :] * tangent[2:3, :] - normal[2:3, :] * tangent[1:2, :]
+            by = normal[2:3, :] * tangent[0:1, :] - normal[0:1, :] * tangent[2:3, :]
+            bz = normal[0:1, :] * tangent[1:2, :] - normal[1:2, :] * tangent[0:1, :]
+            bitangent = jnp.concatenate([bx, by, bz], axis=0)
+            new_d = x * tangent + y * bitangent + z * normal
+            new_o = p + normal * (EPS * 4.0)
+            # where-select (not multiply-mask): dead lanes keep their old
+            # finite state, so no inf*0 can poison later bounces.
+            live = alive > 0.5
+            o = jnp.where(live, new_o, o)
+            d = jnp.where(live, new_d, d)
+            return (o, d, throughput, radiance, alive)
+
+        _, _, _, radiance, _ = jax.lax.fori_loop(
+            0, max_bounces, bounce_step,
+            (o, d, throughput, radiance, alive),
+        )
+        out_ref[:, :] = radiance
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("max_bounces", "interpret"))
+def _trace_fused(
+    origins, directions, centers, radii, albedo, emission,
+    sun_direction, sun_color, sky_horizon, sky_zenith,
+    plane_albedo_a, plane_albedo_b, seed,
+    *, max_bounces: int, interpret: bool,
+):
+    rays = origins.shape[0]
+    padded_rays = -(-rays // BLOCK_R) * BLOCK_R
+    ray_pad = padded_rays - rays
+    o_t = jnp.pad(origins, ((0, ray_pad), (0, 0))).T
+    d_t = jnp.pad(directions, ((0, ray_pad), (0, 0))).T
+
+    n = centers.shape[0]
+    padded_n = -(-n // _SUBLANE) * _SUBLANE
+    sphere_pad = padded_n - n
+    c_t = jnp.pad(centers, ((0, sphere_pad), (0, 0))).T  # [3, Np]
+    radii_p = jnp.pad(radii, (0, sphere_pad))
+    r2 = (radii_p * radii_p)[:, None]
+    csq = jnp.sum(c_t * c_t, axis=0)[:, None]
+    rad = radii_p[:, None]
+    albedo_t = jnp.pad(albedo, ((0, sphere_pad), (0, 0))).T
+    emission_t = jnp.pad(emission, ((0, sphere_pad), (0, 0))).T
+    dc_sun = (c_t.T @ sun_direction)[:, None]  # [Np, 1]
+
+    params = jnp.zeros((8, 3), jnp.float32)
+    params = params.at[0].set(sun_direction)
+    params = params.at[1].set(sun_color)
+    params = params.at[2].set(sky_horizon)
+    params = params.at[3].set(sky_zenith)
+    params = params.at[4].set(plane_albedo_a)
+    params = params.at[5].set(plane_albedo_b)
+    seed_arr = jnp.asarray(seed, jnp.int32).reshape(1, 1)
+
+    grid = (padded_rays // BLOCK_R,)
+    whole = lambda i: (0, 0)  # noqa: E731 - scene blocks replicated per step
+    out = pl.pallas_call(
+        _trace_kernel_factory(max_bounces, padded_n),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), whole, memory_space=pltpu.SMEM),
+            pl.BlockSpec((3, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, padded_n), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, padded_n), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, padded_n), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, 3), whole, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((3, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((3, padded_rays), jnp.float32)],
+        interpret=interpret,
+    )(seed_arr, o_t, d_t, c_t, r2, csq, rad, albedo_t, emission_t, dc_sun, params)[0]
+    return out.T[:rays]
+
+
+def trace_paths_fused(scene, origins, directions, seed, *, max_bounces: int):
+    """Fused megakernel path trace; drop-in for integrator.trace_paths.
+
+    ``seed`` is an int32 scalar (derived from the frame/tile) driving the
+    in-kernel counter-based PCG RNG; radiance is returned as [R, 3].
+    """
+    return _trace_fused(
+        origins,
+        directions,
+        scene.centers,
+        scene.radii,
+        scene.albedo,
+        scene.emission,
+        scene.sun_direction,
+        scene.sun_color,
+        scene.sky_horizon,
+        scene.sky_zenith,
+        scene.plane_albedo_a,
+        scene.plane_albedo_b,
+        seed,
+        max_bounces=max_bounces,
+        interpret=_interpret(),
+    )
