@@ -170,10 +170,6 @@ def render_tile(
         jnp.asarray(x0, jnp.int32),
     )
 
-    # Samples ride the ray axis instead of a sequential lax.scan: one
-    # [samples * n]-ray trace keeps every per-bounce kernel 'samples'x
-    # larger (better VPU/MXU occupancy, fewer serialized steps) for the
-    # same total work — a measured ~1.9x on a single chip.
     sample_keys = jax.vmap(lambda s: jax.random.fold_in(base_key, s))(
         jnp.arange(samples)
     )
@@ -192,15 +188,41 @@ def render_tile(
             jitter=jitter,
         )
 
-    origins, directions = jax.vmap(rays_for_sample)(sample_keys)  # [S, n, 3]
-    radiance = trace_paths(
-        scene,
-        origins.reshape(samples * n, 3),
-        directions.reshape(samples * n, 3),
-        jax.random.fold_in(base_key, jnp.int32(-1)),
-        max_bounces=max_bounces,
-    )
-    image = radiance.reshape(samples, n, 3).mean(axis=0)
+    from tpu_render_cluster.render import pallas_kernels
+
+    if pallas_kernels.pallas_enabled():
+        # Samples ride the ray axis instead of a sequential lax.scan: one
+        # [samples * n]-ray trace keeps every bounce step 'samples'x larger
+        # (better VPU/MXU occupancy, fewer serialized steps) for the same
+        # total work — a measured ~1.9x on a single chip. Safe here because
+        # the fused kernel blocks rays at BLOCK_R; its VMEM working set is
+        # independent of the flattened ray count.
+        origins, directions = jax.vmap(rays_for_sample)(sample_keys)  # [S, n, 3]
+        radiance = trace_paths(
+            scene,
+            origins.reshape(samples * n, 3),
+            directions.reshape(samples * n, 3),
+            jax.random.fold_in(base_key, jnp.int32(-1)),
+            max_bounces=max_bounces,
+        )
+        image = radiance.reshape(samples, n, 3).mean(axis=0)
+    else:
+        # The XLA fallback materializes [R, N] intersection intermediates,
+        # so the flattened [samples * n] ray axis would multiply peak memory
+        # by 'samples' (an OOM risk for big tiles on CPU/GPU workers); keep
+        # the sequential per-sample scan there instead.
+        def sample_step(acc, key):
+            origins, directions = rays_for_sample(key)
+            _, trace_key = jax.random.split(key)
+            radiance = trace_paths(
+                scene, origins, directions, trace_key, max_bounces=max_bounces
+            )
+            return acc + radiance, None
+
+        total, _ = jax.lax.scan(
+            sample_step, jnp.zeros((n, 3), jnp.float32), sample_keys
+        )
+        image = total / samples
     return image.reshape(tile_height, tile_width, 3)
 
 

@@ -247,8 +247,14 @@ def _pcg_hash(x):
 
 
 def _uniform_from_hash(h):
-    """uint32 -> float32 in [0, 1) using the top 24 bits."""
-    return (h >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+    """uint32 -> float32 in [0, 1) using the top 24 bits.
+
+    Mosaic has no uint32->float32 convert rule; the 24-bit word is
+    value-preserved by a same-width bitcast to int32 (it is < 2^31), and
+    int32->float32 is a supported convert.
+    """
+    word = jax.lax.bitcast_convert_type(h >> jnp.uint32(8), jnp.int32)
+    return word.astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
 
 
 def _trace_kernel_factory(max_bounces: int, n_padded: int):
