@@ -182,10 +182,35 @@ class ClusterManager:
     async def _wait_for_workers_and_run_job(self) -> MasterTrace:
         target = self.job.wait_for_number_of_workers
         logger.info("Waiting for %d workers to connect...", target)
-        while len(self.workers) < target:
-            if self.cancellation.is_cancelled():
-                raise RuntimeError("Cancelled while waiting for workers.")
-            await asyncio.sleep(BARRIER_POLL_SECONDS)
+        warmup_task: asyncio.Task | None = None
+        strategy = self.job.frame_distribution_strategy
+        if strategy.strategy_type == "tpu-batch":
+            # Compile the auction kernel while workers connect so the first
+            # scheduling tick doesn't pay XLA compilation inside the job.
+            from tpu_render_cluster.master.tpu_batch import (
+                MAX_SLOTS_PER_TICK,
+                RATE_TARGET_CAP,
+            )
+            from tpu_render_cluster.ops.assignment import warmup
+
+            assert strategy.tpu_batch is not None
+            max_slots = min(
+                MAX_SLOTS_PER_TICK,
+                max(strategy.tpu_batch.target_queue_size, RATE_TARGET_CAP)
+                * max(1, target),
+            )
+            warmup_task = asyncio.create_task(asyncio.to_thread(warmup, max_slots))
+        try:
+            while len(self.workers) < target:
+                if self.cancellation.is_cancelled():
+                    raise RuntimeError("Cancelled while waiting for workers.")
+                await asyncio.sleep(BARRIER_POLL_SECONDS)
+            if warmup_task is not None:
+                await warmup_task
+        except BaseException:
+            if warmup_task is not None and not warmup_task.done():
+                warmup_task.cancel()
+            raise
         logger.info("All %d workers connected; starting job.", target)
 
         self._job_started = True

@@ -3,11 +3,15 @@
 New in this build (the north-star scheduler from BASELINE.md): each
 scheduling tick gathers every worker's queue deficit into a pool of *slots*
 (worker x queue position), predicts the completion time of putting a frame
-into each slot from a per-worker EMA of observed frame times, and solves the
+into each slot from a joint cost model — a per-worker speed EMA times a
+per-frame complexity factor interpolated over frame index (scenes are
+animated, so cost varies smoothly with the frame) — and solves the
 frame->slot min-cost assignment with the JAX auction kernel
-(tpu_render_cluster/ops/assignment.py). Assignments are issued as the same
-``request_frame-queue_add`` RPCs the reference strategies use, so workers
-can't tell the schedulers apart.
+(tpu_render_cluster/ops/assignment.py). An opportunity-cost gate drops
+assignments the rest of the cluster could finish sooner than the chosen
+slot, which keeps the job tail off the slowest worker. Assignments are
+issued as the same ``request_frame-queue_add`` RPCs the reference
+strategies use, so workers can't tell the schedulers apart.
 
 When the pending pool runs dry it degrades to dynamic-strategy stealing
 (reference semantics: master/src/cluster/strategies.rs:250-405), which also
@@ -17,6 +21,7 @@ covers the cold-start case where no frame-time history exists yet.
 from __future__ import annotations
 
 import asyncio
+import bisect
 import logging
 import time
 from typing import TYPE_CHECKING, Sequence
@@ -40,8 +45,20 @@ if TYPE_CHECKING:
 
 logger = logging.getLogger(__name__)
 
-TPU_BATCH_TICK = 0.1
+TPU_BATCH_TICK = 0.05
 DEFAULT_FRAME_TIME_GUESS = 5.0  # seconds, until history arrives
+# Each worker's queue is sized to cover this many seconds of predicted work
+# (bounded below by 1 and above by RATE_TARGET_CAP), so a fast worker's
+# queue holds several ticks of frames while a slow worker holds one or two.
+# A uniform target starves fast workers: they drain the whole queue within
+# a tick and idle until the next one.
+RATE_TARGET_LOOKAHEAD = 0.25
+RATE_TARGET_CAP = 16
+# Hard bound on slots considered per tick: keeps the auction matrix inside
+# the pre-compiled bucket sizes (ClusterManager warms up to this many) and
+# bounds per-tick work on huge clusters; later workers simply get topped up
+# on the next tick.
+MAX_SLOTS_PER_TICK = 128
 
 
 class WorkerCostModel:
@@ -60,12 +77,84 @@ class WorkerCostModel:
                 self.alpha * frame_seconds + (1 - self.alpha) * previous
             )
 
+    def has_history(self, worker_id: int) -> bool:
+        return worker_id in self._ema
+
     def predict(self, worker_id: int) -> float:
         if self._ema:
             default = float(np.median(list(self._ema.values())))
         else:
             default = DEFAULT_FRAME_TIME_GUESS
         return self._ema.get(worker_id, default)
+
+
+class FrameComplexityModel:
+    """Per-frame relative render-cost predictor.
+
+    Scenes are animated, so cost varies smoothly with frame index; unseen
+    frames are predicted by linear interpolation between the nearest
+    observed frame indices (nearest-neighbor at the edges). Observations
+    are worker-speed-normalized, so a heavy frame on a fast worker and a
+    light frame on a slow worker are distinguishable. Cold start predicts
+    a flat 1.0, which reduces the cost matrix to the pure worker-speed
+    model and tpu-batch to its round-2 behavior.
+    """
+
+    def __init__(self, alpha: float = 0.5) -> None:
+        self.alpha = alpha
+        self._complexity: dict[int, float] = {}
+        self._sorted_indices: list[int] = []
+
+    def observe(self, frame_index: int, relative_complexity: float) -> None:
+        previous = self._complexity.get(frame_index)
+        if previous is None:
+            bisect.insort(self._sorted_indices, frame_index)
+            self._complexity[frame_index] = relative_complexity
+        else:
+            self._complexity[frame_index] = (
+                self.alpha * relative_complexity + (1 - self.alpha) * previous
+            )
+
+    def predict(self, frame_index: int) -> float:
+        if not self._sorted_indices:
+            return 1.0
+        known = self._complexity.get(frame_index)
+        if known is not None:
+            return known
+        position = bisect.bisect_left(self._sorted_indices, frame_index)
+        if position == 0:
+            return self._complexity[self._sorted_indices[0]]
+        if position == len(self._sorted_indices):
+            return self._complexity[self._sorted_indices[-1]]
+        left = self._sorted_indices[position - 1]
+        right = self._sorted_indices[position]
+        weight = (frame_index - left) / (right - left)
+        return (1 - weight) * self._complexity[left] + weight * self._complexity[right]
+
+    def predict_many(self, frames: Sequence[int]) -> dict[int, float]:
+        return {frame_index: self.predict(frame_index) for frame_index in frames}
+
+
+class JointCostModel:
+    """Multiplicative decomposition t(worker, frame) ~ speed[worker] * complexity[frame].
+
+    ``speed`` is a per-worker EMA in seconds per complexity unit
+    (WorkerCostModel); ``complexity`` is the per-frame factor
+    (FrameComplexityModel). Each observation updates both: the worker EMA is
+    fed the complexity-normalized time, and the frame model the
+    speed-normalized time. The alternation converges because both models
+    start from flat priors (1.0 complexity, median speed).
+    """
+
+    def __init__(self, alpha: float) -> None:
+        self.worker_speed = WorkerCostModel(alpha)
+        self.frame_complexity = FrameComplexityModel()
+
+    def observe(self, worker_id: int, frame_index: int, seconds: float) -> None:
+        complexity_estimate = max(1e-6, self.frame_complexity.predict(frame_index))
+        self.worker_speed.observe(worker_id, seconds / complexity_estimate)
+        speed_estimate = max(1e-6, self.worker_speed.predict(worker_id))
+        self.frame_complexity.observe(frame_index, seconds / speed_estimate)
 
 
 def build_cost_matrix(
@@ -115,7 +204,7 @@ async def tpu_batch_strategy(
 ) -> None:
     from tpu_render_cluster.ops.assignment import solve_assignment
 
-    cost_model = WorkerCostModel(options.cost_ema_alpha)
+    cost_model = JointCostModel(options.cost_ema_alpha)
     dynamic_options = _as_dynamic_options(options)
     observed_frames: set[tuple[int, int]] = set()
 
@@ -133,20 +222,77 @@ async def tpu_batch_strategy(
                 key = (worker.worker_id, frame_index)
                 if key not in observed_frames:
                     observed_frames.add(key)
-                    cost_model.observe(worker.worker_id, seconds)
+                    cost_model.observe(worker.worker_id, frame_index, seconds)
 
-        # Collect slots from queue deficits.
+        # Collect slots from queue deficits, with per-worker targets scaled
+        # to each worker's predicted rate (uniform targets until history
+        # arrives — the cold-start case falls back to eager-coarse shape).
+        upcoming = state.pending_frames(limit=2 * RATE_TARGET_CAP)
+        batch_mean_complexity = (
+            float(
+                np.mean(
+                    [cost_model.frame_complexity.predict(f) for f in upcoming]
+                )
+            )
+            if upcoming
+            else 1.0
+        )
         slots: list[tuple["WorkerHandle", int]] = []
         for worker in workers:
-            deficit = options.target_queue_size - len(worker.queue)
+            if cost_model.worker_speed.has_history(worker.worker_id):
+                frame_seconds = max(
+                    1e-6,
+                    cost_model.worker_speed.predict(worker.worker_id)
+                    * batch_mean_complexity,
+                )
+                target = min(
+                    max(
+                        1, int(np.ceil(RATE_TARGET_LOOKAHEAD / frame_seconds))
+                    ),
+                    max(options.target_queue_size, RATE_TARGET_CAP),
+                )
+            else:
+                target = options.target_queue_size
+            deficit = target - len(worker.queue)
             for position in range(max(0, deficit)):
                 slots.append((worker, position))
+        del slots[MAX_SLOTS_PER_TICK:]
 
         if slots:
             frames = state.pending_frames(limit=len(slots))
             if frames:
-                cost = build_cost_matrix(frames, slots, cost_model)
+                complexity = cost_model.frame_complexity.predict_many(frames)
+                cost = build_cost_matrix(
+                    frames,
+                    slots,
+                    cost_model.worker_speed,
+                    frame_complexity=complexity,
+                )
                 assignment = solve_assignment(cost)
+
+                # Makespan-balance gate: skip an assignment whose predicted
+                # completion exceeds the time the OTHER workers need to
+                # drain the rest of the pool — queueing it there can only
+                # lengthen the makespan. A slow worker still receives
+                # frames it can finish within the others' drain window
+                # (keeping tail delay low), but never a frame that would
+                # make it the job's tail. The fastest worker's own front
+                # slot always passes (completion == slack term), so the job
+                # always makes progress.
+                speeds = {
+                    worker.worker_id: cost_model.worker_speed.predict(worker.worker_id)
+                    for worker in workers
+                }
+                cluster_rate = sum(1.0 / max(1e-6, s) for s in speeds.values())
+                mean_complexity = float(np.mean(list(complexity.values())))
+                pool_units = state.pending_count() * mean_complexity
+                queued_units = {
+                    worker.worker_id: len(worker.queue) * mean_complexity
+                    for worker in workers
+                }
+                total_queued_units = sum(queued_units.values())
+                fastest_speed = min(speeds.values())
+
                 # Claim frames synchronously, then issue the add-RPCs
                 # concurrently (the reference queues serially in the tick
                 # loop; batching the RPCs keeps tick latency flat as the
@@ -166,6 +312,20 @@ async def tpu_batch_strategy(
                 tasks = []
                 for i, frame_index in enumerate(frames):
                     worker, _position = slots[int(assignment[i])]
+                    others_rate = cluster_rate - 1.0 / max(
+                        1e-6, speeds[worker.worker_id]
+                    )
+                    # Everything the rest of the cluster still has to chew
+                    # through: the pending pool plus their own queues.
+                    rest_units = max(
+                        0.0, pool_units - complexity[frame_index]
+                    ) + (total_queued_units - queued_units[worker.worker_id])
+                    rest_seconds = (
+                        rest_units / others_rate if others_rate > 0 else float("inf")
+                    )
+                    horizon = rest_seconds + fastest_speed * complexity[frame_index]
+                    if cost[i, int(assignment[i])] > horizon:
+                        continue  # leave pending; a better slot will open
                     state.mark_frame_as_queued(frame_index, worker.worker_id, time.time())
                     tasks.append(assign(frame_index, worker))
                 await asyncio.gather(*tasks)

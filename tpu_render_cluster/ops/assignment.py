@@ -90,14 +90,24 @@ def _auction_solve(
         assignment = jnp.full((n,), -1, dtype=jnp.int32)
         owner = jnp.full((n,), -1, dtype=jnp.int32)
 
-        def bounded_body(_, inner):
-            return jax.lax.cond(
-                jnp.any(inner[0] < 0), lambda c: body(eps, c), lambda c: c, inner
+        # while_loop (not a fixed-trip fori): the auction typically
+        # converges in a few dozen rounds, and the scheduler calls this
+        # every 50 ms tick — paying the full iteration cap per phase would
+        # dominate the tick budget on the CPU backend.
+        def not_done(loop_carry):
+            iteration, (inner_assignment, _, _) = loop_carry
+            return jnp.logical_and(
+                iteration < iterations_per_phase, jnp.any(inner_assignment < 0)
             )
 
-        return jax.lax.fori_loop(
-            0, iterations_per_phase, bounded_body, (assignment, owner, prices)
+        def step(loop_carry):
+            iteration, inner = loop_carry
+            return iteration + 1, body(eps, inner)
+
+        _, result = jax.lax.while_loop(
+            not_done, step, (0, (assignment, owner, prices))
         )
+        return result
 
     prices0 = jnp.zeros((n,), dtype=jnp.float32)
     assignment0 = jnp.full((n,), -1, dtype=jnp.int32)
@@ -148,6 +158,20 @@ def _greedy_fallback(cost_matrix: np.ndarray) -> np.ndarray:
         out[item] = slot
         taken[slot] = True
     return out
+
+
+def warmup(max_slots: int) -> None:
+    """Pre-compile the auction for every bucket size up to ``max_slots``.
+
+    The jit cache is keyed on the padded (square, power-of-two) shape; the
+    master calls this while waiting for workers at the barrier so the first
+    scheduling tick doesn't pay XLA compilation inside the timed job.
+    """
+    size = 8
+    target = _next_bucket(max(1, max_slots))
+    while size <= target:
+        _auction_solve(jnp.zeros((size, size), dtype=jnp.float32)).block_until_ready()
+        size *= 2
 
 
 # Batched solve over a leading batch axis of square cost matrices.

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import asyncio
 import time
+from typing import Callable
 
 from tpu_render_cluster.jobs.models import BlenderJob
 from tpu_render_cluster.traces.worker_trace import FrameRenderTime
@@ -23,11 +24,15 @@ class MockBackend(RenderBackend):
         render_seconds: float = 0.02,
         save_seconds: float = 0.005,
         fail_frames: set[int] | None = None,
+        render_seconds_fn: Callable[[int], float] | None = None,
     ) -> None:
         self.load_seconds = load_seconds
         self.render_seconds = render_seconds
         self.save_seconds = save_seconds
         self.fail_frames = fail_frames or set()
+        # Per-frame render duration override, for heterogeneous-cost
+        # workloads (animated scenes whose cost varies by frame index).
+        self.render_seconds_fn = render_seconds_fn
         self.rendered_frames: list[int] = []
 
     async def render_frame(self, job: BlenderJob, frame_index: int) -> FrameRenderTime:
@@ -38,7 +43,12 @@ class MockBackend(RenderBackend):
             self.fail_frames.discard(frame_index)  # fail once, then succeed
             raise RuntimeError(f"mock render failure for frame {frame_index}")
         started_rendering = time.time()
-        await asyncio.sleep(self.render_seconds)
+        render_seconds = (
+            self.render_seconds_fn(frame_index)
+            if self.render_seconds_fn is not None
+            else self.render_seconds
+        )
+        await asyncio.sleep(render_seconds)
         finished_rendering = time.time()
         saving_started = time.time()
         await asyncio.sleep(self.save_seconds)

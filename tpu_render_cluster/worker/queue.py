@@ -61,11 +61,16 @@ class WorkerAutomaticQueue:
         self._frames: list[QueuedFrame] = []
         self._finished_indices: set[tuple[str, int]] = set()
         self._task: asyncio.Task | None = None
+        # Wakes the render loop as soon as work arrives; the 100 ms sleep
+        # remains only as a fallback poll (the reference burns up to a full
+        # poll interval of idle time per queue refill — queue.rs:74-96).
+        self._work_available = asyncio.Event()
 
     # -- queue interface (called from the message manager) -------------------
 
     def queue_frame(self, job: BlenderJob, frame_index: int) -> None:
         self._frames.append(QueuedFrame(job, frame_index))
+        self._work_available.set()
 
     def unqueue_frame(self, job_name: str, frame_index: int) -> str:
         """Returns the frame-queue-remove result enum wire value.
@@ -110,7 +115,13 @@ class WorkerAutomaticQueue:
         while not self._cancellation.is_cancelled():
             frame = self._next_queued()
             if frame is None:
-                await asyncio.sleep(QUEUE_POLL_SECONDS)
+                self._work_available.clear()
+                try:
+                    await asyncio.wait_for(
+                        self._work_available.wait(), QUEUE_POLL_SECONDS
+                    )
+                except asyncio.TimeoutError:
+                    pass
                 continue
             await self._render_frame_and_report(frame)
 
