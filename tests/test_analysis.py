@@ -169,6 +169,35 @@ def test_run_all_cli(results_dir, tmp_path):
     assert (out / "speedup_efficiency.png").exists()
 
 
+def test_run_all_defaults_to_canonical_paths(results_dir, tmp_path, monkeypatch):
+    # VERDICT round-2 item 7 (A3): with no CLI args, run_all must read the
+    # canonical results/cluster-runs directory and write to results/analysis
+    # (tpu_render_cluster/analysis/paths.py) — the same convention the SLURM
+    # scripts and the master's default --resultsDirectory use.
+    from tpu_render_cluster.analysis import run_all
+
+    canonical_out = tmp_path / "analysis"
+    monkeypatch.setattr(run_all, "DEFAULT_RESULTS_DIR", results_dir)
+    monkeypatch.setattr(run_all, "DEFAULT_ANALYSIS_DIR", canonical_out)
+    assert run_all.main(["--no-plots"]) == 0
+    assert (canonical_out / "statistics.json").exists()
+
+
+def test_canonical_paths_are_consistent():
+    # The SLURM generator, master default, and run_all must agree on the
+    # repo-relative convention.
+    from tpu_render_cluster.analysis.paths import (
+        DEFAULT_ANALYSIS_DIR,
+        DEFAULT_RESULTS_DIR,
+        REPO_ROOT,
+    )
+
+    assert DEFAULT_RESULTS_DIR == REPO_ROOT / "results" / "cluster-runs"
+    assert DEFAULT_ANALYSIS_DIR == REPO_ROOT / "results" / "analysis"
+    template = (REPO_ROOT / "scripts" / "slurm" / "queue-batch_04vs_14400f-5w_dynamic.sh").read_text()
+    assert "results/cluster-runs/" in template
+
+
 def test_worker_count_mismatch_rejected(tmp_path):
     path = synth_trace(
         tmp_path, run_id=1, workers=1,

@@ -1,0 +1,37 @@
+"""Canonical results/analysis path conventions.
+
+The glue that makes harness -> master -> analysis a one-command pipeline
+(reference: analysis/core/paths.py:5-44, which pins
+``blender-projects/04_very-simple/results/arnes-results`` as the canonical
+run-results directory). Here the convention is repo-relative:
+
+- ``results/cluster-runs/``   — raw traces; the SLURM scripts and the
+  master's default ``--resultsDirectory`` write here (one subdirectory per
+  experiment is fine: the loader globs recursively).
+- ``results/analysis/``       — ``run_all`` output: statistics.json + plots.
+- ``results/.trace-cache/``   — parsed-trace pickle cache.
+
+Every path can be overridden by CLI flags; ``TRC_RESULTS_DIR`` /
+``TRC_ANALYSIS_DIR`` environment variables override the defaults (useful on
+clusters where the repo checkout is read-only). Unlike the reference, import
+has no mkdir side effects — callers create what they write.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+BLENDER_PROJECTS_DIR = REPO_ROOT / "blender-projects"
+
+RESULTS_ROOT = Path(os.environ.get("TRC_RESULTS_ROOT", REPO_ROOT / "results"))
+
+DEFAULT_RESULTS_DIR = Path(
+    os.environ.get("TRC_RESULTS_DIR", RESULTS_ROOT / "cluster-runs")
+)
+DEFAULT_ANALYSIS_DIR = Path(
+    os.environ.get("TRC_ANALYSIS_DIR", RESULTS_ROOT / "analysis")
+)
+DEFAULT_CACHE_DIR = RESULTS_ROOT / ".trace-cache"

@@ -1,7 +1,12 @@
 """Run the full analysis pipeline (reference: analysis/run_all.py).
 
 Usage:
-  python -m tpu_render_cluster.analysis.run_all --results <dir> --out <dir>
+  python -m tpu_render_cluster.analysis.run_all [--results <dir>] [--out <dir>]
+
+With no arguments it uses the canonical convention from
+``tpu_render_cluster.analysis.paths``: traces are read from
+``results/cluster-runs`` (where the SLURM scripts and the master's default
+``--resultsDirectory`` write) and output lands in ``results/analysis``.
 """
 
 from __future__ import annotations
@@ -13,13 +18,22 @@ from pathlib import Path
 
 from tpu_render_cluster.analysis import metrics as M
 from tpu_render_cluster.analysis.parser import load_traces
+from tpu_render_cluster.analysis.paths import DEFAULT_ANALYSIS_DIR, DEFAULT_RESULTS_DIR
 from tpu_render_cluster.analysis.timed_context import timed_section
 
 
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="trc-analysis")
-    parser.add_argument("--results", required=True, help="Directory of *_raw-trace.json")
-    parser.add_argument("--out", required=True, help="Output directory for plots + stats")
+    parser.add_argument(
+        "--results",
+        default=str(DEFAULT_RESULTS_DIR),
+        help="Directory of *_raw-trace.json (searched recursively)",
+    )
+    parser.add_argument(
+        "--out",
+        default=str(DEFAULT_ANALYSIS_DIR),
+        help="Output directory for plots + stats",
+    )
     parser.add_argument("--no-plots", action="store_true")
     args = parser.parse_args(argv)
 

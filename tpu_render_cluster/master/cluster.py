@@ -206,7 +206,14 @@ class ClusterManager:
                     raise RuntimeError("Cancelled while waiting for workers.")
                 await asyncio.sleep(BARRIER_POLL_SECONDS)
             if warmup_task is not None:
-                await warmup_task
+                try:
+                    await warmup_task
+                except Exception as e:  # noqa: BLE001 - latency opt, not fatal
+                    logger.warning(
+                        "Auction warmup failed (%s); first ticks will pay "
+                        "compilation lazily.",
+                        e,
+                    )
         except BaseException:
             if warmup_task is not None and not warmup_task.done():
                 warmup_task.cancel()

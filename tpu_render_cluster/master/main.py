@@ -35,7 +35,13 @@ def build_parser() -> argparse.ArgumentParser:
     run_job = subparsers.add_parser("run-job", help="Run a job to completion")
     run_job.add_argument("job_file_path")
     run_job.add_argument(
-        "--resultsDirectory", dest="results_directory", required=True
+        "--resultsDirectory",
+        dest="results_directory",
+        default=None,
+        help="Where raw traces + processed results are written. Defaults to "
+        "the canonical results/cluster-runs directory "
+        "(tpu_render_cluster/analysis/paths.py), which run_all reads with "
+        "no arguments.",
     )
     run_job.add_argument(
         "--resume",
@@ -53,6 +59,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 async def run_job_command(args: argparse.Namespace) -> int:
+    if args.results_directory is None:
+        from tpu_render_cluster.analysis.paths import DEFAULT_RESULTS_DIR
+
+        args.results_directory = str(DEFAULT_RESULTS_DIR)
     job = BlenderJob.load_from_file(args.job_file_path)
     start_time = datetime.now()
     manager = ClusterManager(args.host, args.port, job)

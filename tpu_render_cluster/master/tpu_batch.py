@@ -148,7 +148,7 @@ class JointCostModel:
 
     def __init__(self, alpha: float) -> None:
         self.worker_speed = WorkerCostModel(alpha)
-        self.frame_complexity = FrameComplexityModel()
+        self.frame_complexity = FrameComplexityModel(alpha)
 
     def observe(self, worker_id: int, frame_index: int, seconds: float) -> None:
         complexity_estimate = max(1e-6, self.frame_complexity.predict(frame_index))
@@ -228,14 +228,9 @@ async def tpu_batch_strategy(
         # to each worker's predicted rate (uniform targets until history
         # arrives — the cold-start case falls back to eager-coarse shape).
         upcoming = state.pending_frames(limit=2 * RATE_TARGET_CAP)
+        complexity_memo = cost_model.frame_complexity.predict_many(upcoming)
         batch_mean_complexity = (
-            float(
-                np.mean(
-                    [cost_model.frame_complexity.predict(f) for f in upcoming]
-                )
-            )
-            if upcoming
-            else 1.0
+            float(np.mean(list(complexity_memo.values()))) if upcoming else 1.0
         )
         slots: list[tuple["WorkerHandle", int]] = []
         for worker in workers:
@@ -256,12 +251,24 @@ async def tpu_batch_strategy(
             deficit = target - len(worker.queue)
             for position in range(max(0, deficit)):
                 slots.append((worker, position))
-        del slots[MAX_SLOTS_PER_TICK:]
+        # Stay within pre-compiled auction buckets (late-joining workers can
+        # push the slot count past what the barrier-time warmup covered);
+        # excess workers are topped up on later ticks.
+        from tpu_render_cluster.ops.assignment import warmed_max_slots
+
+        slot_cap = MAX_SLOTS_PER_TICK
+        if 0 < warmed_max_slots() < slot_cap:
+            slot_cap = warmed_max_slots()
+        del slots[slot_cap:]
 
         if slots:
             frames = state.pending_frames(limit=len(slots))
             if frames:
-                complexity = cost_model.frame_complexity.predict_many(frames)
+                complexity = {
+                    f: complexity_memo.get(f)
+                    or cost_model.frame_complexity.predict(f)
+                    for f in frames
+                }
                 cost = build_cost_matrix(
                     frames,
                     slots,

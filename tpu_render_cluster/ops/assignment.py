@@ -160,6 +160,9 @@ def _greedy_fallback(cost_matrix: np.ndarray) -> np.ndarray:
     return out
 
 
+_warmed_max_slots = 0
+
+
 def warmup(max_slots: int) -> None:
     """Pre-compile the auction for every bucket size up to ``max_slots``.
 
@@ -167,11 +170,18 @@ def warmup(max_slots: int) -> None:
     master calls this while waiting for workers at the barrier so the first
     scheduling tick doesn't pay XLA compilation inside the timed job.
     """
+    global _warmed_max_slots
     size = 8
     target = _next_bucket(max(1, max_slots))
     while size <= target:
         _auction_solve(jnp.zeros((size, size), dtype=jnp.float32)).block_until_ready()
+        _warmed_max_slots = max(_warmed_max_slots, size)
         size *= 2
+
+
+def warmed_max_slots() -> int:
+    """Largest pre-compiled bucket size (0 when warmup never ran)."""
+    return _warmed_max_slots
 
 
 # Batched solve over a leading batch axis of square cost matrices.
