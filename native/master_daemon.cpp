@@ -38,6 +38,7 @@
 #include <dirent.h>
 #include <ctime>
 #include <limits>
+#include <list>
 #include <map>
 #include <sys/select.h>
 #include <sys/wait.h>
@@ -243,6 +244,9 @@ struct WorkerConn {
     std::atomic<int> generation{0};
     std::atomic<double> last_heartbeat_response;
     double last_heartbeat_sent = 0;  // scheduler-thread only
+    // Consecutive scheduling-RPC timeouts (half-open-connection detector;
+    // reset on any successful scheduling RPC).
+    std::atomic<int> sched_rpc_strikes{0};
     std::deque<FrameOnWorker> queue;  // guarded by the master's state mutex
     std::thread reader;
     Json trace;  // filled by collect_traces
@@ -554,12 +558,14 @@ class MasterDaemon {
         if (heartbeat_thread_.joinable()) heartbeat_thread_.join();
         shutdown_listener();
         if (acceptor_.joinable()) acceptor_.join();
+        close_listener();
         {
             // Bounded by the 15 s handshake receive timeout.
             std::lock_guard<std::mutex> lock(handshake_mutex_);
-            for (auto& thread : handshake_threads_) {
-                if (thread.joinable()) thread.join();
+            for (auto& slot : handshake_threads_) {
+                if (slot->thread.joinable()) slot->thread.join();
             }
+            handshake_threads_.clear();
         }
         join_readers();
 
@@ -574,7 +580,11 @@ class MasterDaemon {
   private:
     MasterOptions options_;
     JobView job_;
-    int listen_fd_ = -1;
+    // Atomic: shutdown_listener() (main thread) races the accept loop's
+    // reads (found by TSAN — tests/test_cpp_sanitizers.py). shutdown()
+    // wakes the blocked select/accept; close happens only after the
+    // acceptor exits so the fd cannot be recycled under it.
+    std::atomic<int> listen_fd_{-1};
     std::thread acceptor_;
     std::thread heartbeat_thread_;
     std::atomic<bool> cancelled_{false};
@@ -592,8 +602,28 @@ class MasterDaemon {
     std::mutex workers_mutex_;
     std::map<uint32_t, std::unique_ptr<WorkerConn>> workers_;
 
+    // Handshake threads are reaped as they finish (the acceptor sweeps
+    // done slots each loop): a flapping client over a multi-hour job must
+    // not accumulate one parked std::thread per connection attempt.
+    struct HandshakeSlot {
+        std::thread thread;
+        std::atomic<bool> done{false};
+    };
     std::mutex handshake_mutex_;
-    std::vector<std::thread> handshake_threads_;
+    std::list<std::unique_ptr<HandshakeSlot>> handshake_threads_;
+
+    void reap_finished_handshakes() {
+        std::lock_guard<std::mutex> lock(handshake_mutex_);
+        for (auto it = handshake_threads_.begin();
+             it != handshake_threads_.end();) {
+            if ((*it)->done.load()) {
+                if ((*it)->thread.joinable()) (*it)->thread.join();
+                it = handshake_threads_.erase(it);
+            } else {
+                ++it;
+            }
+        }
+    }
 
     std::mutex responses_mutex_;
     std::condition_variable responses_cv_;
@@ -621,15 +651,25 @@ class MasterDaemon {
         std::string name_format = name_value->as_string();
         std::string extension = lowercase_ascii(format_value->as_string());
         if (extension == "jpeg") extension = "jpg";
+        // No-placeholder formats still resume (parity with
+        // master/resume.py): the renderer appends the frame number to the
+        // fixed name (image_io.format_frame_placeholders), and a bare
+        // "<name>.<ext>" hit covers the single frame of a 1-frame job.
         size_t hash_start = name_format.find('#');
-        if (hash_start == std::string::npos) return;
         size_t hash_count = 0;
-        while (hash_start + hash_count < name_format.size() &&
-               name_format[hash_start + hash_count] == '#')
-            hash_count++;
-        std::string prefix = name_format.substr(0, hash_start);
-        std::string suffix =
-            name_format.substr(hash_start + hash_count) + "." + extension;
+        std::string prefix;
+        std::string suffix;
+        if (hash_start == std::string::npos) {
+            prefix = name_format;
+            suffix = "." + extension;
+        } else {
+            while (hash_start + hash_count < name_format.size() &&
+                   name_format[hash_start + hash_count] == '#')
+                hash_count++;
+            prefix = name_format.substr(0, hash_start);
+            suffix = name_format.substr(hash_start + hash_count) + "." +
+                     extension;
+        }
 
         DIR* handle = opendir(directory.c_str());
         if (handle == nullptr) return;
@@ -637,23 +677,31 @@ class MasterDaemon {
         struct dirent* entry;
         while ((entry = readdir(handle)) != nullptr) {
             std::string file_name = entry->d_name;
-            if (file_name.size() <= prefix.size() + suffix.size()) continue;
+            if (file_name.size() < prefix.size() + suffix.size()) continue;
             if (file_name.compare(0, prefix.size(), prefix) != 0) continue;
             if (file_name.compare(file_name.size() - suffix.size(),
                                   suffix.size(), suffix) != 0)
                 continue;
             std::string digits = file_name.substr(
                 prefix.size(), file_name.size() - prefix.size() - suffix.size());
-            // Width must be at least the # run's (matches resume.py's
-            // \d{width,}) so foreign short-numbered files are rejected.
-            if (digits.size() < hash_count ||
-                digits.find_first_not_of("0123456789") != std::string::npos)
-                continue;
+            int frame_index;
+            if (digits.empty()) {
+                // Fixed-name output: the one file IS the one frame.
+                if (hash_count != 0 || frames_.size() != 1) continue;
+                frame_index = job_.frame_from;
+            } else {
+                // Width must be at least the # run's (matches resume.py's
+                // \d{width,}) so foreign short-numbered files are rejected.
+                if (digits.size() < hash_count ||
+                    digits.find_first_not_of("0123456789") !=
+                        std::string::npos)
+                    continue;
+                frame_index = atoi(digits.c_str());
+            }
             struct stat info;
             std::string full_path = directory + "/" + file_name;
             if (stat(full_path.c_str(), &info) != 0 || info.st_size == 0)
                 continue;  // truncated output from a killed render
-            int frame_index = atoi(digits.c_str());
             std::lock_guard<std::mutex> lock(state_mutex_);
             FrameSlot* slot = slot_for(frame_index);
             if (slot != nullptr && slot->status == FrameStatus::Pending) {
@@ -699,22 +747,29 @@ class MasterDaemon {
     }
 
     void shutdown_listener() {
-        if (listen_fd_ >= 0) {
-            ::shutdown(listen_fd_, SHUT_RDWR);
-            ::close(listen_fd_);
-            listen_fd_ = -1;
-        }
+        // Only shutdown() here: it unblocks the acceptor's select/accept
+        // without invalidating the fd number while that thread still uses
+        // it. run() calls close_listener() after joining the acceptor.
+        int fd = listen_fd_.load();
+        if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+    }
+
+    void close_listener() {
+        int fd = listen_fd_.exchange(-1);
+        if (fd >= 0) ::close(fd);
     }
 
     // Accept loop with 2 s cancellation poll
     // (reference: master/src/cluster/mod.rs:280-318).
     void accept_loop() {
         while (!cancelled_.load()) {
+            int listen_fd = listen_fd_.load();
+            if (listen_fd < 0) return;
             fd_set fds;
             FD_ZERO(&fds);
-            FD_SET(listen_fd_, &fds);
+            FD_SET(listen_fd, &fds);
             struct timeval tv = {2, 0};
-            int rc = select(listen_fd_ + 1, &fds, nullptr, nullptr, &tv);
+            int rc = select(listen_fd + 1, &fds, nullptr, nullptr, &tv);
             if (rc < 0) {
                 if (errno == EINTR) continue;
                 return;
@@ -722,7 +777,7 @@ class MasterDaemon {
             if (rc == 0) continue;
             struct sockaddr_in peer;
             socklen_t peer_len = sizeof(peer);
-            int fd = accept(listen_fd_, reinterpret_cast<struct sockaddr*>(&peer),
+            int fd = accept(listen_fd, reinterpret_cast<struct sockaddr*>(&peer),
                             &peer_len);
             if (fd < 0) continue;
             char ip[64];
@@ -736,9 +791,15 @@ class MasterDaemon {
             struct timeval handshake_timeout = {15, 0};
             setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &handshake_timeout,
                        sizeof(handshake_timeout));
+            reap_finished_handshakes();
+            auto slot = std::make_unique<HandshakeSlot>();
+            HandshakeSlot* raw = slot.get();
+            slot->thread = std::thread([this, fd, address, raw]() {
+                initialize_worker_connection(fd, address);
+                raw->done.store(true);
+            });
             std::lock_guard<std::mutex> lock(handshake_mutex_);
-            handshake_threads_.emplace_back(
-                &MasterDaemon::initialize_worker_connection, this, fd, address);
+            handshake_threads_.push_back(std::move(slot));
         }
     }
 
@@ -1049,6 +1110,33 @@ class MasterDaemon {
         }
     }
 
+    // Scheduling RPCs use a short timeout: these calls run synchronously in
+    // the single scheduling thread, so one half-open worker (TCP up,
+    // application dead) waiting out the full 60 s protocol timeout would
+    // stall frame distribution to the whole cluster. Three consecutive
+    // timeouts evict the worker (its frames requeue), the same remedy the
+    // heartbeat monitor applies to fully-silent peers.
+    static constexpr double SCHED_RPC_TIMEOUT_S = 5.0;
+    static constexpr int SCHED_RPC_MAX_STRIKES = 3;
+
+    void note_sched_rpc_result(WorkerConn& worker, bool ok) {
+        if (ok) {
+            worker.sched_rpc_strikes.store(0);
+            return;
+        }
+        if (cancelled_.load() || worker.evicted.load() ||
+            !worker.connected.load())
+            return;  // not a half-open stall; other machinery handles these
+        int strikes = worker.sched_rpc_strikes.fetch_add(1) + 1;
+        if (strikes >= SCHED_RPC_MAX_STRIKES &&
+            options_.evict_after_seconds > 0) {
+            LOG_ERROR("Worker %08x timed out %d scheduling RPCs in a row; "
+                      "treating as half-open.",
+                      worker.id, strikes);
+            evict_worker(&worker);
+        }
+    }
+
     // queue_frame (reference: master/src/connection/mod.rs:139-168): mark
     // queued optimistically, RPC, revert on failure.
     bool queue_frame(WorkerConn& worker, int frame_index, bool stolen = false,
@@ -1067,29 +1155,34 @@ class MasterDaemon {
         payload.set("frame_index", Json::make_int(frame_index));
         uint64_t request_id = rng()();
         Json response;
-        bool ok = rpc(worker, "request_frame-queue_add", std::move(payload),
-                      request_id, 60.0, &response);
+        bool rpc_ok = rpc(worker, "request_frame-queue_add", std::move(payload),
+                          request_id, SCHED_RPC_TIMEOUT_S, &response);
+        bool ok = rpc_ok;
         if (ok) {
             const Json* result = response.get("result");
             const Json* value =
                 result != nullptr ? result->get("result") : nullptr;
             ok = value != nullptr && value->as_string() == "added-to-queue";
         }
-        std::lock_guard<std::mutex> lock(state_mutex_);
-        FrameSlot* slot = slot_for(frame_index);
-        if (ok) {
-            FrameOnWorker entry;
-            entry.frame_index = frame_index;
-            entry.queued_at = now_ts();
-            entry.stolen = stolen;
-            entry.stolen_from_worker = stolen_from;
-            worker.queue.push_back(entry);
-        } else if (slot != nullptr && slot->status == FrameStatus::Queued &&
-                   slot->worker == worker.id) {
-            slot->status = FrameStatus::Pending;
-            slot->worker = 0;
-            next_pending_hint_ = 0;
+        {
+            std::lock_guard<std::mutex> lock(state_mutex_);
+            FrameSlot* slot = slot_for(frame_index);
+            if (ok) {
+                FrameOnWorker entry;
+                entry.frame_index = frame_index;
+                entry.queued_at = now_ts();
+                entry.stolen = stolen;
+                entry.stolen_from_worker = stolen_from;
+                worker.queue.push_back(entry);
+            } else if (slot != nullptr &&
+                       slot->status == FrameStatus::Queued &&
+                       slot->worker == worker.id) {
+                slot->status = FrameStatus::Pending;
+                slot->worker = 0;
+                next_pending_hint_ = 0;
+            }
         }
+        note_sched_rpc_result(worker, rpc_ok);
         return ok;
     }
 
@@ -1287,10 +1380,10 @@ class MasterDaemon {
         payload.set("frame_index", Json::make_int(frame_index));
         uint64_t request_id = rng()();
         Json response;
-        if (!rpc(*victim, "request_frame-queue_remove", std::move(payload),
-                 request_id, 60.0, &response)) {
-            return;
-        }
+        bool ok = rpc(*victim, "request_frame-queue_remove", std::move(payload),
+                      request_id, SCHED_RPC_TIMEOUT_S, &response);
+        note_sched_rpc_result(*victim, ok);
+        if (!ok) return;
         const Json* result = response.get("result");
         const Json* value = result != nullptr ? result->get("result") : nullptr;
         std::string outcome = value != nullptr ? value->as_string() : "errored";

@@ -1,0 +1,329 @@
+#!/usr/bin/env python
+"""Run the recorded experiment matrix and the BASELINE.md north-star config.
+
+Suites (each runs real master + N workers over localhost WebSockets via
+tpu_render_cluster.harness, persisting reference-schema raw traces under
+the canonical results/cluster-runs directory):
+
+- ``mock``               — {naive-fine, eager-naive-coarse, dynamic,
+  tpu-batch} x {1,2,4,8} workers x repeats, sleep-based mock renderer with
+  heterogeneous worker speeds and per-frame complexity (the reference's
+  04_very-simple 14400-frame matrix, shrunk to laptop scale — reference:
+  analysis/results_statistics.py:34-73 counts the same strategy x size
+  populations).
+- ``northstar-baseline`` — 1-worker eager-naive-coarse job with the
+  tpu-raytrace backend forced onto CPU: the stand-in for the reference's
+  1-worker CPU Blender baseline (BASELINE.md "Sequential baseline").
+- ``northstar-tpu``      — the north-star config: 10-frame 04_very-simple
+  job, tpu-batch scheduler + tpu-raytrace workers on the TPU chip.
+- ``all``                — orchestrates the three above as subprocesses
+  with the right JAX_PLATFORMS per suite, then runs the analysis pipeline
+  over each result set.
+
+The render jit cache is pre-warmed before the timed job (both baseline and
+TPU pay compilation equally outside the measured window), mirroring how the
+reference excludes Blender binary startup from its job window.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO_ROOT))
+
+# 04_very-simple at 512x512, 8 spp: heavy enough per frame (~0.2 s on the
+# chip including image readback, ~7.7 s on CPU) that per-dispatch transfer
+# latency doesn't mask the device advantage, light enough that the recorded
+# CPU baseline runs stay in CI-friendly territory.
+NORTHSTAR_FRAMES = 10
+NORTHSTAR_WIDTH = 512
+NORTHSTAR_HEIGHT = 512
+NORTHSTAR_SAMPLES = 8
+NORTHSTAR_BOUNCES = 4
+
+
+def make_job(job_name, strategy, frames, workers, output_directory):
+    from tpu_render_cluster.jobs.models import BlenderJob
+
+    return BlenderJob(
+        job_name=job_name,
+        job_description="recorded experiment-matrix run",
+        project_file_path="%BASE%/p.blend",
+        render_script_path="%BASE%/s.py",
+        frame_range_from=1,
+        frame_range_to=frames,
+        wait_for_number_of_workers=workers,
+        frame_distribution_strategy=strategy,
+        output_directory_path=str(output_directory),
+        output_file_name_format="rendered-#####",
+        output_file_format="PNG",
+    )
+
+
+def strategy_by_name(name):
+    from tpu_render_cluster.jobs.models import (
+        DistributionStrategy,
+        DynamicStrategyOptions,
+        TpuBatchStrategyOptions,
+    )
+
+    if name == "naive-fine":
+        return DistributionStrategy.naive_fine()
+    if name == "eager-naive-coarse":
+        return DistributionStrategy.eager_naive_coarse(5)
+    if name == "dynamic":
+        return DistributionStrategy.dynamic_strategy(
+            DynamicStrategyOptions(4, 2, 1, 2)
+        )
+    if name == "tpu-batch":
+        return DistributionStrategy.tpu_batch_strategy(
+            TpuBatchStrategyOptions(
+                target_queue_size=4,
+                min_queue_size_to_steal=2,
+                min_seconds_before_resteal_to_elsewhere=1,
+                min_seconds_before_resteal_to_original_worker=2,
+            )
+        )
+    raise ValueError(name)
+
+
+def run_mock_suite(results_root: Path, repeats: int) -> None:
+    from tpu_render_cluster.harness import run_and_persist
+    from tpu_render_cluster.worker.backends.mock import MockBackend
+
+    # Long enough that queue-based strategies' dynamics (steal timers,
+    # cost-model warm-up) actually engage; the reference's 14400-frame jobs
+    # ran minutes to hours.
+    frames = 96
+    base_seconds = 0.08
+
+    def complexity(frame_index: int) -> float:
+        # Animated-scene cost ramp: later frames are heavier.
+        return 1.0 + frame_index / 64.0
+
+    for strategy_name in ("naive-fine", "eager-naive-coarse", "dynamic", "tpu-batch"):
+        for workers in (1, 2, 4, 8):
+            for repeat in range(repeats):
+                job = make_job(
+                    "mock-matrix",
+                    strategy_by_name(strategy_name),
+                    frames,
+                    workers,
+                    "/tmp/trc-mock-out",
+                )
+                backends = [
+                    MockBackend(
+                        load_seconds=0.002,
+                        save_seconds=0.002,
+                        # Heterogeneous cluster: worker i is up to ~1.8x
+                        # slower than worker 0.
+                        render_seconds_fn=(
+                            lambda f, i=i: base_seconds
+                            * (1.0 + 0.12 * i)
+                            * complexity(f)
+                        ),
+                    )
+                    for i in range(workers)
+                ]
+                label = f"{strategy_name}_{workers}w_r{repeat + 1}"
+                path = run_and_persist(
+                    job, backends, results_root / "mock-matrix", timeout=300
+                )
+                print(f"[mock] {label}: {path.name}", flush=True)
+
+
+def _warm_render_cache() -> None:
+    """Compile the fused renderer outside the timed job (once per process)."""
+    from tpu_render_cluster.render.integrator import fused_frame_renderer
+
+    fused_frame_renderer(
+        "04_very-simple",
+        NORTHSTAR_WIDTH,
+        NORTHSTAR_HEIGHT,
+        NORTHSTAR_SAMPLES,
+        NORTHSTAR_BOUNCES,
+    )(1).block_until_ready()
+
+
+def _tpu_batch_strategy():
+    from tpu_render_cluster.jobs.models import (
+        DistributionStrategy,
+        TpuBatchStrategyOptions,
+    )
+
+    return DistributionStrategy.tpu_batch_strategy(
+        TpuBatchStrategyOptions(
+            target_queue_size=2,
+            min_queue_size_to_steal=1,
+            min_seconds_before_resteal_to_elsewhere=1,
+            min_seconds_before_resteal_to_original_worker=2,
+        )
+    )
+
+
+def _raytrace_backends(n: int):
+    from tpu_render_cluster.worker.backends.tpu_raytrace import TpuRaytraceBackend
+
+    return [
+        TpuRaytraceBackend(
+            width=NORTHSTAR_WIDTH,
+            height=NORTHSTAR_HEIGHT,
+            samples=NORTHSTAR_SAMPLES,
+            max_bounces=NORTHSTAR_BOUNCES,
+        )
+        for _ in range(n)
+    ]
+
+
+def run_northstar(results_root: Path, repeats: int, *, tpu: bool) -> None:
+    from tpu_render_cluster.jobs.models import DistributionStrategy
+    from tpu_render_cluster.harness import run_and_persist
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    print(f"[northstar] JAX platform: {platform}", flush=True)
+    _warm_render_cache()
+
+    with tempfile.TemporaryDirectory(prefix="trc-northstar-") as out_dir:
+        if tpu:
+            # (a) The exact BASELINE.md north-star job: 10 frames,
+            # tpu-batch scheduler, 4 tpu-raytrace workers (speedup headline,
+            # same analysis population as the CPU baseline below).
+            for repeat in range(repeats):
+                job = make_job(
+                    "04_very-simple", _tpu_batch_strategy(), NORTHSTAR_FRAMES, 4, out_dir
+                )
+                path = run_and_persist(
+                    job, _raytrace_backends(4),
+                    results_root / "northstar-10f/tpu-batch_4w_tpu-raytrace",
+                    timeout=1800,
+                )
+                print(f"[northstar tpu 10f] r{repeat + 1}: {path.name}", flush=True)
+            # (b) A production-scale 64-frame run for the utilization
+            # headline: with 10 frames across 4 workers, scheduler lead-in
+            # dominates each worker's tiny window; 64 frames amortize it.
+            for repeat in range(2):
+                job = make_job(
+                    "04_very-simple", _tpu_batch_strategy(), 64, 4, out_dir
+                )
+                path = run_and_persist(
+                    job, _raytrace_backends(4),
+                    results_root / "northstar-util-64f/tpu-batch_4w_tpu-raytrace",
+                    timeout=1800,
+                )
+                print(f"[northstar tpu 64f] r{repeat + 1}: {path.name}", flush=True)
+        else:
+            # Reference 1-worker baselines use eager-naive-coarse with a
+            # target queue of 100 (BASELINE.md "Strategies measured").
+            strategy = DistributionStrategy.eager_naive_coarse(100)
+            for repeat in range(repeats):
+                job = make_job(
+                    "04_very-simple", strategy, NORTHSTAR_FRAMES, 1, out_dir
+                )
+                path = run_and_persist(
+                    job, _raytrace_backends(1),
+                    results_root / "northstar-10f/eager-naive-coarse_1w_cpu-baseline",
+                    timeout=1800,
+                )
+                print(f"[northstar cpu] r{repeat + 1}: {path.name}", flush=True)
+
+
+def run_all(results_root: Path, repeats: int) -> int:
+    """Re-exec per suite with the right JAX platform, then analyze."""
+    script = str(Path(__file__).resolve())
+    axon_site = "/root/.axon_site"
+    base_env = dict(os.environ)
+    repo_paths = [str(REPO_ROOT)]
+    if Path(axon_site).is_dir():
+        repo_paths.append(axon_site)
+
+    def env_for(platform: str) -> dict:
+        env = dict(base_env)
+        env["PYTHONPATH"] = ":".join(repo_paths)
+        if platform == "cpu":
+            env["JAX_PLATFORMS"] = "cpu"
+            env["TRC_PALLAS"] = "0"
+        else:
+            env.pop("JAX_PLATFORMS", None)  # let the plugin pick the chip
+        return env
+
+    suites = [
+        ("mock", "cpu"),
+        ("northstar-baseline", "cpu"),
+        ("northstar-tpu", "tpu"),
+    ]
+    for suite, platform in suites:
+        print(f"=== suite {suite} ({platform}) ===", flush=True)
+        result = subprocess.run(
+            [
+                sys.executable,
+                script,
+                "--suite",
+                suite,
+                "--results",
+                str(results_root),
+                "--repeats",
+                str(repeats),
+            ],
+            env=env_for(platform),
+        )
+        if result.returncode != 0:
+            print(f"suite {suite} failed rc={result.returncode}", file=sys.stderr)
+            return result.returncode
+
+    # Analysis product, one output tree per experiment population.
+    from tpu_render_cluster.analysis import run_all as analysis
+
+    analysis_root = results_root.parent / "analysis"
+    for name in ("mock-matrix", "northstar-10f", "northstar-util-64f"):
+        rc = analysis.main(
+            [
+                "--results",
+                str(results_root / name),
+                "--out",
+                str(analysis_root / name),
+            ]
+        )
+        if rc != 0:
+            return rc
+    print(json.dumps({"ok": True, "results": str(results_root)}))
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--suite",
+        choices=["mock", "northstar-baseline", "northstar-tpu", "all"],
+        default="all",
+    )
+    parser.add_argument("--results", default=None)
+    parser.add_argument("--repeats", type=int, default=3)
+    args = parser.parse_args()
+
+    from tpu_render_cluster.analysis.paths import DEFAULT_RESULTS_DIR
+
+    results_root = Path(args.results) if args.results else DEFAULT_RESULTS_DIR
+
+    if args.suite == "all":
+        return run_all(results_root, args.repeats)
+    if args.suite == "mock":
+        run_mock_suite(results_root, args.repeats)
+        return 0
+    if args.suite == "northstar-baseline":
+        run_northstar(results_root, max(2, args.repeats - 1), tpu=False)
+        return 0
+    run_northstar(results_root, args.repeats, tpu=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,12 +9,14 @@ beyond-reference eviction path reschedules a killed worker's frames.
 
 from __future__ import annotations
 
+import asyncio
 import os
 import shutil
 import signal
 import socket
 import subprocess
 import sys
+import threading
 import time
 from pathlib import Path
 
@@ -194,6 +196,160 @@ def test_cpp_master_with_python_workers(tmp_path):
         _wait(proc, 30)
     trace = JobTrace.load_from_trace_file(next(results.glob("*_raw-trace.json")))
     assert len(trace.worker_traces) == 2
+
+
+def _run_resumed_master(tmp_path, job_path) -> int:
+    """Run trc-master --resume on a fully-rendered job; returns exit code.
+
+    A fully-resumed job short-circuits before the worker barrier, so the
+    process must exit promptly with rc 0.
+    """
+    master = build_master_daemon()
+    assert master is not None
+    results = tmp_path / "results"
+    proc = _spawn_master(
+        master, _free_port(), job_path, results, "--resume", "--baseDirectory",
+        str(tmp_path),
+    )
+    return _wait(proc, 30)
+
+
+def test_cpp_resume_parity_no_placeholder_single_frame(tmp_path):
+    # VERDICT round-2 C++ defect (b): the C++ master refused to resume jobs
+    # whose output_file_name_format has no '#', while the Python master
+    # resumes them — the two masters diverged on --resume. Both must now
+    # treat a bare "<name>.<ext>" as the one frame of a single-frame job.
+    job_path = tmp_path / "job.toml"
+    job_path.write_text(f'''
+job_name = "resume-parity"
+job_description = "x"
+project_file_path = "%BASE%/p.blend"
+render_script_path = "%BASE%/s.py"
+frame_range_from = 1
+frame_range_to = 1
+wait_for_number_of_workers = 1
+output_directory_path = "{tmp_path / 'frames'}"
+output_file_name_format = "rendered"
+output_file_format = "PNG"
+
+[frame_distribution_strategy]
+strategy_type = "naive-fine"
+''')
+    frames = tmp_path / "frames"
+    frames.mkdir()
+    (frames / "rendered.png").write_bytes(b"x")
+    assert _run_resumed_master(tmp_path, job_path) == 0
+
+    # Python parity on the identical job file.
+    from tpu_render_cluster.jobs.models import BlenderJob
+    from tpu_render_cluster.master.resume import scan_rendered_frames
+
+    job = BlenderJob.load_from_file(job_path)
+    assert scan_rendered_frames(job, tmp_path) == {1}
+
+
+def test_cpp_resume_no_placeholder_appended_digits(tmp_path):
+    # Renderer-appended frame numbers on a fixed-name format resume in the
+    # C++ master too (multi-frame, no '#').
+    job_path = tmp_path / "job.toml"
+    job_path.write_text(f'''
+job_name = "resume-appended"
+job_description = "x"
+project_file_path = "%BASE%/p.blend"
+render_script_path = "%BASE%/s.py"
+frame_range_from = 1
+frame_range_to = 2
+wait_for_number_of_workers = 1
+output_directory_path = "{tmp_path / 'frames'}"
+output_file_name_format = "rendered"
+output_file_format = "PNG"
+
+[frame_distribution_strategy]
+strategy_type = "naive-fine"
+''')
+    frames = tmp_path / "frames"
+    frames.mkdir()
+    (frames / "rendered1.png").write_bytes(b"x")
+    (frames / "rendered2.png").write_bytes(b"x")
+    assert _run_resumed_master(tmp_path, job_path) == 0
+
+
+def _mute_worker_thread(port: int, stop: "threading.Event") -> "threading.Thread":
+    """A half-open worker: handshakes and answers heartbeats, but never
+    responds to frame-queue RPCs while keeping the TCP connection alive."""
+
+    async def run() -> None:
+        from tpu_render_cluster.protocol import messages as pm
+        from tpu_render_cluster.transport.ws import websocket_connect
+
+        ws = await websocket_connect("127.0.0.1", port)
+        request = pm.decode_message(await ws.receive_text())
+        assert isinstance(request, pm.MasterHandshakeRequest)
+        await ws.send_text(
+            pm.encode_message(
+                pm.WorkerHandshakeResponse(
+                    handshake_type="first-connection",
+                    worker_version="1.0.0",
+                    worker_id=0x0BADBEEF,
+                )
+            )
+        )
+        pm.decode_message(await ws.receive_text())  # ack
+        while not stop.is_set():
+            try:
+                message = pm.decode_message(
+                    await asyncio.wait_for(ws.receive_text(), 1.0)
+                )
+            except asyncio.TimeoutError:
+                continue
+            except Exception:
+                return  # master shut the socket (eviction): done
+            if isinstance(message, pm.MasterHeartbeatRequest):
+                await ws.send_text(
+                    pm.encode_message(pm.WorkerHeartbeatResponse())
+                )
+            # Everything else (queue adds, job-finished) is swallowed.
+
+    thread = threading.Thread(target=lambda: asyncio.run(run()), daemon=True)
+    thread.start()
+    return thread
+
+
+def test_half_open_worker_does_not_stall_distribution(tmp_path):
+    """VERDICT round-2 C++ defect (a): scheduling RPCs ran with a 60 s
+    timeout on the single scheduling thread, so one half-open worker (TCP
+    up, application dead) stalled frame distribution to the whole cluster.
+    With the short scheduling-RPC timeout + strike eviction, the job must
+    complete on the healthy worker well before heartbeat-based eviction
+    (disabled here at 120 s) could have saved it."""
+    master = build_master_daemon()
+    worker = build_worker_daemon()
+    assert master is not None and worker is not None
+    port = _free_port()
+    job_path = _write_job(
+        tmp_path, name="cppmaster-halfopen", frames=8, workers=2,
+        strategy_lines='strategy_type = "naive-fine"',
+    )
+    results = tmp_path / "results"
+    master_proc = _spawn_master(
+        master, port, job_path, results, "--evictAfterSeconds", "120"
+    )
+    time.sleep(0.3)
+    stop = threading.Event()
+    mute = _mute_worker_thread(port, stop)
+    healthy = _spawn_cpp_worker(worker, port, mock_ms=30)
+    try:
+        # Worst case: 3 strikes x 5 s timeout + scheduling overhead. The
+        # old behavior (single 60 s add-RPC timeout per tick, eviction only
+        # via 120 s heartbeat silence) cannot finish within this window.
+        assert _wait(master_proc, 60) == 0
+    finally:
+        stop.set()
+        healthy.kill()
+        healthy.wait()
+        mute.join(timeout=5)
+    rendered = sorted((tmp_path / "frames").glob("rendered-*.png"))
+    assert len(rendered) == 8
 
 
 def test_eviction_requeues_dead_workers_frames(tmp_path):

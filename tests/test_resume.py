@@ -64,6 +64,31 @@ def test_scan_base_placeholder(tmp_path):
     assert scan_rendered_frames(job, tmp_path) == {8}
 
 
+def test_scan_no_placeholder_fixed_name_single_frame(tmp_path):
+    # No '#' in the format: a bare "<name>.<ext>" hit covers the one frame
+    # of a single-frame job (VERDICT round-2 C++ defect (b) parity surface).
+    job = _job(tmp_path, name_format="rendered", frames=1)
+    _touch(tmp_path / "frames", "rendered.png")
+    assert scan_rendered_frames(job) == {1}
+
+
+def test_scan_no_placeholder_fixed_name_multi_frame_is_ambiguous(tmp_path):
+    job = _job(tmp_path, name_format="rendered", frames=3)
+    _touch(tmp_path / "frames", "rendered.png")
+    assert scan_rendered_frames(job) == set()
+
+
+def test_scan_no_placeholder_appended_digits(tmp_path):
+    # The renderer appends the frame number to fixed-name formats
+    # (image_io.format_frame_placeholders), so resume must pick those up
+    # even for multi-frame jobs.
+    job = _job(tmp_path, name_format="rendered", frames=5)
+    for i in (1, 4):
+        _touch(tmp_path / "frames", f"rendered{i}.png")
+    _touch(tmp_path / "frames", "rendered99.png")  # out of range: ignored
+    assert scan_rendered_frames(job) == {1, 4}
+
+
 def test_apply_resume_marks_finished_and_strategy_skips(tmp_path):
     job = _job(tmp_path, frames=6)
     frames = tmp_path / "frames"

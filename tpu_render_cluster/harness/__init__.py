@@ -1,0 +1,12 @@
+"""Experiment harness: in-process cluster runs + the recorded matrix.
+
+The reference validates itself with SLURM runs on real clusters
+(SURVEY.md §4); this package is the single-host counterpart — it runs a
+real master + N real workers over localhost WebSockets inside one process
+and persists reference-schema raw traces, so the measurement product
+(analysis A5-A12) can be produced and regenerated anywhere.
+"""
+
+from tpu_render_cluster.harness.local import run_local_job, run_and_persist
+
+__all__ = ["run_local_job", "run_and_persist"]

@@ -1,0 +1,68 @@
+"""In-process cluster runner: one master + N workers over localhost.
+
+Every run uses the full production stack — ClusterManager's accepting
+server, the 3-step handshake, heartbeats, and the real distribution
+strategies — only colocated in a single asyncio loop, exactly like the
+integration tests. Traces are persisted with the same writer the master
+CLI uses, so the output is indistinguishable from a multi-host run
+(reference: master/src/main.rs:26-338 persistence path).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from datetime import datetime
+from pathlib import Path
+
+from tpu_render_cluster.jobs.models import BlenderJob
+from tpu_render_cluster.master.cluster import ClusterManager
+from tpu_render_cluster.master.persist import (
+    parse_worker_traces,
+    save_processed_results,
+    save_raw_traces,
+)
+from tpu_render_cluster.traces.master_trace import MasterTrace
+from tpu_render_cluster.traces.worker_trace import WorkerTrace
+from tpu_render_cluster.worker.backends.base import RenderBackend
+from tpu_render_cluster.worker.runtime import Worker
+
+
+async def _run(job: BlenderJob, backends: list[RenderBackend]):
+    manager = ClusterManager("127.0.0.1", 0, job)
+    server_task = asyncio.create_task(manager.initialize_server_and_run_job())
+    while manager._server is None:
+        await asyncio.sleep(0.01)
+    workers = [Worker("127.0.0.1", manager.port, backend) for backend in backends]
+    worker_tasks = [
+        asyncio.create_task(w.connect_and_run_to_job_completion()) for w in workers
+    ]
+    master_trace, worker_traces = await server_task
+    await asyncio.gather(*worker_tasks)
+    return master_trace, worker_traces
+
+
+def run_local_job(
+    job: BlenderJob,
+    backends: list[RenderBackend],
+    *,
+    timeout: float = 600.0,
+) -> tuple[MasterTrace, list[tuple[str, WorkerTrace]]]:
+    """Run one job on an in-process cluster; returns (master trace, worker traces)."""
+    return asyncio.run(asyncio.wait_for(_run(job, backends), timeout))
+
+
+def run_and_persist(
+    job: BlenderJob,
+    backends: list[RenderBackend],
+    results_directory: str | Path,
+    *,
+    timeout: float = 600.0,
+) -> Path:
+    """Run and write ``*_raw-trace.json`` + processed results; returns the raw path."""
+    start = datetime.now()
+    master_trace, worker_traces = run_local_job(job, backends, timeout=timeout)
+    results_directory = Path(results_directory)
+    raw_path = save_raw_traces(start, job, results_directory, master_trace, worker_traces)
+    performance = parse_worker_traces(worker_traces)
+    save_processed_results(start, job, results_directory, performance)
+    return raw_path

@@ -37,10 +37,12 @@ def _output_pattern(job: BlenderJob) -> re.Pattern[str]:
     if extension == "jpeg":
         extension = "jpg"
     if match is None:
-        # No placeholder: a fixed name can only cover a single-frame job;
-        # capture nothing — the caller maps a hit to frame_range_from.
+        # No placeholder: the renderer appends the frame number to the
+        # fixed name (image_io.format_frame_placeholders), so accept
+        # "<name><digits>.<ext>"; a bare "<name>.<ext>" hit maps to the one
+        # frame of a single-frame job (group stays empty in that case).
         return re.compile(
-            re.escape(name_format) + r"\." + re.escape(extension) + r"$"
+            re.escape(name_format) + r"(\d+)?\." + re.escape(extension) + r"$"
         )
     width = len(match.group(0))
     prefix = re.escape(name_format[: match.start()])
@@ -75,8 +77,9 @@ def scan_rendered_frames(
                 continue  # truncated output from a killed render
         except OSError:
             continue
-        if match.groups():
-            frame_index = int(match.group(1))
+        digits = match.group(1) if match.groups() else None
+        if digits:
+            frame_index = int(digits)
         elif job.frame_count() == 1:
             # Fixed-name output: the one file IS the one frame.
             frame_index = job.frame_range_from

@@ -247,7 +247,11 @@ async def tpu_batch_strategy(
                     max(options.target_queue_size, RATE_TARGET_CAP),
                 )
             else:
-                target = options.target_queue_size
+                # Cold start: commit conservatively until the model has seen
+                # this worker render — dumping a full target_queue_size onto
+                # a worker of unknown speed parks frames on what may be the
+                # slowest node, and short jobs never recover via stealing.
+                target = min(2, options.target_queue_size)
             deficit = target - len(worker.queue)
             for position in range(max(0, deficit)):
                 slots.append((worker, position))

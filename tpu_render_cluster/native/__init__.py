@@ -100,10 +100,15 @@ def _build() -> bool:
 _COMMON_HEADER = _SOURCE.parent / "trc_common.hpp"
 
 
-def _build_daemon(source: Path, binary: Path) -> Path | None:
+def _build_daemon(
+    source: Path, binary: Path, sanitize: str | None = None
+) -> Path | None:
     """Builds a standalone C++ daemon (worker or master) against the codec.
 
-    Returns the binary path, or None when the toolchain/source is missing.
+    ``sanitize`` selects an instrumented variant ("thread" or "address" —
+    SURVEY.md §5.2: the C++ side needs TSAN/ASAN precisely because we lose
+    Rust's borrow checker). Returns the binary path, or None when the
+    toolchain/source is missing.
     """
     if not source.is_file() or not _SOURCE.is_file():
         return None
@@ -112,12 +117,16 @@ def _build_daemon(source: Path, binary: Path) -> Path | None:
         newest_source = max(newest_source, _COMMON_HEADER.stat().st_mtime)
     if binary.is_file() and binary.stat().st_mtime >= newest_source:
         return binary
+    flags = ["-O2"]
+    if sanitize is not None:
+        # -O1 -g keeps sanitizer reports readable and stacks accurate.
+        flags = [f"-fsanitize={sanitize}", "-O1", "-g", "-fno-omit-frame-pointer"]
     try:
         subprocess.run(
             [
                 "g++",
                 "-std=gnu++17",
-                "-O2",
+                *flags,
                 "-pthread",
                 "-o",
                 str(binary),
@@ -126,7 +135,7 @@ def _build_daemon(source: Path, binary: Path) -> Path | None:
             ],
             check=True,
             capture_output=True,
-            timeout=300,
+            timeout=600,
         )
         return binary
     except (subprocess.SubprocessError, OSError) as e:
@@ -134,14 +143,24 @@ def _build_daemon(source: Path, binary: Path) -> Path | None:
         return None
 
 
-def build_worker_daemon() -> Path | None:
+def build_worker_daemon(sanitize: str | None = None) -> Path | None:
     """Builds the standalone C++ worker daemon (native/worker_daemon.cpp)."""
-    return _build_daemon(_SOURCE.parent / "worker_daemon.cpp", _SOURCE.parent / "trc-worker")
+    suffix = f"-{sanitize[0]}san" if sanitize else ""
+    return _build_daemon(
+        _SOURCE.parent / "worker_daemon.cpp",
+        _SOURCE.parent / f"trc-worker{suffix}",
+        sanitize,
+    )
 
 
-def build_master_daemon() -> Path | None:
+def build_master_daemon(sanitize: str | None = None) -> Path | None:
     """Builds the standalone C++ master daemon (native/master_daemon.cpp)."""
-    return _build_daemon(_SOURCE.parent / "master_daemon.cpp", _SOURCE.parent / "trc-master")
+    suffix = f"-{sanitize[0]}san" if sanitize else ""
+    return _build_daemon(
+        _SOURCE.parent / "master_daemon.cpp",
+        _SOURCE.parent / f"trc-master{suffix}",
+        sanitize,
+    )
 
 
 def load_codec() -> NativeCodec | None:
