@@ -3,14 +3,18 @@
 Three sharding modes, mirroring how a multi-chip worker can split render
 work (the SP/DP analogs called for by SURVEY.md §2.7 / §5.7):
 
-- **tile**: the image's row dimension is sharded — each device renders a
-  horizontal band of the same frame (spatial decomposition; output is
-  jointly sharded, gathered on host read);
-- **spp**: every device renders the full frame with a decorrelated subset
-  of samples and the results are averaged with a ``psum`` over ICI
-  (sample decomposition — a true collective reduction);
-- **frames**: a batch of frames is sharded one-per-device (the task-farm
-  axis collapsed into the device mesh — highest throughput for animation).
+- ``render_frame_sharded(mode="tile")``: the image's row dimension is
+  sharded — each device renders a horizontal band of the same frame
+  (spatial decomposition; output is jointly sharded, gathered on host
+  read);
+- ``render_frame_sharded(mode="spp")``: every device renders the full
+  frame with a decorrelated subset of samples and the results are
+  averaged with a ``psum`` over ICI (sample decomposition — a true
+  collective reduction);
+- ``render_frames_batched``: a batch of frames is sharded one-per-device
+  (the task-farm axis collapsed into the device mesh — highest
+  throughput for animation). This is a separate function, not a
+  ``render_frame_sharded`` mode, because its unit of work is a batch.
 """
 
 from __future__ import annotations
