@@ -26,11 +26,29 @@ WIDTH = 256
 HEIGHT = 256
 SAMPLES = 4
 BOUNCES = 4
-BATCH = 8  # frames rendered per device dispatch (vmapped)
-TIMED_BATCHES = 4
+BATCH = 8  # frames per vmapped inner batch
+CHUNKS = 128  # scan steps per dispatch -> CHUNKS*BATCH frames per dispatch
+REPS = 5  # report the median of this many independent timed windows
+MIN_WINDOW_S = 5.0  # each timed window covers at least this much device time
+
+# Measurement methodology (changed in round 4):
+#
+# Rounds 1-3 timed a handful of 8-frame dispatches ending in
+# block_until_ready(). Two flaws surfaced when chasing the r02->r03
+# "regression" (47.5 -> 44.8 f/s): (a) through the axon TPU tunnel,
+# block_until_ready() returns without waiting for device completion, so
+# longer pipelines reported physically impossible rates (>1M f/s); (b) the
+# short window was dominated by a one-time post-warmup dispatch hiccup
+# (~0.7 s), so the number tracked tunnel latency, not render throughput.
+# The r02/r03 delta was that hiccup varying — noise, not a render change.
+#
+# Now each dispatch renders CHUNKS*BATCH frames inside one jitted lax.scan
+# and returns per-chunk means (a few floats); fetching that tiny array to
+# host forces real completion of every chunk. Windows of >= MIN_WINDOW_S
+# are timed fetch-to-fetch, and the median over REPS windows is reported.
 
 
-def measure_fps() -> float:
+def _make_render_many(chunks: int):
     import jax
     import jax.numpy as jnp
 
@@ -55,18 +73,59 @@ def measure_fps() -> float:
             max_bounces=BOUNCES,
         )
 
-    render_batch = jax.jit(jax.vmap(render_one))
+    @jax.jit
+    def render_many(frame0):
+        def body(carry, c):
+            fr = frame0 + c * BATCH + jnp.arange(BATCH, dtype=jnp.float32)
+            return carry, jax.vmap(render_one)(fr).mean()
 
-    frames = jnp.arange(1, BATCH + 1, dtype=jnp.float32)
-    render_batch(frames).block_until_ready()  # compile + warm caches
+        _, means = jax.lax.scan(
+            body, 0.0, jnp.arange(chunks, dtype=jnp.float32)
+        )
+        return means
 
-    t0 = time.perf_counter()
-    for i in range(TIMED_BATCHES):
-        offset = (i + 1) * BATCH
-        out = render_batch(frames + offset)
-    out.block_until_ready()
-    elapsed = time.perf_counter() - t0
-    return (BATCH * TIMED_BATCHES) / elapsed
+    return render_many
+
+
+def measure_fps(
+    reps: int = REPS,
+    min_window_s: float = MIN_WINDOW_S,
+    chunks: int = CHUNKS,
+) -> float:
+    """Median frames/sec over ``reps`` fully-synced timed windows."""
+    import statistics
+
+    import jax
+
+    render_many = _make_render_many(chunks)
+    per_dispatch = chunks * BATCH
+
+    def timed_dispatch(frame0: float) -> float:
+        t0 = time.perf_counter()
+        jax.device_get(render_many(frame0))  # tiny fetch = real sync
+        return time.perf_counter() - t0
+
+    timed_dispatch(1.0)  # compile + warm caches
+    if min_window_s > 0:
+        timed_dispatch(1.0 + per_dispatch)  # absorb post-warmup hiccup
+
+    fps = []
+    offset = 1.0 + 2 * per_dispatch
+    for _ in range(reps):
+        # Accumulate dispatches until the window is long enough; a fixed
+        # count derived from one probe could under-fill it if the probe
+        # happened to be a slow outlier.
+        frames_done = 0
+        t0 = time.perf_counter()
+        while True:
+            jax.device_get(render_many(offset))
+            offset += per_dispatch
+            frames_done += per_dispatch
+            elapsed = time.perf_counter() - t0
+            if elapsed >= min_window_s:
+                break
+        fps.append(frames_done / elapsed)
+    return statistics.median(fps)
 
 
 def cpu_baseline_fps() -> float:
@@ -100,11 +159,9 @@ def cpu_baseline_fps() -> float:
 
 def main() -> int:
     if "--cpu-probe" in sys.argv:
-        # Smaller sample for the slow CPU path; fps scales linearly in
-        # batches, so one timed batch suffices.
-        global TIMED_BATCHES
-        TIMED_BATCHES = 1
-        print(f"CPU_FPS={measure_fps()}")
+        # Smaller sample for the slow CPU path (~1 fps): one 8-frame
+        # dispatch, one window; fps scales linearly in frames.
+        print(f"CPU_FPS={measure_fps(reps=1, min_window_s=0.0, chunks=1)}")
         return 0
 
     import jax
