@@ -483,6 +483,86 @@ static std::vector<int> greedy_assignment(
     return out;
 }
 
+// Joint worker-speed x frame-complexity cost model, behaviorally identical
+// to the Python master's (tpu_render_cluster/master/tpu_batch.py
+// JointCostModel): t(worker, frame) ~ speed[worker] * complexity[frame].
+// Each observation updates the worker EMA with the complexity-normalized
+// time and the frame model with the speed-normalized time; unseen frames
+// interpolate linearly between the nearest observed frame indices.
+class JointCostModel {
+  public:
+    static constexpr double kDefaultFrameGuess = 5.0;
+
+    explicit JointCostModel(double alpha) : alpha_(alpha) {}
+
+    void observe(uint32_t worker_id, int frame_index, double seconds) {
+        double complexity =
+            std::max(1e-6, predict_complexity(frame_index));
+        auto it = speed_.find(worker_id);
+        if (it == speed_.end()) {
+            speed_[worker_id] = seconds / complexity;
+        } else {
+            it->second = alpha_ * (seconds / complexity) +
+                         (1 - alpha_) * it->second;
+        }
+        double speed = std::max(1e-6, predict_speed(worker_id));
+        auto cit = complexity_.find(frame_index);
+        if (cit == complexity_.end()) {
+            complexity_[frame_index] = seconds / speed;
+        } else {
+            cit->second = alpha_ * (seconds / speed) +
+                          (1 - alpha_) * cit->second;
+        }
+    }
+
+    bool has_history(uint32_t worker_id) const {
+        return speed_.count(worker_id) != 0;
+    }
+
+    double predict_speed(uint32_t worker_id) const {
+        auto it = speed_.find(worker_id);
+        if (it != speed_.end()) return it->second;
+        if (speed_.empty()) return kDefaultFrameGuess;
+        // Median of known workers (np.median semantics: middle pair
+        // averaged for even counts).
+        std::vector<double> values;
+        values.reserve(speed_.size());
+        for (const auto& pair : speed_) values.push_back(pair.second);
+        std::sort(values.begin(), values.end());
+        size_t n = values.size();
+        return (n % 2 == 1) ? values[n / 2]
+                            : 0.5 * (values[n / 2 - 1] + values[n / 2]);
+    }
+
+    double predict_complexity(int frame_index) const {
+        if (complexity_.empty()) return 1.0;
+        auto it = complexity_.find(frame_index);
+        if (it != complexity_.end()) return it->second;
+        auto right = complexity_.lower_bound(frame_index);
+        if (right == complexity_.begin()) return right->second;
+        if (right == complexity_.end())
+            return std::prev(right)->second;
+        auto left = std::prev(right);
+        double weight = double(frame_index - left->first) /
+                        double(right->first - left->first);
+        return (1 - weight) * left->second + weight * right->second;
+    }
+
+    // Mean complexity over observed frames; estimates the pending pool's
+    // total work without predicting every pending frame each tick.
+    double mean_observed_complexity() const {
+        if (complexity_.empty()) return 1.0;
+        double total = 0;
+        for (const auto& pair : complexity_) total += pair.second;
+        return total / double(complexity_.size());
+    }
+
+  private:
+    double alpha_;
+    std::map<uint32_t, double> speed_;
+    std::map<int, double> complexity_;  // ordered -> interpolation neighbors
+};
+
 // ---------------------------------------------------------------------------
 // Master daemon
 
@@ -630,11 +710,13 @@ class MasterDaemon {
     std::map<uint64_t, Json> responses_;
 
     AssignmentService assignment_;
-    // tpu-batch cost model: per-worker EMA of observed frame seconds
-    // (tpu_render_cluster/master/tpu_batch.py WorkerCostModel).
-    std::map<uint32_t, double> frame_time_ema_;
+    struct CompletionObservation {
+        uint32_t worker_id;
+        int frame_index;
+        double seconds;
+    };
     std::mutex observations_mutex_;
-    std::vector<std::pair<uint32_t, double>> completion_observations_;
+    std::vector<CompletionObservation> completion_observations_;
 
     // Resume-by-scanning-output-dir (beyond-reference, SURVEY.md §5.4;
     // Python counterpart: tpu_render_cluster/master/resume.py): mark frames
@@ -1040,8 +1122,8 @@ class MasterDaemon {
             }
             if (started_at > 0) {
                 std::lock_guard<std::mutex> obs_lock(observations_mutex_);
-                completion_observations_.emplace_back(worker->id,
-                                                      at - started_at);
+                completion_observations_.push_back(
+                    {worker->id, frame_index, at - started_at});
             }
         } else {
             // Beyond-reference: errored frames return to the pending pool
@@ -1073,6 +1155,15 @@ class MasterDaemon {
             }
         }
         return out;
+    }
+
+    int pending_count() {
+        std::lock_guard<std::mutex> lock(state_mutex_);
+        int count = 0;
+        for (const FrameSlot& slot : frames_) {
+            if (slot.status == FrameStatus::Pending) count++;
+        }
+        return count;
     }
 
     // -- RPC ------------------------------------------------------------------
@@ -1439,76 +1530,165 @@ class MasterDaemon {
         return false;
     }
 
-    // tpu-batch: cost-matrix assignment each 100 ms tick; stealing fallback
-    // when the pending pool is dry (tpu_render_cluster/master/tpu_batch.py).
+    // tpu-batch: cost-matrix assignment each tick; stealing fallback when
+    // the pending pool is dry. Behaviorally identical to the Python
+    // master's scheduler (tpu_render_cluster/master/tpu_batch.py): joint
+    // worker-speed x frame-complexity cost model, rate-scaled queue
+    // targets with the configured target as a floor, and the
+    // makespan-balance gate.
     bool tpu_batch_loop() {
-        const double kDefaultFrameGuess = 5.0;
+        const double kRateTargetLookahead = 0.25;
+        const int kRateTargetCap = 16;
+        const size_t kMaxSlotsPerTick = 128;
+        JointCostModel cost_model(job_.cost_ema_alpha);
+        std::set<std::pair<uint32_t, int>> observed_frames;
         while (!cancelled_.load()) {
             if (all_frames_finished()) return true;
             if (!cluster_alive()) return false;
             assignment_.poll_ready();
 
-            // Feed the EMA cost model from completion observations.
+            // Feed the joint cost model from completion observations
+            // (first completion per (worker, frame) only, like Python's
+            // observed_frames dedup — a re-render after eviction would
+            // otherwise double-count).
             {
                 std::lock_guard<std::mutex> lock(observations_mutex_);
                 for (const auto& obs : completion_observations_) {
-                    auto it = frame_time_ema_.find(obs.first);
-                    if (it == frame_time_ema_.end()) {
-                        frame_time_ema_[obs.first] = obs.second;
-                    } else {
-                        it->second = job_.cost_ema_alpha * obs.second +
-                                     (1 - job_.cost_ema_alpha) * it->second;
+                    if (observed_frames
+                            .insert({obs.worker_id, obs.frame_index})
+                            .second) {
+                        cost_model.observe(obs.worker_id, obs.frame_index,
+                                           obs.seconds);
                     }
                 }
                 completion_observations_.clear();
             }
 
             std::vector<WorkerConn*> workers = live_workers();
-            // Slots = queue deficits: (worker, position).
+
+            // Mean complexity of the upcoming batch scales the per-worker
+            // rate targets.
+            std::vector<int> upcoming =
+                pending_frames(size_t(2 * kRateTargetCap));
+            double batch_mean_complexity = 1.0;
+            if (!upcoming.empty()) {
+                double total = 0;
+                for (int frame : upcoming)
+                    total += cost_model.predict_complexity(frame);
+                batch_mean_complexity = total / double(upcoming.size());
+            }
+
+            // Slots = queue deficits: (worker, position). The configured
+            // target is a floor; rate-scaling only deepens queues for
+            // workers that drain faster than the lookahead window.
+            // Cold-start workers get a conservative target until their
+            // speed is known.
             std::vector<std::pair<WorkerConn*, int>> slots;
             for (WorkerConn* worker : workers) {
-                int deficit = job_.target_queue_size - int(queue_size(worker));
+                int target;
+                if (cost_model.has_history(worker->id)) {
+                    double frame_seconds =
+                        std::max(1e-6, cost_model.predict_speed(worker->id) *
+                                           batch_mean_complexity);
+                    int rate_target = int(
+                        std::ceil(kRateTargetLookahead / frame_seconds));
+                    target = std::min(
+                        std::max(job_.target_queue_size, rate_target),
+                        std::max(job_.target_queue_size, kRateTargetCap));
+                } else {
+                    target = std::min(2, job_.target_queue_size);
+                }
+                int deficit = target - int(queue_size(worker));
                 for (int position = 0; position < deficit; position++) {
                     slots.emplace_back(worker, position);
                 }
             }
+            if (slots.size() > kMaxSlotsPerTick) slots.resize(kMaxSlotsPerTick);
+
             if (!slots.empty()) {
                 std::vector<int> frames = pending_frames(slots.size());
                 if (!frames.empty()) {
-                    // cost[i][j] = (queue_len + position + 1) * EMA(worker)
-                    // (tpu_batch.py build_cost_matrix).
-                    double median = kDefaultFrameGuess;
-                    if (!frame_time_ema_.empty()) {
-                        std::vector<double> values;
-                        for (auto& pair : frame_time_ema_)
-                            values.push_back(pair.second);
-                        std::sort(values.begin(), values.end());
-                        median = values[values.size() / 2];
+                    // cost[i][j] = (queue_len + position + 1) *
+                    //              speed(worker) * complexity(frame).
+                    std::vector<double> complexity(frames.size());
+                    for (size_t i = 0; i < frames.size(); i++) {
+                        complexity[i] =
+                            cost_model.predict_complexity(frames[i]);
                     }
-                    std::vector<float> slot_cost(slots.size());
+                    std::vector<float> slot_base(slots.size());
                     for (size_t j = 0; j < slots.size(); j++) {
                         WorkerConn* worker = slots[j].first;
-                        auto it = frame_time_ema_.find(worker->id);
-                        double predicted =
-                            it != frame_time_ema_.end() ? it->second : median;
-                        slot_cost[j] = float(
-                            double(queue_size(worker) + size_t(slots[j].second) +
-                                   1) *
-                            predicted);
+                        slot_base[j] = float(
+                            double(queue_size(worker) +
+                                   size_t(slots[j].second) + 1) *
+                            cost_model.predict_speed(worker->id));
                     }
                     std::vector<std::vector<float>> cost(
                         frames.size(), std::vector<float>(slots.size()));
-                    for (size_t i = 0; i < frames.size(); i++) cost[i] = slot_cost;
+                    for (size_t i = 0; i < frames.size(); i++) {
+                        for (size_t j = 0; j < slots.size(); j++) {
+                            cost[i][j] = slot_base[j] * float(complexity[i]);
+                        }
+                    }
 
                     std::vector<int> result;
                     if (!assignment_.solve(cost, &result) ||
                         result.size() != frames.size()) {
                         result = greedy_assignment(cost);
                     }
+
+                    // Makespan-balance gate (unit-consistent complexity
+                    // accounting): skip an assignment whose predicted
+                    // completion exceeds the time the OTHER workers need
+                    // to drain the rest of the pool plus the fastest
+                    // worker's time on this frame.
+                    double cluster_rate = 0;
+                    double fastest_speed =
+                        std::numeric_limits<double>::infinity();
+                    std::map<uint32_t, double> speeds;
+                    for (WorkerConn* worker : workers) {
+                        double speed = cost_model.predict_speed(worker->id);
+                        speeds[worker->id] = speed;
+                        cluster_rate += 1.0 / std::max(1e-6, speed);
+                        fastest_speed = std::min(fastest_speed, speed);
+                    }
+                    double pool_units =
+                        double(pending_count()) *
+                        cost_model.mean_observed_complexity();
+                    std::map<uint32_t, double> queued_units;
+                    double total_queued_units = 0;
+                    {
+                        std::lock_guard<std::mutex> lock(state_mutex_);
+                        for (WorkerConn* worker : workers) {
+                            double units = 0;
+                            for (const FrameOnWorker& frame : worker->queue) {
+                                units += cost_model.predict_complexity(
+                                    frame.frame_index);
+                            }
+                            queued_units[worker->id] = units;
+                            total_queued_units += units;
+                        }
+                    }
+
                     for (size_t i = 0; i < frames.size(); i++) {
                         if (result[i] < 0 || result[i] >= int(slots.size()))
                             continue;
-                        queue_frame(*slots[size_t(result[i])].first, frames[i]);
+                        WorkerConn* worker = slots[size_t(result[i])].first;
+                        double others_rate =
+                            cluster_rate -
+                            1.0 / std::max(1e-6, speeds[worker->id]);
+                        double rest_units =
+                            std::max(0.0, pool_units - complexity[i]) +
+                            (total_queued_units - queued_units[worker->id]);
+                        double rest_seconds =
+                            others_rate > 0
+                                ? rest_units / others_rate
+                                : std::numeric_limits<double>::infinity();
+                        double horizon =
+                            rest_seconds + fastest_speed * complexity[i];
+                        if (double(cost[i][size_t(result[i])]) > horizon)
+                            continue;  // leave pending for a better slot
+                        queue_frame(*worker, frames[i]);
                     }
                     std::this_thread::sleep_for(
                         std::chrono::milliseconds(100));

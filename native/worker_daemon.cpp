@@ -196,6 +196,11 @@ struct Options {
     std::string append_arguments;
     std::string log_file_path;
     int mock_render_ms = 100;
+    // When > 0, mock render time scales with the frame index:
+    // duration = mockRenderMs * (1 + frame_index / ramp) — an animated
+    // scene's cost ramp, for scheduler tests against heterogeneous
+    // clusters (mirrors tests/test_cluster_integration.py complexity()).
+    double mock_complexity_ramp = 0;
     int render_width = 256;
     int render_height = 256;
     int render_samples = 4;
@@ -284,8 +289,12 @@ static bool render_frame(const Options& options, const RenderRequest& request,
     double t0 = now_ts();
     if (options.backend == "mock") {
         double duration = options.mock_render_ms / 1000.0;
+        if (options.mock_complexity_ramp > 0) {
+            duration *= 1.0 + double(request.frame_index) /
+                                  options.mock_complexity_ramp;
+        }
         std::this_thread::sleep_for(
-            std::chrono::milliseconds(options.mock_render_ms));
+            std::chrono::milliseconds(long(duration * 1000.0)));
         FILE* f = fopen(output_path.c_str(), "wb");
         if (f != nullptr) {
             fputs("trc-worker mock frame\n", f);
@@ -806,6 +815,7 @@ static void print_usage() {
             "  --prependArguments S   extra args before the blend file\n"
             "  --appendArguments S    extra args at the end\n"
             "  --mockRenderMs N       mock render duration (default 100)\n"
+            "  --mockComplexityRamp R scale mock duration by (1 + frame/R)\n"
             "  --renderWidth/Height/Samples N   cli backend quality knobs\n"
             "  --logFilePath F        also append logs to this file\n");
 }
@@ -831,6 +841,7 @@ int main(int argc, char** argv) {
         else if (flag == "--prependArguments") options.prepend_arguments = next();
         else if (flag == "--appendArguments") options.append_arguments = next();
         else if (flag == "--mockRenderMs") options.mock_render_ms = atoi(next().c_str());
+        else if (flag == "--mockComplexityRamp") options.mock_complexity_ramp = atof(next().c_str());
         else if (flag == "--renderWidth") options.render_width = atoi(next().c_str());
         else if (flag == "--renderHeight") options.render_height = atoi(next().c_str());
         else if (flag == "--renderSamples") options.render_samples = atoi(next().c_str());

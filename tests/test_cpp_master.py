@@ -98,17 +98,22 @@ def _spawn_master(
     )
 
 
-def _spawn_cpp_worker(worker: Path, port: int, mock_ms: int = 30) -> subprocess.Popen:
+def _spawn_cpp_worker(
+    worker: Path, port: int, mock_ms: int = 30, ramp: float = 0
+) -> subprocess.Popen:
+    args = [
+        str(worker),
+        "--masterServerHost",
+        "127.0.0.1",
+        "--masterServerPort",
+        str(port),
+        "--mockRenderMs",
+        str(mock_ms),
+    ]
+    if ramp > 0:
+        args += ["--mockComplexityRamp", str(ramp)]
     return subprocess.Popen(
-        [
-            str(worker),
-            "--masterServerHost",
-            "127.0.0.1",
-            "--masterServerPort",
-            str(port),
-            "--mockRenderMs",
-            str(mock_ms),
-        ],
+        args,
         stdout=subprocess.DEVNULL,
         stderr=subprocess.DEVNULL,
     )
@@ -383,3 +388,79 @@ def test_eviction_requeues_dead_workers_frames(tmp_path):
     # All 10 frames rendered despite losing a worker mid-job.
     rendered = sorted((tmp_path / "frames").glob("rendered-*.png"))
     assert len(rendered) == 10
+
+
+TPU_BATCH = """strategy_type = "tpu-batch"
+target_queue_size = 2
+min_queue_size_to_steal = 1
+min_seconds_before_resteal_to_elsewhere = 1
+min_seconds_before_resteal_to_original_worker = 2"""
+
+
+def _run_cpp_heterogeneous(tmp_path: Path, tag: str, strategy_lines: str):
+    """One fast + one 8x-slower C++ worker over a complexity ramp.
+
+    Returns (job duration, tail delay) computed from the persisted raw
+    trace — the same metrics as the Python heterogeneous win test
+    (tests/test_cluster_integration.py _run_heterogeneous).
+    """
+    master = build_master_daemon()
+    worker = build_worker_daemon()
+    assert master is not None and worker is not None
+    run_dir = tmp_path / tag
+    run_dir.mkdir(parents=True, exist_ok=True)
+    port = _free_port()
+    job_path = _write_job(
+        run_dir, name="cpp-hetero", frames=36, workers=2,
+        strategy_lines=strategy_lines,
+    )
+    results = run_dir / "results"
+    master_proc = _spawn_master(master, port, job_path, results)
+    time.sleep(0.3)
+    workers = [
+        _spawn_cpp_worker(worker, port, mock_ms=10, ramp=10.0),
+        _spawn_cpp_worker(worker, port, mock_ms=80, ramp=10.0),
+    ]
+    assert _wait(master_proc, 120) == 0
+    for proc in workers:
+        _wait(proc, 30)
+    rendered = sorted((run_dir / "frames").glob("rendered-*.png"))
+    assert len(rendered) == 36
+    trace = JobTrace.load_from_trace_file(next(results.glob("*_raw-trace.json")))
+    duration = trace.job_finished_at - trace.job_started_at
+    last_finishes = [
+        max(f.details.exited_process_at for f in w.frame_render_traces)
+        for w in trace.worker_traces.values()
+    ]
+    tail = max(last_finishes) - min(last_finishes)
+    return duration, tail
+
+
+def test_cpp_tpu_batch_beats_dynamic_on_heterogeneous_cluster(tmp_path):
+    # The C++ master must carry the same joint worker-speed x
+    # frame-complexity cost model + makespan gate as the Python master
+    # (tpu_render_cluster/master/tpu_batch.py): with one fast and one
+    # 8x-slower worker over a cost ramp, tpu-batch must beat the dynamic
+    # strategy on job duration and not worsen the tail.
+    def best_of_two(tag: str, strategy_lines: str):
+        runs = [
+            _run_cpp_heterogeneous(tmp_path, f"{tag}{i}", strategy_lines)
+            for i in range(2)
+        ]
+        return min(r[0] for r in runs), min(r[1] for r in runs)
+
+    dynamic_duration, dynamic_tail = best_of_two("dyn", DYNAMIC)
+    tpu_duration, tpu_tail = best_of_two("tpu", TPU_BATCH)
+    if tpu_duration >= dynamic_duration or tpu_tail >= max(dynamic_tail, 0.3) * 1.25:
+        # One retry for CI load spikes, mirroring the Python win test.
+        retry_duration, retry_tail = _run_cpp_heterogeneous(
+            tmp_path, "tpu-retry", TPU_BATCH
+        )
+        tpu_duration = min(tpu_duration, retry_duration)
+        tpu_tail = min(tpu_tail, retry_tail)
+    print(
+        f"\ncpp duration: dynamic={dynamic_duration:.3f} tpu={tpu_duration:.3f}\n"
+        f"cpp tail:     dynamic={dynamic_tail:.3f} tpu={tpu_tail:.3f}"
+    )
+    assert tpu_duration < dynamic_duration
+    assert tpu_tail < max(dynamic_tail, 0.3) * 1.25

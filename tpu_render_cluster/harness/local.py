@@ -31,6 +31,11 @@ async def _run(job: BlenderJob, backends: list[RenderBackend]):
     manager = ClusterManager("127.0.0.1", 0, job)
     server_task = asyncio.create_task(manager.initialize_server_and_run_job())
     while manager._server is None:
+        if server_task.done():
+            # Startup failed (e.g. port bind); await to surface the real
+            # exception instead of spinning until the outer timeout.
+            await server_task
+            raise RuntimeError("master server task exited before startup")
         await asyncio.sleep(0.01)
     workers = [Worker("127.0.0.1", manager.port, backend) for backend in backends]
     worker_tasks = [

@@ -134,6 +134,15 @@ class FrameComplexityModel:
     def predict_many(self, frames: Sequence[int]) -> dict[int, float]:
         return {frame_index: self.predict(frame_index) for frame_index in frames}
 
+    def mean_observed(self) -> float:
+        """Mean complexity over observed frames (1.0 before any history).
+
+        Used to estimate the pending pool's total work without predicting
+        every pending frame each tick (pools can be 14400 frames)."""
+        if not self._complexity:
+            return 1.0
+        return float(np.mean(list(self._complexity.values())))
+
 
 class JointCostModel:
     """Multiplicative decomposition t(worker, frame) ~ speed[worker] * complexity[frame].
@@ -240,9 +249,16 @@ async def tpu_batch_strategy(
                     cost_model.worker_speed.predict(worker.worker_id)
                     * batch_mean_complexity,
                 )
+                # The configured target is a floor: a worker must always
+                # hold at least one buffered frame beyond the one it is
+                # rendering, or it idles for a full master round-trip after
+                # every frame (utilization collapses to ~50% on fast
+                # backends). Rate-scaling only ever deepens the queue for
+                # workers that drain faster than the lookahead window.
                 target = min(
                     max(
-                        1, int(np.ceil(RATE_TARGET_LOOKAHEAD / frame_seconds))
+                        options.target_queue_size,
+                        int(np.ceil(RATE_TARGET_LOOKAHEAD / frame_seconds)),
                     ),
                     max(options.target_queue_size, RATE_TARGET_CAP),
                 )
@@ -295,10 +311,21 @@ async def tpu_batch_strategy(
                     for worker in workers
                 }
                 cluster_rate = sum(1.0 / max(1e-6, s) for s in speeds.values())
-                mean_complexity = float(np.mean(list(complexity.values())))
-                pool_units = state.pending_count() * mean_complexity
+                # Work is measured in complexity units throughout: the pool
+                # via the model-wide mean (pools can be 14400 frames — too
+                # many to predict individually each tick), queues via the
+                # sum of per-frame predictions (queues are small), and the
+                # candidate frame via its own prediction — so the
+                # subtraction in rest_units below is unit-consistent.
+                pool_units = state.pending_count() * (
+                    cost_model.frame_complexity.mean_observed()
+                )
                 queued_units = {
-                    worker.worker_id: len(worker.queue) * mean_complexity
+                    worker.worker_id: sum(
+                        complexity_memo.get(f.frame_index)
+                        or cost_model.frame_complexity.predict(f.frame_index)
+                        for f in worker.queue.all_frames()
+                    )
                     for worker in workers
                 }
                 total_queued_units = sum(queued_units.values())

@@ -48,6 +48,49 @@ class TpuRaytraceBackend(RenderBackend):
         # (tpu_render_cluster/parallel/sharded_render.py).
         self.sharding = sharding
 
+    def warm(self, scene_name: str) -> None:
+        """Compile + execute the renderer once, outside any job window.
+
+        The process-level analog of pre-pulling the Blender container
+        (reference: pull-blender-image.sh): the first XLA compile costs
+        20-40 s and must not land inside a rendered frame's trace.
+        """
+        import numpy as np
+
+        from tpu_render_cluster.render.scene import scene_for_job_name
+
+        # Accept job names as well as scene names, resolving exactly like
+        # the render path does — otherwise the warmed program can differ
+        # from the one the job compiles.
+        scene_name = scene_for_job_name(scene_name)
+
+        if self.sharding in ("tile", "spp"):
+            from tpu_render_cluster.parallel.sharded_render import render_frame_sharded
+
+            np.asarray(
+                render_frame_sharded(
+                    scene_name,
+                    1,
+                    width=self.width,
+                    height=self.height,
+                    samples=self.samples,
+                    max_bounces=self.max_bounces,
+                    mode=self.sharding,
+                )
+            )
+        else:
+            from tpu_render_cluster.render.integrator import fused_frame_renderer
+
+            np.asarray(
+                fused_frame_renderer(
+                    scene_name,
+                    self.width,
+                    self.height,
+                    self.samples,
+                    self.max_bounces,
+                )(1)
+            )
+
     async def render_frame(self, job: BlenderJob, frame_index: int) -> FrameRenderTime:
         return await asyncio.to_thread(self._render_sync, job, frame_index)
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import os
 import sys
 
 from tpu_render_cluster.utils.logging import initialize_console_and_file_logging
@@ -33,6 +34,35 @@ def build_parser() -> argparse.ArgumentParser:
         default="blender",
         help="Render backend (default: blender, matching the reference).",
     )
+    parser.add_argument(
+        "--sharding",
+        choices=["none", "tile", "spp"],
+        default="none",
+        help="tpu-raytrace only: split each frame across the local device "
+        "mesh (tile = horizontal bands, spp = sample subsets psum-averaged "
+        "over ICI; tpu_render_cluster/parallel/sharded_render.py).",
+    )
+    parser.add_argument(
+        "--renderSize",
+        dest="render_size",
+        default="512x512",
+        help="tpu-raytrace only: output WxH (default 512x512).",
+    )
+    parser.add_argument(
+        "--renderSamples",
+        dest="render_samples",
+        type=int,
+        default=8,
+        help="tpu-raytrace only: samples per pixel (default 8).",
+    )
+    parser.add_argument(
+        "--warmScene",
+        dest="warm_scene",
+        default=None,
+        help="tpu-raytrace only: compile the renderer for this scene BEFORE "
+        "connecting to the master, so the job window never contains XLA "
+        "compilation (the analog of pre-pulling the Blender image).",
+    )
     return parser
 
 
@@ -46,7 +76,30 @@ def make_backend(args: argparse.Namespace):
             append_arguments=args.append_arguments,
         )
     if args.backend == "tpu-raytrace":
-        return create_backend("tpu-raytrace", base_directory=args.base_directory)
+        cache_dir = os.environ.get("TRC_COMPILE_CACHE")
+        if cache_dir:
+            # Persistent XLA compilation cache: the first worker process
+            # pays the 20-40 s compile, later ones deserialize in ~1 s.
+            try:
+                import jax
+
+                jax.config.update("jax_compilation_cache_dir", cache_dir)
+                jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+                jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+            except Exception:  # noqa: BLE001 - cache is an optimization only
+                pass
+        try:
+            width, height = (int(v) for v in args.render_size.lower().split("x"))
+        except ValueError as e:
+            raise SystemExit(f"--renderSize must be WxH: {e}")
+        return create_backend(
+            "tpu-raytrace",
+            base_directory=args.base_directory,
+            width=width,
+            height=height,
+            samples=args.render_samples,
+            sharding=None if args.sharding == "none" else args.sharding,
+        )
     return create_backend("mock")
 
 
@@ -54,6 +107,8 @@ def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     initialize_console_and_file_logging(args.log_file_path)
     backend = make_backend(args)
+    if args.warm_scene and args.backend == "tpu-raytrace":
+        backend.warm(args.warm_scene)
     worker = Worker(args.master_host, args.master_port, backend)
     asyncio.run(worker.connect_and_run_to_job_completion())
     return 0
