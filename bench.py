@@ -48,17 +48,18 @@ MIN_WINDOW_S = 5.0  # each timed window covers at least this much device time
 # are timed fetch-to-fetch, and the median over REPS windows is reported.
 
 
-def _make_render_many(chunks: int):
+def _make_render_many(chunks: int, scene_name: str = "04_very-simple"):
     import jax
     import jax.numpy as jnp
 
     from tpu_render_cluster.render.camera import scene_camera
     from tpu_render_cluster.render.integrator import render_tile
+    from tpu_render_cluster.render.mesh import scene_mesh_set
     from tpu_render_cluster.render.scene import build_scene
 
     def render_one(frame):
-        scene = build_scene("04_very-simple", frame)
-        camera = scene_camera("04_very-simple", frame)
+        scene = build_scene(scene_name, frame)
+        camera = scene_camera(scene_name, frame)
         return render_tile(
             scene,
             camera,
@@ -71,6 +72,7 @@ def _make_render_many(chunks: int):
             tile_width=WIDTH,
             samples=SAMPLES,
             max_bounces=BOUNCES,
+            mesh=scene_mesh_set(scene_name, frame),
         )
 
     @jax.jit
@@ -91,13 +93,14 @@ def measure_fps(
     reps: int = REPS,
     min_window_s: float = MIN_WINDOW_S,
     chunks: int = CHUNKS,
+    scene_name: str = "04_very-simple",
 ) -> float:
     """Median frames/sec over ``reps`` fully-synced timed windows."""
     import statistics
 
     import jax
 
-    render_many = _make_render_many(chunks)
+    render_many = _make_render_many(chunks, scene_name)
     per_dispatch = chunks * BATCH
 
     def timed_dispatch(frame0: float) -> float:

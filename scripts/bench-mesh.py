@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""Mesh-scene benchmark: frames/sec/chip on 02_physics-mesh.
+
+Same methodology as the headline bench.py (chunked lax.scan dispatches,
+tiny-fetch sync, median of >=5 s windows), on the triangle-mesh scene: 24
+tumbling box instances traversed with the Pallas stackless threaded-BVH
+kernel per bounce (render/mesh.py, SURVEY.md §7 hard part #4). Prints ONE
+JSON line like bench.py.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO_ROOT))
+
+import bench  # noqa: E402
+
+
+def main() -> int:
+    import jax
+
+    # Mesh traversal is heavier per frame than the sphere megakernel;
+    # smaller chunks keep the first dispatch's compile+run bounded.
+    fps = bench.measure_fps(chunks=16, scene_name="02_physics-mesh")
+    platform = jax.devices()[0].platform
+    print(
+        json.dumps(
+            {
+                "metric": f"02_physics-mesh frames/sec/chip "
+                f"({bench.WIDTH}x{bench.HEIGHT}, {bench.SAMPLES}spp, "
+                f"{platform}, pallas-bvh)",
+                "value": round(fps, 3),
+                "unit": "frames/s/chip",
+                "vs_baseline": 0.0,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

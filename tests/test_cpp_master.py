@@ -416,7 +416,10 @@ def _run_cpp_heterogeneous(tmp_path: Path, tag: str, strategy_lines: str):
     )
     results = run_dir / "results"
     master_proc = _spawn_master(master, port, job_path, results)
-    time.sleep(0.3)
+    # Generous accept-loop lead time: under full-suite load the daemon can
+    # take a while to bind, and a worker that never connects parks the
+    # master at the barrier until the _wait timeout.
+    time.sleep(0.6)
     workers = [
         _spawn_cpp_worker(worker, port, mock_ms=10, ramp=10.0),
         _spawn_cpp_worker(worker, port, mock_ms=80, ramp=10.0),

@@ -53,6 +53,9 @@ def render_frame_sharded(
     n = mesh.devices.size
     scene = build_scene(scene_name, frame_index)
     camera = scene_camera(scene_name, frame_index)
+    from tpu_render_cluster.render.mesh import scene_mesh_set
+
+    mesh_set = scene_mesh_set(scene_name, frame_index)
     frame = jnp.asarray(frame_index, jnp.float32)
 
     if mode == "tile":
@@ -75,6 +78,7 @@ def render_frame_sharded(
                 tile_width=width,
                 samples=samples,
                 max_bounces=max_bounces,
+                mesh=mesh_set,
             )
 
         sharded = _shard_map(
@@ -106,6 +110,7 @@ def render_frame_sharded(
                 tile_width=width,
                 samples=samples_per_device,
                 max_bounces=max_bounces,
+                mesh=mesh_set,
             )
             return jax.lax.psum(image, "d") / n
 
@@ -143,6 +148,8 @@ def render_frames_batched(
         raise ValueError(f"Batch {frames.shape[0]} not divisible by {n} devices.")
 
     def render_one(frame):
+        from tpu_render_cluster.render.mesh import scene_mesh_set
+
         scene = build_scene(scene_name, frame)
         camera = scene_camera(scene_name, frame)
         return render_tile(
@@ -157,6 +164,7 @@ def render_frames_batched(
             tile_width=width,
             samples=samples,
             max_bounces=max_bounces,
+            mesh=scene_mesh_set(scene_name, frame),
         )
 
     # shard_map (not jit-level SPMD): the Pallas intersection kernel lowers

@@ -52,9 +52,19 @@ def _cosine_sample_hemisphere(normals, key):
     )
 
 
-def _shade_bounce(scene: Scene, carry, key):
+def _shade_bounce(scene: Scene, carry, key, mesh=None):
     origins, directions, throughput, radiance, alive = carry
     t, sphere_index, is_plane = intersect_scene(scene, origins, directions)
+    mesh_closer = None
+    if mesh is not None:
+        from tpu_render_cluster.render.mesh import intersect_instances
+
+        t_mesh, mesh_normals, mesh_albedo = intersect_instances(
+            mesh.bvh, mesh.instances, origins, directions
+        )
+        mesh_closer = t_mesh < t
+        t = jnp.minimum(t, t_mesh)
+        is_plane = is_plane & ~mesh_closer
     hit = t < INF
 
     # Escaped rays pick up the sky and die.
@@ -79,6 +89,12 @@ def _shade_bounce(scene: Scene, carry, key):
         jnp.zeros((1, 3), jnp.float32),
         scene.emission[sphere_index],
     )
+    if mesh_closer is not None:
+        normals = jnp.where(mesh_closer[:, None], mesh_normals, normals)
+        albedo = jnp.where(mesh_closer[:, None], mesh_albedo, albedo)
+        emission = jnp.where(
+            mesh_closer[:, None], jnp.zeros((1, 3), jnp.float32), emission
+        )
     radiance = radiance + throughput * emission * alive[:, None]
 
     # Sun next-event estimation (delta light -> single shadow ray).
@@ -86,6 +102,12 @@ def _shade_bounce(scene: Scene, carry, key):
     shadow_origin = points + normals * EPS * 4.0
     sun_dir = jnp.broadcast_to(scene.sun_direction, normals.shape)
     in_shadow = occluded_sun(scene, shadow_origin, sun_dir)
+    if mesh is not None:
+        from tpu_render_cluster.render.mesh import occluded_instances
+
+        in_shadow = in_shadow | occluded_instances(
+            mesh.bvh, mesh.instances, shadow_origin, sun_dir
+        )
     direct = (
         albedo
         * scene.sun_color[None, :]
@@ -104,7 +126,7 @@ def _shade_bounce(scene: Scene, carry, key):
 
 
 def trace_paths(
-    scene: Scene, origins, directions, key, *, max_bounces: int = 4
+    scene: Scene, origins, directions, key, *, max_bounces: int = 4, mesh=None
 ) -> jnp.ndarray:
     """Trace one sample per ray; returns radiance [R, 3].
 
@@ -116,7 +138,10 @@ def trace_paths(
     """
     from tpu_render_cluster.render import pallas_kernels
 
-    if pallas_kernels.pallas_enabled():
+    if pallas_kernels.pallas_enabled() and mesh is None:
+        # The fused megakernel covers sphere+plane scenes; mesh scenes run
+        # the XLA bounce scan whose intersections still dispatch to the
+        # Pallas sphere kernels and the Pallas BVH traversal per bounce.
         seed = jax.random.key_data(key).ravel()[-1].astype(jnp.int32)
         return pallas_kernels.trace_paths_fused(
             scene, origins, directions, seed, max_bounces=max_bounces
@@ -132,7 +157,7 @@ def trace_paths(
     keys = jax.random.split(key, max_bounces)
 
     def step(carry, bounce_key):
-        return _shade_bounce(scene, carry, bounce_key), None
+        return _shade_bounce(scene, carry, bounce_key, mesh=mesh), None
 
     (_, _, _, radiance, _), _ = jax.lax.scan(step, carry, keys)
     return radiance
@@ -155,6 +180,7 @@ def render_tile(
     tile_width: int,
     samples: int = 8,
     max_bounces: int = 4,
+    mesh=None,
 ) -> jnp.ndarray:
     """Render a tile; returns [tile_height, tile_width, 3] linear radiance.
 
@@ -190,7 +216,7 @@ def render_tile(
 
     from tpu_render_cluster.render import pallas_kernels
 
-    if pallas_kernels.pallas_enabled():
+    if pallas_kernels.pallas_enabled() and mesh is None:
         # Samples ride the ray axis instead of a sequential lax.scan: one
         # [samples * n]-ray trace keeps every bounce step 'samples'x larger
         # (better VPU/MXU occupancy, fewer serialized steps) for the same
@@ -215,7 +241,8 @@ def render_tile(
             origins, directions = rays_for_sample(key)
             _, trace_key = jax.random.split(key)
             radiance = trace_paths(
-                scene, origins, directions, trace_key, max_bounces=max_bounces
+                scene, origins, directions, trace_key,
+                max_bounces=max_bounces, mesh=mesh,
             )
             return acc + radiance, None
 
@@ -237,8 +264,11 @@ def render_frame(
     tile_size: int | None = None,
 ) -> jnp.ndarray:
     """Render a full frame on the default device; returns [H, W, 3] linear."""
+    from tpu_render_cluster.render.mesh import scene_mesh_set
+
     scene = build_scene(scene_name, frame_index)
     camera = scene_camera(scene_name, frame_index)
+    mesh = scene_mesh_set(scene_name, frame_index)
     frame = jnp.asarray(frame_index, jnp.float32)
     if tile_size is None:
         return render_tile(
@@ -253,6 +283,7 @@ def render_frame(
             tile_width=width,
             samples=samples,
             max_bounces=max_bounces,
+            mesh=mesh,
         )
     rows = []
     for y0 in range(0, height, tile_size):
@@ -271,6 +302,7 @@ def render_frame(
                     tile_width=min(tile_size, width - x0),
                     samples=samples,
                     max_bounces=max_bounces,
+                    mesh=mesh,
                 )
             )
         rows.append(jnp.concatenate(row, axis=1))
@@ -307,8 +339,11 @@ def fused_frame_renderer(
 
     @jax.jit
     def render(frame: jnp.ndarray) -> jnp.ndarray:
+        from tpu_render_cluster.render.mesh import scene_mesh_set
+
         scene = build_scene(scene_name, frame)
         camera = scene_camera(scene_name, frame)
+        mesh = scene_mesh_set(scene_name, frame)
         linear = render_tile(
             scene,
             camera,
@@ -321,6 +356,7 @@ def fused_frame_renderer(
             tile_width=width,
             samples=samples,
             max_bounces=max_bounces,
+            mesh=mesh,
         )
         return tonemap(linear)
 

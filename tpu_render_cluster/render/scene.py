@@ -39,6 +39,7 @@ class Scene(NamedTuple):
 SCENE_NAMES = (
     "04_very-simple",
     "01_simple-animation",
+    "02_physics-mesh",
     "02_physics",
     "03_physics-2",
 )
@@ -145,35 +146,69 @@ def _physics(frame: jnp.ndarray, n_spheres: int, pad: int, *, chaos: float):
     drop_delay = u1 * 2.0 * chaos
     tau = jnp.maximum(t - drop_delay, 0.0)
 
-    # Bouncing height: fall from h0, elastic bounces with restitution e.
-    e = 0.7
-    t_fall = jnp.sqrt(2.0 * h0 / _GRAVITY)
-
-    def bounce_height(tau):
-        # After the first impact at t_fall, bounce k has duration
-        # d_k = 2 * e^k * v0 / g with peak h0 * e^(2k).
-        v0 = jnp.sqrt(2.0 * _GRAVITY * h0)
-        in_fall = tau < t_fall
-        fall_y = h0 - 0.5 * _GRAVITY * tau**2
-        s = tau - t_fall
-        # Find bounce index via geometric series sum: sum_{j<k} 2 e^j v0/g.
-        # Solve 2 v0 (1-e^k)/(g (1-e)) <= s  ->  k = log_e(1 - s g (1-e)/(2 v0))
-        denom = 2.0 * v0 / (_GRAVITY * (1.0 - e))
-        ratio = jnp.clip(1.0 - s / denom, 1e-6, 1.0)
-        k = jnp.floor(jnp.log(ratio) / jnp.log(e))
-        k = jnp.clip(k, 0.0, 40.0)
-        elapsed = denom * (1.0 - e**k)
-        local = s - elapsed
-        vk = v0 * e**k
-        bounce_y = jnp.maximum(vk * local - 0.5 * _GRAVITY * local**2, 0.0)
-        settled = vk < 0.15
-        return jnp.where(in_fall, fall_y, jnp.where(settled, 0.0, bounce_y))
-
-    y = bounce_height(tau) + radius
+    y = _ballistic_height(tau, h0) + radius
     centers = jnp.stack([x, y, z], axis=-1)
     albedo = _grid_colors(n_spheres)
     emission = jnp.zeros((n_spheres, 3), jnp.float32)
     return _pad_spheres(centers, radius, albedo, emission, pad)
+
+
+def _ballistic_height(t, h0, *, restitution: float = 0.7):
+    """Closed-form bounce height at time t for a drop from h0 (see _physics)."""
+    e = restitution
+    v0 = jnp.sqrt(2.0 * _GRAVITY * h0)
+    t_fall = jnp.sqrt(2.0 * h0 / _GRAVITY)
+    in_fall = t < t_fall
+    fall_y = h0 - 0.5 * _GRAVITY * t**2
+    s = t - t_fall
+    denom = 2.0 * v0 / (_GRAVITY * (1.0 - e))
+    ratio = jnp.clip(1.0 - s / denom, 1e-6, 1.0)
+    k = jnp.clip(jnp.floor(jnp.log(ratio) / jnp.log(e)), 0.0, 40.0)
+    elapsed = denom * (1.0 - e**k)
+    local = s - elapsed
+    vk = v0 * e**k
+    bounce_y = jnp.maximum(vk * local - 0.5 * _GRAVITY * local**2, 0.0)
+    settled = vk < 0.15
+    return jnp.where(in_fall, fall_y, jnp.where(settled, 0.0, bounce_y))
+
+
+def build_mesh_instances(name: str, frame):
+    """Mesh instance transforms for mesh-backed scenes, else ``None``.
+
+    02_physics-mesh: K tumbling boxes dropped ballistically (the mesh
+    counterpart of the _physics sphere rain — reference analog:
+    blender-projects/02_physics rigid bodies). Topology is static (one
+    shared box BVH); only the rigid transforms depend on the frame, so the
+    whole thing jits and vmaps over frames.
+    """
+    if name != "02_physics-mesh":
+        return None
+    from tpu_render_cluster.render.mesh import MeshInstances, rotation_y
+
+    frame = jnp.asarray(frame, jnp.float32)
+    t = frame / _FPS
+    k = 24
+    index = jnp.arange(k, dtype=jnp.float32)
+    u1 = jnp.mod(index * 0.7548776662, 1.0)
+    u2 = jnp.mod(index * 0.5698402909, 1.0)
+    u3 = jnp.mod(index * 0.3819660113, 1.0)
+    size = 0.6 + 0.5 * u3
+    x = (u1 - 0.5) * 7.0
+    z = (u2 - 0.5) * 7.0
+    h0 = 2.5 + 4.0 * u3
+    tau = jnp.maximum(t - u1 * 1.5, 0.0)
+    y = _ballistic_height(tau, h0) + size * 0.5
+    rotation = rotation_y(tau * (0.6 + 2.0 * u2) + u1 * 6.28)
+    translation = jnp.stack([x, y, z], axis=-1)
+    albedo = _grid_colors(k)
+    return MeshInstances(
+        rotation=rotation, translation=translation, albedo=albedo, scale=size
+    )
+
+
+def mesh_kind_for_scene(name: str) -> str | None:
+    """Which cached object-space BVH a mesh scene uses (None = no mesh)."""
+    return "box" if name == "02_physics-mesh" else None
 
 
 def build_scene(name: str, frame) -> Scene:
@@ -185,6 +220,11 @@ def build_scene(name: str, frame) -> Scene:
         spheres = _simple_animation(frame)
     elif name == "02_physics":
         spheres = _physics(frame, 48, 64, chaos=0.0)
+    elif name == "02_physics-mesh":
+        # A handful of spheres accompany the boxes (sky + plane + spheres
+        # exercise every primitive in one scene); the boxes ride the mesh
+        # path via build_mesh_instances.
+        spheres = _physics(frame, 12, 16, chaos=0.0)
     elif name == "03_physics-2":
         spheres = _physics(frame, 96, 128, chaos=1.0)
     else:
@@ -201,8 +241,15 @@ def scene_for_job_name(job_name: str) -> str:
     ("01sa_...", "02ph_...", "03ph2_...", "04vs_..."): the two-digit
     project number prefix is unique across families.
     """
+    # Exact family-name prefixes first, longest first, so
+    # "02_physics-mesh_x" doesn't fall through to "02_physics".
+    for name in sorted(SCENE_NAMES, key=len, reverse=True):
+        if job_name.startswith(name):
+            return name
+    # Two-digit project prefixes map to the classic (non-mesh) families.
     for name in SCENE_NAMES:
-        key = name.split("_", 1)[0]  # "04", "01", ...
-        if job_name.startswith(name) or job_name.startswith(key):
+        if name.endswith("-mesh"):
+            continue
+        if job_name.startswith(name.split("_", 1)[0]):
             return name
     return "04_very-simple"
