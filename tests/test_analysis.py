@@ -130,16 +130,18 @@ def test_tail_delay_and_phase_split(results_dir):
     tail = M.tail_delay_stats(traces)
     assert tail[(1, "eager-naive-coarse")]["mean_tail_seconds"] == pytest.approx(0.0)
     phases = M.phase_split_stats(traces)
-    assert phases[1]["reading"] == pytest.approx(0.2, abs=0.01)
-    assert phases[1]["rendering"] == pytest.approx(0.7, abs=0.01)
-    assert phases[1]["writing"] == pytest.approx(0.1, abs=0.01)
+    key = (1, "eager-naive-coarse")
+    assert phases[key]["reading"] == pytest.approx(0.2, abs=0.01)
+    assert phases[key]["rendering"] == pytest.approx(0.7, abs=0.01)
+    assert phases[key]["writing"] == pytest.approx(0.1, abs=0.01)
 
 
 def test_latency_stats(results_dir):
     traces = load_traces(results_dir)
     stats = M.latency_stats(traces)
-    assert stats[1]["mean_ms"] == pytest.approx(1.5, abs=0.01)
-    assert stats[1]["over_25ms"] == 0
+    key = (1, "eager-naive-coarse")
+    assert stats[key]["mean_ms"] == pytest.approx(1.5, abs=0.01)
+    assert stats[key]["over_25ms"] == 0
 
 
 def test_run_statistics(results_dir):

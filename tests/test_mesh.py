@@ -142,3 +142,33 @@ def test_mesh_scene_job_name_mapping():
     assert scene_for_job_name("02_physics-mesh_240f") == "02_physics-mesh"
     assert scene_for_job_name("02_physics_demo") == "02_physics"
     assert scene_for_job_name("04_very-simple_10f") == "04_very-simple"
+
+
+def test_occlusion_anyhit_matches_nearest_hit():
+    # The dedicated any-hit walks (XLA + Pallas) must agree with "nearest
+    # hit exists" from the brute-force reference, and respect the
+    # `already` mask.
+    import jax.numpy as jnp
+
+    from tpu_render_cluster.render import pallas_kernels
+    from tpu_render_cluster.render.mesh import occluded_bvh_packet
+
+    bvh = cached_mesh_bvh("icosphere")
+    origins, directions = _rays(300, seed=5)
+    t_brute, _ = intersect_triangles_brute(bvh, origins, directions)
+    expected = np.asarray(t_brute) < 1e29
+    none = jnp.zeros((300,), bool)
+    occ_xla = np.asarray(occluded_bvh_packet(bvh, origins, directions, none))
+    occ_pl = np.asarray(
+        pallas_kernels.occluded_bvh_pallas(bvh, origins, directions, none)
+    )
+    assert (occ_xla == expected).all()
+    assert (occ_pl == expected).all()
+    # already-occluded rays stay occluded.
+    all_occ = jnp.ones((300,), bool)
+    assert np.asarray(
+        occluded_bvh_packet(bvh, origins, directions, all_occ)
+    ).all()
+    assert np.asarray(
+        pallas_kernels.occluded_bvh_pallas(bvh, origins, directions, all_occ)
+    ).all()

@@ -157,21 +157,25 @@ def tail_delay_stats(traces: list[JobTrace]) -> dict:
 
 
 def latency_stats(traces: list[JobTrace]) -> dict:
-    """Heartbeat RTT in milliseconds (reference: worker_latency.py:74-87)."""
-    grouped: dict[int, list[float]] = defaultdict(list)
+    """Heartbeat RTT in milliseconds, grouped by (cluster size, strategy)
+    (reference: worker_latency.py:74-87 keeps the strategy axis — a
+    strategy-specific latency pathology must stay visible)."""
+    grouped: dict[tuple[int, str], list[float]] = defaultdict(list)
     for trace in traces:
         for worker in trace.worker_traces.values():
             for ping in worker.ping_traces:
-                grouped[trace.cluster_size()].append(ping.latency() * 1000.0)
+                grouped[(trace.cluster_size(), trace.strategy_type())].append(
+                    ping.latency() * 1000.0
+                )
     return {
-        size: {
+        key: {
             "mean_ms": statistics.fmean(values),
             "median_ms": statistics.median(values),
             "max_ms": max(values),
             "over_25ms": sum(1 for v in values if v > 25.0),
             "count": len(values),
         }
-        for size, values in grouped.items()
+        for key, values in grouped.items()
         if values
     }
 
@@ -180,9 +184,11 @@ def latency_stats(traces: list[JobTrace]) -> dict:
 
 
 def phase_split_stats(traces: list[JobTrace]) -> dict:
-    """Mean fraction of frame time in load/render/save
-    (reference: reading_rendering_writing.py)."""
-    grouped: dict[int, list[tuple[float, float, float]]] = defaultdict(list)
+    """Mean fraction of frame time in load/render/save, grouped by
+    (cluster size, strategy) (reference: reading_rendering_writing.py)."""
+    grouped: dict[tuple[int, str], list[tuple[float, float, float]]] = (
+        defaultdict(list)
+    )
     for trace in traces:
         for worker in trace.worker_traces.values():
             for frame in worker.frame_render_traces:
@@ -193,17 +199,17 @@ def phase_split_stats(traces: list[JobTrace]) -> dict:
                 read = d.finished_loading_at - d.started_process_at
                 render = d.finished_rendering_at - d.started_rendering_at
                 save = d.file_saving_finished_at - d.file_saving_started_at
-                grouped[trace.cluster_size()].append(
+                grouped[(trace.cluster_size(), trace.strategy_type())].append(
                     (read / total, render / total, save / total)
                 )
     return {
-        size: {
+        key: {
             "reading": statistics.fmean(v[0] for v in values),
             "rendering": statistics.fmean(v[1] for v in values),
             "writing": statistics.fmean(v[2] for v in values),
             "frames": len(values),
         }
-        for size, values in grouped.items()
+        for key, values in grouped.items()
         if values
     }
 

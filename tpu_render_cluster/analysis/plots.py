@@ -122,19 +122,29 @@ def plot_tail_delay(traces: list[JobTrace], output_directory: Path) -> Path:
 
 
 def plot_latency(traces: list[JobTrace], output_directory: Path) -> Path:
+    """Heartbeat RTT boxplots per cluster size, one panel per strategy
+    (reference: worker_latency.py keeps the strategy axis)."""
     output_directory.mkdir(parents=True, exist_ok=True)
-    by_size = defaultdict(list)
-    for trace in traces:
-        for worker in trace.worker_traces.values():
-            for ping in worker.ping_traces:
-                by_size[trace.cluster_size()].append(ping.latency() * 1000.0)
-    sizes = sorted(by_size)
-    fig, axis = plt.subplots(figsize=(7, 4))
-    if sizes:
-        axis.boxplot([by_size[s] for s in sizes], tick_labels=[str(s) for s in sizes])
-    axis.set_xlabel("cluster size")
-    axis.set_ylabel("heartbeat RTT (ms)")
-    axis.set_ybound(0.0, 5.0)
+    groups = _strategy_groups(traces)
+    fig, axes = plt.subplots(
+        1, max(len(groups), 1), figsize=(5 * max(len(groups), 1), 4),
+        squeeze=False,
+    )
+    for axis, (strategy, strategy_traces) in zip(axes[0], sorted(groups.items())):
+        by_size = defaultdict(list)
+        for trace in strategy_traces:
+            for worker in trace.worker_traces.values():
+                for ping in worker.ping_traces:
+                    by_size[trace.cluster_size()].append(ping.latency() * 1000.0)
+        sizes = sorted(by_size)
+        if sizes:
+            axis.boxplot(
+                [by_size[s] for s in sizes], tick_labels=[str(s) for s in sizes]
+            )
+        axis.set_title(f"RTT — {strategy}")
+        axis.set_xlabel("cluster size")
+        axis.set_ylabel("heartbeat RTT (ms)")
+        axis.set_ybound(0.0, 5.0)
     path = output_directory / "worker_latency.png"
     fig.tight_layout()
     fig.savefig(path, dpi=110)
@@ -145,18 +155,138 @@ def plot_latency(traces: list[JobTrace], output_directory: Path) -> Path:
 def plot_phase_split(traces: list[JobTrace], output_directory: Path) -> Path:
     output_directory.mkdir(parents=True, exist_ok=True)
     stats = M.phase_split_stats(traces)
-    sizes = sorted(stats)
-    fig, axis = plt.subplots(figsize=(7, 4))
-    left = [0.0] * len(sizes)
+    keys = sorted(stats)
+    fig, axis = plt.subplots(figsize=(8, max(4, 0.4 * len(keys))))
+    left = [0.0] * len(keys)
     for phase, color in (("reading", "#4878a8"), ("rendering", "#e8a33d"), ("writing", "#6aa56a")):
-        values = [stats[s][phase] for s in sizes]
-        axis.barh(range(len(sizes)), values, left=left, label=phase, color=color)
+        values = [stats[k][phase] for k in keys]
+        axis.barh(range(len(keys)), values, left=left, label=phase, color=color)
         left = [l + v for l, v in zip(left, values)]
-    axis.set_yticks(range(len(sizes)))
-    axis.set_yticklabels([f"{s} workers" for s in sizes])
+    axis.set_yticks(range(len(keys)))
+    axis.set_yticklabels([f"{size}w/{strategy}" for size, strategy in keys], fontsize=7)
     axis.set_xlabel("fraction of frame time")
     axis.legend(fontsize=8)
     path = output_directory / "reading_rendering_writing.png"
+    fig.tight_layout()
+    fig.savefig(path, dpi=110)
+    plt.close(fig)
+    return path
+
+
+def plot_tail_delay_grids(traces: list[JobTrace], output_directory: Path) -> list[Path]:
+    """Per-cluster-size panels of per-strategy tail-delay boxplots.
+
+    Two figures, absolute seconds and scaled by mean frame render time,
+    mirroring the reference's 3x2 grids (reference: job_tail_delay.py
+    plot_tail_delay — one panel per measured cluster size, strategies on
+    the x axis; scaled plot bounded to [0, 2] x mean frame time).
+    """
+    from tpu_render_cluster.analysis.models import (
+        mean_frame_time,
+        worker_tail_delay,
+    )
+    import statistics
+
+    output_directory.mkdir(parents=True, exist_ok=True)
+    # (size, strategy) -> per-run (absolute, scaled) tail delays.
+    per_run: dict[tuple[int, str], list[tuple[float, float]]] = defaultdict(list)
+    for trace in traces:
+        global_last = trace.get_last_frame_finished_at()
+        delays = [
+            worker_tail_delay(worker, global_last)
+            for worker in trace.worker_traces.values()
+        ]
+        if not delays:
+            continue
+        run_tail = max(delays)
+        frame_times = [
+            mean_frame_time(w)
+            for w in trace.worker_traces.values()
+            if w.frame_render_traces
+        ]
+        mean_ft = statistics.fmean(frame_times) if frame_times else 0.0
+        per_run[(trace.cluster_size(), trace.strategy_type())].append(
+            (run_tail, run_tail / mean_ft if mean_ft > 0 else 0.0)
+        )
+
+    sizes = sorted({size for size, _ in per_run})
+    strategies = sorted({strategy for _, strategy in per_run})
+    if not sizes:
+        return []
+    n_cols = 2
+    n_rows = -(-len(sizes) // n_cols)
+    global_max = max(v[0] for values in per_run.values() for v in values)
+
+    paths = []
+    for which, suffix, y_label, y_max in (
+        (0, "seconds", "tail delay (s)", max(global_max * 1.1, 1e-3)),
+        (1, "scaled", "tail delay (x mean frame time)", 2.0),
+    ):
+        fig, axes = plt.subplots(
+            n_rows, n_cols, figsize=(5 * n_cols, 3.4 * n_rows), squeeze=False
+        )
+        for i in range(n_rows * n_cols):
+            axis = axes[i // n_cols][i % n_cols]
+            if i >= len(sizes):
+                fig.delaxes(axis)
+                continue
+            size = sizes[i]
+            data = [
+                [v[which] for v in per_run.get((size, strategy), [])]
+                for strategy in strategies
+            ]
+            axis.boxplot(
+                [d if d else [0.0] for d in data],
+                tick_labels=[s.replace("-", chr(10)) for s in strategies],
+            )
+            axis.set_title(f"{size} workers", fontsize=9)
+            axis.set_ybound(0.0, y_max)
+            axis.tick_params(labelsize=6)
+            if i % n_cols == 0:
+                axis.set_ylabel(y_label, fontsize=8)
+        fig.suptitle(f"Job tail delay ({suffix})")
+        path = output_directory / f"job_tail_delay_{suffix}_grid.png"
+        fig.tight_layout()
+        fig.savefig(path, dpi=110)
+        plt.close(fig)
+        paths.append(path)
+    return paths
+
+
+def plot_utilization_vs_strategy(
+    traces: list[JobTrace], output_directory: Path
+) -> Path:
+    """Utilization boxplots with the STRATEGY on the x axis, one panel per
+    cluster size (reference: worker_utilization.py
+    plot_utilization_rate_against_strategies:188-296, including the
+    emphasised [0.95, 1.0] bound, widened only when data falls below)."""
+    output_directory.mkdir(parents=True, exist_ok=True)
+    per_key: dict[tuple[int, str], list[float]] = defaultdict(list)
+    for trace in traces:
+        for u in M.worker_utilizations(trace):
+            per_key[(trace.cluster_size(), trace.strategy_type())].append(
+                u.utilization
+            )
+    sizes = sorted({size for size, _ in per_key})
+    strategies = sorted({strategy for _, strategy in per_key})
+    fig, axes = plt.subplots(
+        1, max(len(sizes), 1), figsize=(4.2 * max(len(sizes), 1), 4),
+        squeeze=False,
+    )
+    lowest = min((min(v) for v in per_key.values() if v), default=1.0)
+    lower_bound = min(0.95, max(0.0, lowest - 0.02))
+    for axis, size in zip(axes[0], sizes):
+        data = [per_key.get((size, strategy), []) for strategy in strategies]
+        axis.boxplot(
+            [d if d else [0.0] for d in data],
+            tick_labels=[s.replace("-", chr(10)) for s in strategies],
+        )
+        axis.set_title(f"{size} workers", fontsize=9)
+        axis.set_xlabel("strategy", fontsize=8)
+        axis.set_ylabel("utilization", fontsize=8)
+        axis.set_ybound(lower_bound, 1.0)
+        axis.tick_params(labelsize=6)
+    path = output_directory / "worker_utilization_vs_strategy.png"
     fig.tight_layout()
     fig.savefig(path, dpi=110)
     plt.close(fig)

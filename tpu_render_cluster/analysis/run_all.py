@@ -69,6 +69,8 @@ def main(argv: list[str] | None = None) -> int:
                 plots.plot_speedup_and_efficiency,
                 plots.plot_job_durations,
                 plots.plot_tail_delay,
+                plots.plot_tail_delay_grids,
+                plots.plot_utilization_vs_strategy,
                 plots.plot_latency,
                 plots.plot_phase_split,
             ):

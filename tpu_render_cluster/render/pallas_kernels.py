@@ -550,7 +550,7 @@ BVH_DONE_EPS = 1e-12
 
 def _bvh_kernel_factory(n_nodes: int, leaf_size: int):
     def kernel(
-        o_ref, d_ref, v0_ref, e1_ref, e2_ref,
+        o_ref, d_ref, tinit_ref, v0_ref, e1_ref, e2_ref,
         bmin_ref, bmax_ref, skip_ref, first_ref, count_ref,
         t_ref, idx_ref,
     ):
@@ -653,7 +653,7 @@ def _bvh_kernel_factory(n_nodes: int, leaf_size: int):
             body,
             (
                 jnp.int32(0),
-                jnp.full((1, block), INF, jnp.float32),
+                tinit_ref[:, :],  # cull seed from earlier instances
                 jnp.zeros((1, block), jnp.int32),
             ),
         )
@@ -663,24 +663,36 @@ def _bvh_kernel_factory(n_nodes: int, leaf_size: int):
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _bvh_nearest(
-    origins, directions, v0, e1, e2, bounds_min, bounds_max, skip, first,
-    count, *, interpret: bool,
-):
-    from tpu_render_cluster.render.mesh import LEAF_SIZE
+def _pad_rays_to_miss(origins, directions):
+    """Block-pad rays so pad lanes provably MISS the tree.
 
+    A zero pad direction would turn the slab test degenerate (inv ~ 1e12
+    hits every AABB) and — through the packet-wide any() — strip all BVH
+    culling from the final block. A far-away origin with a perpendicular
+    unit direction misses the root.
+    """
     rays = origins.shape[0]
     padded_rays = -(-rays // BLOCK_R) * BLOCK_R
     ray_pad = padded_rays - rays
-    # Pad rays must MISS the tree: a zero direction would turn the slab
-    # test degenerate (inv ~ 1e12 hits every AABB) and — through the
-    # packet-wide any() — strip all BVH culling from the final block. A
-    # far-away origin with a perpendicular unit direction misses the root.
     o_t = jnp.pad(origins, ((0, ray_pad), (0, 0)), constant_values=1e7).T
     d_t = jnp.pad(directions, ((0, ray_pad), (0, 0))).T
     if ray_pad:
         d_t = d_t.at[1, rays:].set(1.0)
+    return o_t, d_t, rays, padded_rays
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _bvh_nearest(
+    origins, directions, init_t, v0, e1, e2, bounds_min, bounds_max, skip,
+    first, count, *, interpret: bool,
+):
+    from tpu_render_cluster.render.mesh import LEAF_SIZE
+
+    o_t, d_t, rays, padded_rays = _pad_rays_to_miss(origins, directions)
+    t_init = jnp.pad(
+        init_t[None, :], ((0, 0), (0, padded_rays - rays)),
+        constant_values=INF,
+    )
 
     n_nodes = skip.shape[0]
     grid = (padded_rays // BLOCK_R,)
@@ -692,6 +704,7 @@ def _bvh_nearest(
         in_specs=[
             pl.BlockSpec((3, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
             pl.BlockSpec((3, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
             pl.BlockSpec(v0.shape, whole, memory_space=pltpu.VMEM),
             pl.BlockSpec(e1.shape, whole, memory_space=pltpu.VMEM),
             pl.BlockSpec(e2.shape, whole, memory_space=pltpu.VMEM),
@@ -710,14 +723,165 @@ def _bvh_nearest(
             jax.ShapeDtypeStruct((1, padded_rays), jnp.int32),
         ],
         interpret=interpret,
-    )(o_t, d_t, v0, e1, e2, bounds_min, bounds_max, skip, first, count)
+    )(o_t, d_t, t_init, v0, e1, e2, bounds_min, bounds_max, skip, first, count)
     return t[0, :rays], idx[0, :rays]
 
 
-def intersect_bvh_pallas(bvh, origins, directions):
+def intersect_bvh_pallas(bvh, origins, directions, init_t=None):
     """Pallas drop-in for ``mesh.intersect_bvh_packet`` (same results)."""
+    if init_t is None:
+        init_t = jnp.full((origins.shape[0],), INF, jnp.float32)
     return _bvh_nearest(
-        origins, directions, bvh.v0, bvh.e1, bvh.e2,
+        origins, directions, init_t, bvh.v0, bvh.e1, bvh.e2,
+        bvh.bounds_min, bvh.bounds_max, bvh.skip, bvh.first, bvh.count,
+        interpret=_interpret(),
+    )
+
+
+def _bvh_anyhit_kernel_factory(n_nodes: int, leaf_size: int):
+    def kernel(
+        o_ref, d_ref, already_ref, v0_ref, e1_ref, e2_ref,
+        bmin_ref, bmax_ref, skip_ref, first_ref, count_ref,
+        occ_ref,
+    ):
+        o = o_ref[:, :]
+        d = d_ref[:, :]
+        ox, oy, oz = o[0:1, :], o[1:2, :], o[2:3, :]
+        dx, dy, dz = d[0:1, :], d[1:2, :], d[2:3, :]
+        small = jnp.abs(d) < 1e-12
+        inv = 1.0 / jnp.where(small, jnp.where(d < 0, -1e-12, 1e-12), d)
+        invx, invy, invz = inv[0:1, :], inv[1:2, :], inv[2:3, :]
+        block = o.shape[1]
+        lanes = jax.lax.broadcasted_iota(jnp.int32, (leaf_size, block), 0)
+
+        def cond(carry):
+            node, _ = carry
+            return node < n_nodes
+
+        def body(carry):
+            node, occluded = carry
+            lox = (bmin_ref[node, 0] - ox) * invx
+            hix = (bmax_ref[node, 0] - ox) * invx
+            loy = (bmin_ref[node, 1] - oy) * invy
+            hiy = (bmax_ref[node, 1] - oy) * invy
+            loz = (bmin_ref[node, 2] - oz) * invz
+            hiz = (bmax_ref[node, 2] - oz) * invz
+            tnear = jnp.maximum(
+                jnp.maximum(jnp.minimum(lox, hix), jnp.minimum(loy, hiy)),
+                jnp.minimum(loz, hiz),
+            )
+            tfar = jnp.minimum(
+                jnp.minimum(jnp.maximum(lox, hix), jnp.maximum(loy, hiy)),
+                jnp.maximum(loz, hiz),
+            )
+            packet_hit = (
+                (tfar >= jnp.maximum(tnear, 0.0)) & (occluded <= 0.0)
+            )
+            hit_any = jnp.any(packet_hit)
+
+            count = count_ref[node]
+            is_leaf = count > 0
+            start = first_ref[node]
+
+            v0b = v0_ref[pl.dslice(start, leaf_size), :]
+            e1b = e1_ref[pl.dslice(start, leaf_size), :]
+            e2b = e2_ref[pl.dslice(start, leaf_size), :]
+            v0x, v0y, v0z = v0b[:, 0:1], v0b[:, 1:2], v0b[:, 2:3]
+            e1x, e1y, e1z = e1b[:, 0:1], e1b[:, 1:2], e1b[:, 2:3]
+            e2x, e2y, e2z = e2b[:, 0:1], e2b[:, 1:2], e2b[:, 2:3]
+            pvx = dy * e2z - dz * e2y
+            pvy = dz * e2x - dx * e2z
+            pvz = dx * e2y - dy * e2x
+            det = e1x * pvx + e1y * pvy + e1z * pvz
+            inv_det = 1.0 / jnp.where(
+                jnp.abs(det) < BVH_DONE_EPS, BVH_DONE_EPS, det
+            )
+            tvx = ox - v0x
+            tvy = oy - v0y
+            tvz = oz - v0z
+            u = (tvx * pvx + tvy * pvy + tvz * pvz) * inv_det
+            qvx = tvy * e1z - tvz * e1y
+            qvy = tvz * e1x - tvx * e1z
+            qvz = tvx * e1y - tvy * e1x
+            v = (dx * qvx + dy * qvy + dz * qvz) * inv_det
+            tt = (e2x * qvx + e2y * qvy + e2z * qvz) * inv_det
+            tri_hit = (
+                (jnp.abs(det) > BVH_DONE_EPS)
+                & (u >= 0.0)
+                & (v >= 0.0)
+                & (u + v <= 1.0)
+                & (tt > EPS)
+                & (lanes < count)
+                & is_leaf
+                & hit_any
+            )
+            occluded = jnp.maximum(
+                occluded,
+                jnp.max(jnp.where(tri_hit, 1.0, 0.0), axis=0, keepdims=True),
+            )
+            next_node = jnp.where(
+                hit_any,
+                jnp.where(is_leaf, skip_ref[node], node + 1),
+                skip_ref[node],
+            )
+            return next_node, occluded
+
+        _, occluded = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), already_ref[:, :])
+        )
+        occ_ref[:, :] = occluded
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _bvh_anyhit(
+    origins, directions, already, v0, e1, e2, bounds_min, bounds_max, skip,
+    first, count, *, interpret: bool,
+):
+    from tpu_render_cluster.render.mesh import LEAF_SIZE
+
+    o_t, d_t, rays, padded_rays = _pad_rays_to_miss(origins, directions)
+    # Pad lanes start "occluded" so they never extend the walk.
+    already_f = jnp.pad(
+        already.astype(jnp.float32)[None, :],
+        ((0, 0), (0, padded_rays - rays)),
+        constant_values=1.0,
+    )
+
+    n_nodes = skip.shape[0]
+    grid = (padded_rays // BLOCK_R,)
+    whole = lambda i: (0, 0)  # noqa: E731
+    flat = lambda i: (0,)  # noqa: E731
+    occ = pl.pallas_call(
+        _bvh_anyhit_kernel_factory(n_nodes, LEAF_SIZE),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((3, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec(v0.shape, whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec(e1.shape, whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec(e2.shape, whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec(bounds_min.shape, whole, memory_space=pltpu.SMEM),
+            pl.BlockSpec(bounds_max.shape, whole, memory_space=pltpu.SMEM),
+            pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
+            pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
+            pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((1, padded_rays), jnp.float32),
+        interpret=interpret,
+    )(o_t, d_t, already_f, v0, e1, e2, bounds_min, bounds_max, skip, first, count)
+    return occ[0, :rays] > 0.0
+
+
+def occluded_bvh_pallas(bvh, origins, directions, already):
+    """Pallas drop-in for ``mesh.occluded_bvh_packet`` (same results)."""
+    return _bvh_anyhit(
+        origins, directions, already, bvh.v0, bvh.e1, bvh.e2,
         bvh.bounds_min, bvh.bounds_max, bvh.skip, bvh.first, bvh.count,
         interpret=_interpret(),
     )
