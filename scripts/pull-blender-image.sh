@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Provision the Blender container the `blender` worker backend shells into
+# on HPC nodes (reference: pull-blender-image.sh — same image + version, so
+# render output stays comparable across harnesses).
+#
+# Usage: scripts/pull-blender-image.sh [output-dir]
+#   Produces <output-dir>/blender-3.6.0.sif (singularity/apptainer), or a
+#   local docker/podman image when no singularity runtime exists.
+# Workers then run it via:
+#   --blenderBinary "singularity exec <dir>/blender-3.6.0.sif blender"
+
+set -euo pipefail
+
+IMAGE="docker://linuxserver/blender:3.6.0"
+OUT_DIR="${1:-.}"
+SIF="$OUT_DIR/blender-3.6.0.sif"
+
+mkdir -p "$OUT_DIR"
+
+if command -v singularity >/dev/null 2>&1; then
+    echo "Pulling linuxserver/blender:3.6.0 via singularity."
+    singularity pull --force "$SIF" "$IMAGE"
+elif command -v apptainer >/dev/null 2>&1; then
+    echo "Pulling linuxserver/blender:3.6.0 via apptainer."
+    apptainer pull --force "$SIF" "$IMAGE"
+elif command -v docker >/dev/null 2>&1; then
+    echo "No singularity/apptainer; pulling with docker instead."
+    docker pull linuxserver/blender:3.6.0
+elif command -v podman >/dev/null 2>&1; then
+    echo "No singularity/apptainer; pulling with podman instead."
+    podman pull linuxserver/blender:3.6.0
+else
+    echo "error: no container runtime (singularity/apptainer/docker/podman) found." >&2
+    exit 1
+fi
+echo "Done."
