@@ -40,6 +40,7 @@
 #include <limits>
 #include <list>
 #include <map>
+#include <set>
 #include <sys/select.h>
 #include <sys/wait.h>
 
@@ -573,6 +574,9 @@ struct MasterOptions {
     std::string job_path;
     std::string results_directory = "results";
     std::string python_binary = "python3";
+    // Scheduling-RPC timeout (seconds); raise for sanitized/loaded runs
+    // where 5 s can evict healthy workers (--schedRpcTimeoutSeconds).
+    double sched_rpc_timeout_s = 5.0;
     std::string base_directory = ".";    // %BASE% root for --resume
     bool resume = false;                 // skip frames whose outputs exist
     double evict_after_seconds = 120.0;  // 0 disables (reference behavior)
@@ -708,6 +712,14 @@ class MasterDaemon {
     std::mutex responses_mutex_;
     std::condition_variable responses_cv_;
     std::map<uint64_t, Json> responses_;
+
+    // queue_add RPCs that timed out (request_id -> (worker, frame)): a
+    // late ack is reconciled in dispatch() instead of silently producing
+    // duplicate renders. ignored_responses_ swallows the replies to
+    // fire-and-forget reconciliation removes.
+    std::mutex timed_out_adds_mutex_;
+    std::map<uint64_t, std::pair<uint32_t, int>> timed_out_adds_;
+    std::set<uint64_t> ignored_responses_;
 
     AssignmentService assignment_;
     struct CompletionObservation {
@@ -1055,8 +1067,21 @@ class MasterDaemon {
                    type == "response_job-finished") {
             const Json* context = payload.get("message_request_context_id");
             if (context == nullptr) return;
+            uint64_t id = context->as_u64();
+            {
+                std::lock_guard<std::mutex> lock(timed_out_adds_mutex_);
+                if (ignored_responses_.erase(id) != 0) return;
+                auto late = timed_out_adds_.find(id);
+                if (late != timed_out_adds_.end()) {
+                    auto stale = late->second;
+                    timed_out_adds_.erase(late);
+                    reconcile_late_queue_add(worker, stale.first,
+                                             stale.second, payload);
+                    return;
+                }
+            }
             std::lock_guard<std::mutex> lock(responses_mutex_);
-            responses_[context->as_u64()] = payload;
+            responses_[id] = payload;
             responses_cv_.notify_all();
         } else if (type == "event_frame-queue_item-started-rendering") {
             const Json* frame = payload.get("frame_index");
@@ -1207,7 +1232,7 @@ class MasterDaemon {
     // stall frame distribution to the whole cluster. Three consecutive
     // timeouts evict the worker (its frames requeue), the same remedy the
     // heartbeat monitor applies to fully-silent peers.
-    static constexpr double SCHED_RPC_TIMEOUT_S = 5.0;
+    double sched_rpc_timeout() const { return options_.sched_rpc_timeout_s; }
     static constexpr int SCHED_RPC_MAX_STRIKES = 3;
 
     void note_sched_rpc_result(WorkerConn& worker, bool ok) {
@@ -1226,6 +1251,53 @@ class MasterDaemon {
                       worker.id, strikes);
             evict_worker(&worker);
         }
+    }
+
+    // A queue_add ack that arrived after its RPC timed out: the worker has
+    // the frame queued, but the master reverted the slot to Pending. If
+    // the slot is still unclaimed, adopt the assignment (cheapest — the
+    // render proceeds where it already is); if another worker has since
+    // claimed it, tell the late worker to drop its copy so the frame is
+    // not rendered twice.
+    void reconcile_late_queue_add(WorkerConn* worker, uint32_t worker_id,
+                                  int frame_index, const Json& payload) {
+        const Json* result = payload.get("result");
+        const Json* value = result != nullptr ? result->get("result") : nullptr;
+        bool added = value != nullptr && value->as_string() == "added-to-queue";
+        if (!added || worker->id != worker_id) return;
+        bool adopt = false;
+        {
+            std::lock_guard<std::mutex> lock(state_mutex_);
+            FrameSlot* slot = slot_for(frame_index);
+            if (slot != nullptr && slot->status == FrameStatus::Pending) {
+                slot->status = FrameStatus::Queued;
+                slot->worker = worker->id;
+                FrameOnWorker entry;
+                entry.frame_index = frame_index;
+                entry.queued_at = now_ts();
+                worker->queue.push_back(entry);
+                adopt = true;
+            }
+        }
+        if (adopt) {
+            LOG_WARN("Late queue_add ack for frame %d on %08x: adopted.",
+                     frame_index, worker->id);
+            return;
+        }
+        LOG_WARN("Late queue_add ack for frame %d on %08x after "
+                 "reassignment: removing remote copy.",
+                 frame_index, worker->id);
+        Json remove = Json::make_object();
+        remove.set("frame_index", Json::make_int(frame_index));
+        uint64_t remove_id = rng()();
+        remove.set("message_request_id", Json::make_uint(remove_id));
+        {
+            std::lock_guard<std::mutex> lock(timed_out_adds_mutex_);
+            ignored_responses_.insert(remove_id);
+            if (ignored_responses_.size() > 1024) ignored_responses_.clear();
+        }
+        send_to_worker(*worker, "request_frame-queue_remove",
+                       std::move(remove));
     }
 
     // queue_frame (reference: master/src/connection/mod.rs:139-168): mark
@@ -1247,7 +1319,15 @@ class MasterDaemon {
         uint64_t request_id = rng()();
         Json response;
         bool rpc_ok = rpc(worker, "request_frame-queue_add", std::move(payload),
-                          request_id, SCHED_RPC_TIMEOUT_S, &response);
+                          request_id, sched_rpc_timeout(), &response);
+        if (!rpc_ok) {
+            // The ack may still arrive after we revert the slot; remember
+            // the request so a late "added-to-queue" can be reconciled
+            // instead of double-rendering the frame (see dispatch()).
+            std::lock_guard<std::mutex> lock(timed_out_adds_mutex_);
+            if (timed_out_adds_.size() > 1024) timed_out_adds_.clear();
+            timed_out_adds_[request_id] = {worker.id, frame_index};
+        }
         bool ok = rpc_ok;
         if (ok) {
             const Json* result = response.get("result");
@@ -1472,7 +1552,7 @@ class MasterDaemon {
         uint64_t request_id = rng()();
         Json response;
         bool ok = rpc(*victim, "request_frame-queue_remove", std::move(payload),
-                      request_id, SCHED_RPC_TIMEOUT_S, &response);
+                      request_id, sched_rpc_timeout(), &response);
         note_sched_rpc_result(*victim, ok);
         if (!ok) return;
         const Json* result = response.get("result");
@@ -1914,6 +1994,7 @@ static void print_usage() {
             "                          their frames (0 = reference behavior:\n"
             "                          never; default 120)\n"
             "  --pythonBinary B        python for the tpu-batch assignment\n"
+            "  --schedRpcTimeoutSeconds S  scheduling RPC timeout (default 5)\n"
             "                          service (default python3)\n"
             "  --resume                skip frames whose output files exist\n"
             "  --baseDirectory D       %%BASE%% root for --resume (default .)\n");
@@ -1942,6 +2023,7 @@ int main(int argc, char** argv) {
         else if (flag == "--evictAfterSeconds")
             options.evict_after_seconds = atof(next().c_str());
         else if (flag == "--pythonBinary") options.python_binary = next();
+        else if (flag == "--schedRpcTimeoutSeconds") options.sched_rpc_timeout_s = atof(next().c_str());
         else if (flag == "--resume") options.resume = true;
         else if (flag == "--baseDirectory") options.base_directory = next();
         else if (flag == "--help" || flag == "-h") {
