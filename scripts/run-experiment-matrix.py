@@ -236,7 +236,13 @@ def run_northstar(results_root: Path, repeats: int, *, tpu: bool) -> None:
                 print(f"[northstar cpu] r{repeat + 1}: {path.name}", flush=True)
 
 
-def _job_toml(frames: int, workers: int, strategy: str, output_directory: str) -> str:
+def _job_toml(
+    frames: int,
+    workers: int,
+    strategy: str,
+    output_directory: str,
+    job_name: str = "04_very-simple",
+) -> str:
     if strategy == "tpu-batch":
         strategy_block = (
             '[frame_distribution_strategy]\n'
@@ -253,7 +259,7 @@ def _job_toml(frames: int, workers: int, strategy: str, output_directory: str) -
             "target_queue_size = 100\n"
         )
     return (
-        'job_name = "04_very-simple"\n'
+        f'job_name = "{job_name}"\n'
         'job_description = "north-star multiprocess run"\n'
         'project_file_path = "%BASE%/p.blend"\n'
         'render_script_path = "%BASE%/s.py"\n'
@@ -267,7 +273,9 @@ def _job_toml(frames: int, workers: int, strategy: str, output_directory: str) -
     )
 
 
-def run_northstar_multiprocess(results_root: Path, repeats: int) -> None:
+def run_northstar_multiprocess(
+    results_root: Path, repeats: int, *, only: str | None = None
+) -> None:
     """Master + workers as separate OS processes over localhost WebSockets.
 
     The reference's actual deployment shape (one process per SLURM task).
@@ -296,12 +304,16 @@ def run_northstar_multiprocess(results_root: Path, repeats: int) -> None:
         results_directory: Path,
         *,
         worker_platform: str,
+        job_name: str = "04_very-simple",
     ) -> None:
         port = free_port()
         with tempfile.TemporaryDirectory(prefix="trc-mp-") as out_dir:
             job_path = Path(out_dir) / "job.toml"
             job_path.write_text(
-                _job_toml(frames, workers, strategy, str(Path(out_dir) / "frames"))
+                _job_toml(
+                    frames, workers, strategy,
+                    str(Path(out_dir) / "frames"), job_name,
+                )
             )
             master_env = dict(os.environ)
             master_env["PYTHONPATH"] = str(REPO_ROOT)
@@ -336,7 +348,7 @@ def run_northstar_multiprocess(results_root: Path, repeats: int) -> None:
                         "--renderSize",
                         f"{NORTHSTAR_WIDTH}x{NORTHSTAR_HEIGHT}",
                         "--renderSamples", str(NORTHSTAR_SAMPLES),
-                        "--warmScene", "04_very-simple",
+                        "--warmScene", job_name,
                     ],
                     env=worker_env,
                 )
@@ -356,27 +368,37 @@ def run_northstar_multiprocess(results_root: Path, repeats: int) -> None:
                     master.kill()
 
     # 1-worker CPU baseline with the identical process topology.
-    for repeat in range(max(2, repeats - 1)):
+    for repeat in range(0 if only == "mesh" else max(2, repeats - 1)):
         run_cluster(
             NORTHSTAR_FRAMES, 1, "eager-naive-coarse",
             results_root / "northstar-mp-10f/eager-naive-coarse_1w_cpu-baseline",
             worker_platform="cpu",
         )
         print(f"[northstar-mp cpu] r{repeat + 1} done", flush=True)
-    for repeat in range(repeats):
+    for repeat in range(0 if only == "mesh" else repeats):
         run_cluster(
             NORTHSTAR_FRAMES, 4, "tpu-batch",
             results_root / "northstar-mp-10f/tpu-batch_4w_tpu-raytrace",
             worker_platform="tpu",
         )
         print(f"[northstar-mp tpu 10f] r{repeat + 1} done", flush=True)
-    for repeat in range(2):
+    for repeat in range(0 if only == "mesh" else 2):
         run_cluster(
             64, 4, "tpu-batch",
             results_root / "northstar-mp-64f/tpu-batch_4w_tpu-raytrace",
             worker_platform="tpu",
         )
         print(f"[northstar-mp tpu 64f] r{repeat + 1} done", flush=True)
+    # Mesh scene through the full distributed stack: tumbling-box frames
+    # rendered by tpu-raytrace workers via the Pallas BVH traversal.
+    for repeat in range(2):
+        run_cluster(
+            24, 4, "tpu-batch",
+            results_root / "mesh-mp-24f/tpu-batch_4w_tpu-raytrace",
+            worker_platform="tpu",
+            job_name="02_physics-mesh",
+        )
+        print(f"[mesh-mp tpu 24f] r{repeat + 1} done", flush=True)
 
 
 def run_all(results_root: Path, repeats: int) -> int:
@@ -433,6 +455,7 @@ def run_all(results_root: Path, repeats: int) -> int:
         "northstar-util-64f",
         "northstar-mp-10f",
         "northstar-mp-64f",
+        "mesh-mp-24f",
     ):
         rc = analysis.main(
             [
@@ -452,7 +475,7 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--suite",
-        choices=["mock", "northstar-baseline", "northstar-tpu", "northstar-mp", "all"],
+        choices=["mock", "northstar-baseline", "northstar-tpu", "northstar-mp", "mesh-mp", "all"],
         default="all",
     )
     parser.add_argument("--results", default=None)
@@ -470,6 +493,9 @@ def main() -> int:
         return 0
     if args.suite == "northstar-mp":
         run_northstar_multiprocess(results_root, args.repeats)
+        return 0
+    if args.suite == "mesh-mp":
+        run_northstar_multiprocess(results_root, args.repeats, only="mesh")
         return 0
     if args.suite == "northstar-baseline":
         run_northstar(results_root, max(2, args.repeats - 1), tpu=False)

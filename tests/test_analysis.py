@@ -196,7 +196,7 @@ def test_canonical_paths_are_consistent():
 
     assert DEFAULT_RESULTS_DIR == REPO_ROOT / "results" / "cluster-runs"
     assert DEFAULT_ANALYSIS_DIR == REPO_ROOT / "results" / "analysis"
-    template = (REPO_ROOT / "scripts" / "slurm" / "queue-batch_04vs_14400f-5w_dynamic.sh").read_text()
+    template = (REPO_ROOT / "scripts" / "slurm" / "arnes" / "queue-batch_04vs_14400f-5w_dynamic.sh").read_text()
     assert "results/cluster-runs/" in template
 
 

@@ -13,7 +13,10 @@ import jax.numpy as jnp
 
 from tpu_render_cluster.render.scene import Scene
 
-INF = jnp.float32(1e30)
+# Plain Python float (not a jnp scalar): a module-level device constant
+# would be created during whatever trace first imports the module and leak
+# that trace's tracer into every later caller.
+INF = 1e30
 EPS = 1e-3
 
 

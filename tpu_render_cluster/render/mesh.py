@@ -38,7 +38,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-INF = jnp.float32(1e30)
+# Plain Python floats: a module-level jnp constant would be created during
+# whatever trace first imports this module (the integrator imports it
+# lazily inside traced functions) and leak that trace's tracer into every
+# later caller.
+INF = 1e30
 EPS = 1e-3
 # Fixed leaf width: every leaf occupies its own LEAF_SIZE-aligned slot of
 # exactly LEAF_SIZE triangle rows (real triangles first, degenerate padding
