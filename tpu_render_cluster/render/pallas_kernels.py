@@ -37,7 +37,12 @@ from jax.experimental.pallas import tpu as pltpu
 INF = 1e30
 EPS = 1e-3
 
-BLOCK_R = 1024  # rays per grid step (8 f32 lane-tiles)
+# Rays per grid step. Swept on the real chip (bench.py, 256x256 4spp):
+# 512 -> 432 f/s, 1024 -> 509, 2048 -> 538, 4096 -> 548, 8192 -> 545.
+# Bigger blocks amortize per-step scheduling and keep the VPU busier;
+# VMEM stays comfortable (the largest intermediate is [N_spheres, BLOCK_R]
+# ~ 1 MB at 64 spheres).
+BLOCK_R = 4096
 _SUBLANE = 8  # f32 sublane tile; sphere count is padded to a multiple
 
 
