@@ -538,6 +538,14 @@ def occluded_instances(bvh: MeshBVH, instances: MeshInstances, origins, directio
     so the per-instance scan skips the normal/albedo gathers and transform.
     """
 
+    from tpu_render_cluster.render import pallas_kernels
+
+    if pallas_kernels.pallas_enabled():
+        return pallas_kernels.occluded_instances_pallas(
+            bvh, instances, origins, directions,
+            jnp.zeros((origins.shape[0],), bool),
+        )
+
     def per_instance(occluded, k):
         local_origins, local_directions = _rays_to_object_space(
             instances, k, origins, directions

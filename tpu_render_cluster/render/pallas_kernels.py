@@ -43,6 +43,12 @@ EPS = 1e-3
 # VMEM stays comfortable (the largest intermediate is [N_spheres, BLOCK_R]
 # ~ 1 MB at 64 spheres).
 BLOCK_R = 4096
+# The BVH kernels use their own ray-block size: packet culling (the
+# block-wide any() on AABB tests and the instance-level world-AABB skip)
+# only bites when a block is spatially tight. Swept on the real chip
+# (bench-mesh): 512 -> 8.3 f/s, 1024 -> 9.0, 2048 -> 9.25, 4096 -> 9.1,
+# 8192 -> 8.6.
+BVH_BLOCK_R = 2048
 _SUBLANE = 8  # f32 sublane tile; sphere count is padded to a multiple
 
 
@@ -677,7 +683,7 @@ def _pad_rays_to_miss(origins, directions):
     unit direction misses the root.
     """
     rays = origins.shape[0]
-    padded_rays = -(-rays // BLOCK_R) * BLOCK_R
+    padded_rays = -(-rays // BVH_BLOCK_R) * BVH_BLOCK_R
     ray_pad = padded_rays - rays
     o_t = jnp.pad(origins, ((0, ray_pad), (0, 0)), constant_values=1e7).T
     d_t = jnp.pad(directions, ((0, ray_pad), (0, 0))).T
@@ -700,16 +706,16 @@ def _bvh_nearest(
     )
 
     n_nodes = skip.shape[0]
-    grid = (padded_rays // BLOCK_R,)
+    grid = (padded_rays // BVH_BLOCK_R,)
     whole = lambda i: (0, 0)  # noqa: E731
     flat = lambda i: (0,)  # noqa: E731
     t, idx = pl.pallas_call(
         _bvh_kernel_factory(n_nodes, LEAF_SIZE),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((3, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((3, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
             pl.BlockSpec(v0.shape, whole, memory_space=pltpu.VMEM),
             pl.BlockSpec(e1.shape, whole, memory_space=pltpu.VMEM),
             pl.BlockSpec(e2.shape, whole, memory_space=pltpu.VMEM),
@@ -720,8 +726,8 @@ def _bvh_nearest(
             pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((1, padded_rays), jnp.float32),
@@ -855,16 +861,16 @@ def _bvh_anyhit(
     )
 
     n_nodes = skip.shape[0]
-    grid = (padded_rays // BLOCK_R,)
+    grid = (padded_rays // BVH_BLOCK_R,)
     whole = lambda i: (0, 0)  # noqa: E731
     flat = lambda i: (0,)  # noqa: E731
     occ = pl.pallas_call(
         _bvh_anyhit_kernel_factory(n_nodes, LEAF_SIZE),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((3, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((3, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
             pl.BlockSpec(v0.shape, whole, memory_space=pltpu.VMEM),
             pl.BlockSpec(e1.shape, whole, memory_space=pltpu.VMEM),
             pl.BlockSpec(e2.shape, whole, memory_space=pltpu.VMEM),
@@ -875,7 +881,7 @@ def _bvh_anyhit(
             pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
         ],
         out_specs=pl.BlockSpec(
-            (1, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM
+            (1, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM
         ),
         out_shape=jax.ShapeDtypeStruct((1, padded_rays), jnp.float32),
         interpret=interpret,
@@ -888,5 +894,367 @@ def occluded_bvh_pallas(bvh, origins, directions, already):
     return _bvh_anyhit(
         origins, directions, already, bvh.v0, bvh.e1, bvh.e2,
         bvh.bounds_min, bvh.bounds_max, bvh.skip, bvh.first, bvh.count,
+        interpret=_interpret(),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Instanced BVH traversal: ALL instances in one kernel launch.
+#
+# The scan-over-instances alternative executes the single-instance kernel K
+# times per pass; here the grid is (ray_blocks, K) with k minormost, so the
+# output block for a ray block stays VMEM-resident while every instance
+# walks it (initialize at k == 0, min-accumulate after). Instance
+# transforms (9 rotation + 3 translation + 1 inv-scale scalars) live in
+# SMEM and are applied to the ray block in-kernel — no [K*R] ray
+# materialization in HBM, one launch per pass instead of K.
+
+
+def _bvh_instanced_kernel_factory(n_nodes: int, leaf_size: int, anyhit: bool):
+    def kernel(
+        o_ref, d_ref, inst_ref, v0_ref, e1_ref, e2_ref,
+        bmin_ref, bmax_ref, skip_ref, first_ref, count_ref,
+        *out_refs,
+    ):
+        k = pl.program_id(1)
+        # World -> object from SMEM scalars (x' = R^T (x - t) / s; the
+        # direction scales by 1/s too so t stays in world units).
+        r00, r01, r02 = inst_ref[k, 0], inst_ref[k, 1], inst_ref[k, 2]
+        r10, r11, r12 = inst_ref[k, 3], inst_ref[k, 4], inst_ref[k, 5]
+        r20, r21, r22 = inst_ref[k, 6], inst_ref[k, 7], inst_ref[k, 8]
+        tx, ty, tz = inst_ref[k, 9], inst_ref[k, 10], inst_ref[k, 11]
+        inv_s = inst_ref[k, 12]
+
+        wo = o_ref[:, :]
+        wd = d_ref[:, :]
+
+        # Top-level cull: slab-test the ray block against this instance's
+        # WORLD AABB with the untransformed rays; skip the whole walk when
+        # nothing in the block can touch the instance.
+        def winv(v):
+            small = jnp.abs(v) < 1e-12
+            return 1.0 / jnp.where(small, jnp.where(v < 0, -1e-12, 1e-12), v)
+
+        wox, woy, woz = wo[0:1, :], wo[1:2, :], wo[2:3, :]
+        wix, wiy, wiz = winv(wd[0:1, :]), winv(wd[1:2, :]), winv(wd[2:3, :])
+        wlox = (inst_ref[k, 13] - wox) * wix
+        whix = (inst_ref[k, 16] - wox) * wix
+        wloy = (inst_ref[k, 14] - woy) * wiy
+        whiy = (inst_ref[k, 17] - woy) * wiy
+        wloz = (inst_ref[k, 15] - woz) * wiz
+        whiz = (inst_ref[k, 18] - woz) * wiz
+        wnear = jnp.maximum(
+            jnp.maximum(jnp.minimum(wlox, whix), jnp.minimum(wloy, whiy)),
+            jnp.minimum(wloz, whiz),
+        )
+        wfar = jnp.minimum(
+            jnp.minimum(jnp.maximum(wlox, whix), jnp.maximum(wloy, whiy)),
+            jnp.maximum(wloz, whiz),
+        )
+        block_touches_instance = jnp.any(wfar >= jnp.maximum(wnear, 0.0))
+
+        sx = wo[0:1, :] - tx
+        sy = wo[1:2, :] - ty
+        sz = wo[2:3, :] - tz
+        # Column j of R^T is row j of R: o'_i = sum_j s_j * R[j][i].
+        ox = (sx * r00 + sy * r10 + sz * r20) * inv_s
+        oy = (sx * r01 + sy * r11 + sz * r21) * inv_s
+        oz = (sx * r02 + sy * r12 + sz * r22) * inv_s
+        wdx, wdy, wdz = wd[0:1, :], wd[1:2, :], wd[2:3, :]
+        dx = (wdx * r00 + wdy * r10 + wdz * r20) * inv_s
+        dy = (wdx * r01 + wdy * r11 + wdz * r21) * inv_s
+        dz = (wdx * r02 + wdy * r12 + wdz * r22) * inv_s
+
+        def inv_axis(v):
+            small = jnp.abs(v) < 1e-12
+            return 1.0 / jnp.where(small, jnp.where(v < 0, -1e-12, 1e-12), v)
+
+        invx, invy, invz = inv_axis(dx), inv_axis(dy), inv_axis(dz)
+        block = wo.shape[1]
+        lanes = jax.lax.broadcasted_iota(jnp.int32, (leaf_size, block), 0)
+
+        if anyhit:
+            occ_ref, = out_refs
+
+            @pl.when(k == 0)
+            def _():
+                # Already-occluded rays are folded in by the wrapper
+                # (replaced with guaranteed-miss rays), so the buffer
+                # starts all-clear (_bvh_anyhit_instanced).
+                occ_ref[:, :] = jnp.zeros((1, block), jnp.float32)
+        else:
+            t_ref, tri_ref, inst_out_ref = out_refs
+
+            @pl.when(k == 0)
+            def _():
+                t_ref[:, :] = jnp.full((1, block), INF, jnp.float32)
+                tri_ref[:, :] = jnp.zeros((1, block), jnp.int32)
+                inst_out_ref[:, :] = jnp.zeros((1, block), jnp.int32)
+
+        def cond(carry):
+            return carry[0] < n_nodes
+
+        def body(carry):
+            if anyhit:
+                node, occluded = carry
+                best_t = jnp.where(occluded > 0.0, -INF, INF)
+            else:
+                node, best_t, best_tri, best_inst = carry
+            lox = (bmin_ref[node, 0] - ox) * invx
+            hix = (bmax_ref[node, 0] - ox) * invx
+            loy = (bmin_ref[node, 1] - oy) * invy
+            hiy = (bmax_ref[node, 1] - oy) * invy
+            loz = (bmin_ref[node, 2] - oz) * invz
+            hiz = (bmax_ref[node, 2] - oz) * invz
+            tnear = jnp.maximum(
+                jnp.maximum(jnp.minimum(lox, hix), jnp.minimum(loy, hiy)),
+                jnp.minimum(loz, hiz),
+            )
+            tfar = jnp.minimum(
+                jnp.minimum(jnp.maximum(lox, hix), jnp.maximum(loy, hiy)),
+                jnp.maximum(loz, hiz),
+            )
+            packet_hit = (tfar >= jnp.maximum(tnear, 0.0)) & (tnear < best_t)
+            hit_any = jnp.any(packet_hit)
+
+            count = count_ref[node]
+            is_leaf = count > 0
+            start = first_ref[node]
+
+            v0b = v0_ref[pl.dslice(start, leaf_size), :]
+            e1b = e1_ref[pl.dslice(start, leaf_size), :]
+            e2b = e2_ref[pl.dslice(start, leaf_size), :]
+            v0x, v0y, v0z = v0b[:, 0:1], v0b[:, 1:2], v0b[:, 2:3]
+            e1x, e1y, e1z = e1b[:, 0:1], e1b[:, 1:2], e1b[:, 2:3]
+            e2x, e2y, e2z = e2b[:, 0:1], e2b[:, 1:2], e2b[:, 2:3]
+            pvx = dy * e2z - dz * e2y
+            pvy = dz * e2x - dx * e2z
+            pvz = dx * e2y - dy * e2x
+            det = e1x * pvx + e1y * pvy + e1z * pvz
+            inv_det = 1.0 / jnp.where(
+                jnp.abs(det) < BVH_DONE_EPS, BVH_DONE_EPS, det
+            )
+            tvx = ox - v0x
+            tvy = oy - v0y
+            tvz = oz - v0z
+            u = (tvx * pvx + tvy * pvy + tvz * pvz) * inv_det
+            qvx = tvy * e1z - tvz * e1y
+            qvy = tvz * e1x - tvx * e1z
+            qvz = tvx * e1y - tvy * e1x
+            v = (dx * qvx + dy * qvy + dz * qvz) * inv_det
+            tt = (e2x * qvx + e2y * qvy + e2z * qvz) * inv_det
+            tri_hit = (
+                (jnp.abs(det) > BVH_DONE_EPS)
+                & (u >= 0.0)
+                & (v >= 0.0)
+                & (u + v <= 1.0)
+                & (tt > EPS)
+                & (lanes < count)
+                & is_leaf
+                & hit_any
+            )
+            next_node = jnp.where(
+                hit_any,
+                jnp.where(is_leaf, skip_ref[node], node + 1),
+                skip_ref[node],
+            )
+            if anyhit:
+                occluded = jnp.maximum(
+                    occluded,
+                    jnp.max(
+                        jnp.where(tri_hit, 1.0, 0.0), axis=0, keepdims=True
+                    ),
+                )
+                return next_node, occluded
+            t_cand = jnp.where(tri_hit, tt, INF)
+            t_leaf = jnp.min(t_cand, axis=0, keepdims=True)
+            local = jnp.min(
+                jnp.where(t_cand == t_leaf, lanes, leaf_size),
+                axis=0,
+                keepdims=True,
+            )
+            closer = t_leaf < best_t
+            best_t = jnp.where(closer, t_leaf, best_t)
+            best_tri = jnp.where(
+                closer, start + jnp.minimum(local, leaf_size - 1), best_tri
+            )
+            best_inst = jnp.where(closer, k, best_inst)
+            return next_node, best_t, best_tri, best_inst
+
+        @pl.when(block_touches_instance)
+        def _walk():
+            if anyhit:
+                _, occluded = jax.lax.while_loop(
+                    cond, body, (jnp.int32(0), occ_ref[:, :])
+                )
+                occ_ref[:, :] = occluded
+            else:
+                _, best_t, best_tri, best_inst = jax.lax.while_loop(
+                    cond,
+                    body,
+                    (
+                        jnp.int32(0),
+                        t_ref[:, :],
+                        tri_ref[:, :],
+                        inst_out_ref[:, :],
+                    ),
+                )
+                t_ref[:, :] = best_t
+                tri_ref[:, :] = best_tri
+                inst_out_ref[:, :] = best_inst
+
+    return kernel
+
+
+def _instance_table(rotation, translation, scale, bounds_min, bounds_max):
+    """[K, 19] SMEM table: rotation row-major, translation, 1/scale, and
+    the instance's WORLD-space AABB (rows 13..18) — the top-level cull the
+    kernel applies before paying for the object-space walk.
+
+    World AABB of a transformed box: center_w = s R c_o + t,
+    half_w = s |R| h_o (elementwise absolute rotation).
+    """
+    k = rotation.shape[0]
+    center_obj = 0.5 * (bounds_min[0] + bounds_max[0])  # root node
+    half_obj = 0.5 * (bounds_max[0] - bounds_min[0])
+    center_w = (
+        scale[:, None] * jnp.einsum(
+            "kij,j->ki", rotation, center_obj, precision="highest"
+        )
+        + translation
+    )
+    half_w = scale[:, None] * jnp.einsum(
+        "kij,j->ki", jnp.abs(rotation), half_obj, precision="highest"
+    )
+    return jnp.concatenate(
+        [
+            rotation.reshape(k, 9),
+            translation,
+            (1.0 / scale)[:, None],
+            center_w - half_w,
+            center_w + half_w,
+        ],
+        axis=1,
+    )
+
+
+def _instanced_specs(inst_table, v0, e1, e2, bounds_min, bounds_max, n_nodes):
+    whole = lambda i, k: (0, 0)  # noqa: E731
+    flat = lambda i, k: (0,)  # noqa: E731
+    return [
+        pl.BlockSpec((3, BVH_BLOCK_R), lambda i, k: (0, i), memory_space=pltpu.VMEM),
+        pl.BlockSpec((3, BVH_BLOCK_R), lambda i, k: (0, i), memory_space=pltpu.VMEM),
+        pl.BlockSpec(inst_table.shape, whole, memory_space=pltpu.SMEM),
+        pl.BlockSpec(v0.shape, whole, memory_space=pltpu.VMEM),
+        pl.BlockSpec(e1.shape, whole, memory_space=pltpu.VMEM),
+        pl.BlockSpec(e2.shape, whole, memory_space=pltpu.VMEM),
+        pl.BlockSpec(bounds_min.shape, whole, memory_space=pltpu.SMEM),
+        pl.BlockSpec(bounds_max.shape, whole, memory_space=pltpu.SMEM),
+        pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
+        pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
+        pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
+    ]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _bvh_nearest_instanced(
+    origins, directions, rotation, translation, scale,
+    v0, e1, e2, bounds_min, bounds_max, skip, first, count,
+    *, interpret: bool,
+):
+    from tpu_render_cluster.render.mesh import LEAF_SIZE
+
+    o_t, d_t, rays, padded_rays = _pad_rays_to_miss(origins, directions)
+    inst_table = _instance_table(
+        rotation, translation, scale, bounds_min, bounds_max
+    )
+    n_nodes = skip.shape[0]
+    k_count = rotation.shape[0]
+    grid = (padded_rays // BVH_BLOCK_R, k_count)
+    out_block = pl.BlockSpec(
+        (1, BVH_BLOCK_R), lambda i, k: (0, i), memory_space=pltpu.VMEM
+    )
+    t, tri, inst = pl.pallas_call(
+        _bvh_instanced_kernel_factory(n_nodes, LEAF_SIZE, anyhit=False),
+        grid=grid,
+        in_specs=_instanced_specs(
+            inst_table, v0, e1, e2, bounds_min, bounds_max, n_nodes
+        ),
+        out_specs=[out_block, out_block, out_block],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, padded_rays), jnp.float32),
+            jax.ShapeDtypeStruct((1, padded_rays), jnp.int32),
+            jax.ShapeDtypeStruct((1, padded_rays), jnp.int32),
+        ],
+        interpret=interpret,
+    )(o_t, d_t, inst_table, v0, e1, e2, bounds_min, bounds_max, skip, first,
+      count)
+    return t[0, :rays], tri[0, :rays], inst[0, :rays]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _bvh_anyhit_instanced(
+    origins, directions, already, rotation, translation, scale,
+    v0, e1, e2, bounds_min, bounds_max, skip, first, count,
+    *, interpret: bool,
+):
+    from tpu_render_cluster.render.mesh import LEAF_SIZE
+
+    # Fold the `already` mask into the rays: an already-occluded ray is
+    # replaced by a guaranteed-miss ray (the kernel initializes occluded=0
+    # at k == 0, so a pre-set mask cannot ride the output buffer), and the
+    # mask is OR-ed back on afterwards.
+    masked_origins = jnp.where(already[:, None], 1e7, origins)
+    masked_directions = jnp.where(
+        already[:, None],
+        jnp.array([0.0, 1.0, 0.0], jnp.float32)[None, :],
+        directions,
+    )
+    o_t, d_t, rays, padded_rays = _pad_rays_to_miss(
+        masked_origins, masked_directions
+    )
+    inst_table = _instance_table(
+        rotation, translation, scale, bounds_min, bounds_max
+    )
+    n_nodes = skip.shape[0]
+    k_count = rotation.shape[0]
+    grid = (padded_rays // BVH_BLOCK_R, k_count)
+    occ = pl.pallas_call(
+        _bvh_instanced_kernel_factory(n_nodes, LEAF_SIZE, anyhit=True),
+        grid=grid,
+        in_specs=_instanced_specs(
+            inst_table, v0, e1, e2, bounds_min, bounds_max, n_nodes
+        ),
+        out_specs=pl.BlockSpec(
+            (1, BVH_BLOCK_R), lambda i, k: (0, i), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((1, padded_rays), jnp.float32),
+        interpret=interpret,
+    )(o_t, d_t, inst_table, v0, e1, e2, bounds_min, bounds_max, skip, first,
+      count)
+    return (occ[0, :rays] > 0.0) | already
+
+
+def intersect_instances_pallas(bvh, instances, origins, directions):
+    """All-instance nearest hit in ONE kernel launch.
+
+    Returns (t [R], triangle_index [R], instance_index [R]).
+    """
+    return _bvh_nearest_instanced(
+        origins, directions,
+        instances.rotation, instances.translation, instances.scale,
+        bvh.v0, bvh.e1, bvh.e2, bvh.bounds_min, bvh.bounds_max,
+        bvh.skip, bvh.first, bvh.count,
+        interpret=_interpret(),
+    )
+
+
+def occluded_instances_pallas(bvh, instances, origins, directions, already):
+    """All-instance any-hit in ONE kernel launch."""
+    return _bvh_anyhit_instanced(
+        origins, directions, already,
+        instances.rotation, instances.translation, instances.scale,
+        bvh.v0, bvh.e1, bvh.e2, bvh.bounds_min, bvh.bounds_max,
+        bvh.skip, bvh.first, bvh.count,
         interpret=_interpret(),
     )
