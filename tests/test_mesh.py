@@ -123,13 +123,14 @@ def test_instance_transform_preserves_t():
         )
 
 
-def test_mesh_scene_renders():
+@pytest.mark.parametrize(
+    "scene", ["02_physics-mesh", "03_physics-2-mesh"]
+)
+def test_mesh_scene_renders(scene):
     from tpu_render_cluster.render.integrator import render_frame
 
     image = np.asarray(
-        render_frame(
-            "02_physics-mesh", 30, width=64, height=64, samples=2, max_bounces=2
-        )
+        render_frame(scene, 30, width=64, height=64, samples=2, max_bounces=2)
     )
     assert image.shape == (64, 64, 3)
     assert image.std() > 0.05, "mesh scene must have non-trivial content"
@@ -140,6 +141,8 @@ def test_mesh_scene_job_name_mapping():
     from tpu_render_cluster.render.scene import scene_for_job_name
 
     assert scene_for_job_name("02_physics-mesh_240f") == "02_physics-mesh"
+    assert scene_for_job_name("03_physics-2-mesh_240f") == "03_physics-2-mesh"
+    assert scene_for_job_name("03-physics-2_measuring") == "03_physics-2"
     assert scene_for_job_name("02_physics_demo") == "02_physics"
     assert scene_for_job_name("04_very-simple_10f") == "04_very-simple"
 

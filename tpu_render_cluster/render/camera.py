@@ -39,7 +39,7 @@ def scene_camera(scene_name: str, frame) -> Camera:
             [9.0 * jnp.cos(angle), 4.5, 9.0 * jnp.sin(angle)]
         )
         return look_at_camera(origin, [0.0, 0.8, 0.0])
-    if scene_name in ("02_physics", "02_physics-mesh", "03_physics-2"):
+    if scene_name.startswith(("02_physics", "03_physics-2")):
         return look_at_camera([10.0, 6.0, 10.0], [0.0, 1.0, 0.0])
     # 04_very-simple: fixed three-quarter view of the grid.
     return look_at_camera([8.0, 6.5, 8.0], [0.0, 0.4, 0.0])

@@ -41,6 +41,7 @@ SCENE_NAMES = (
     "01_simple-animation",
     "02_physics-mesh",
     "02_physics",
+    "03_physics-2-mesh",
     "03_physics-2",
 )
 
@@ -181,22 +182,31 @@ def build_mesh_instances(name: str, frame):
     shared box BVH); only the rigid transforms depend on the frame, so the
     whole thing jits and vmaps over frames.
     """
-    if name != "02_physics-mesh":
+    if name not in ("02_physics-mesh", "03_physics-2-mesh"):
         return None
     from tpu_render_cluster.render.mesh import MeshInstances, rotation_y
 
     frame = jnp.asarray(frame, jnp.float32)
     t = frame / _FPS
-    k = 24
+    # 03's variant: more, smaller icosphere instances (chaotic spread) —
+    # the deeper 127-node BVH makes traversal depth matter.
+    k = 48 if name == "03_physics-2-mesh" else 24
     index = jnp.arange(k, dtype=jnp.float32)
     u1 = jnp.mod(index * 0.7548776662, 1.0)
     u2 = jnp.mod(index * 0.5698402909, 1.0)
     u3 = jnp.mod(index * 0.3819660113, 1.0)
-    size = 0.6 + 0.5 * u3
-    x = (u1 - 0.5) * 7.0
-    z = (u2 - 0.5) * 7.0
-    h0 = 2.5 + 4.0 * u3
-    tau = jnp.maximum(t - u1 * 1.5, 0.0)
+    if name == "03_physics-2-mesh":
+        size = 0.45 + 0.35 * u3
+        x = (u1 - 0.5) * 9.0 + 0.5 * jnp.sin(12.0 * u2)
+        z = (u2 - 0.5) * 9.0 + 0.5 * jnp.cos(12.0 * u1)
+        h0 = 2.0 + 5.0 * u3
+        tau = jnp.maximum(t - u1 * 2.0, 0.0)
+    else:
+        size = 0.6 + 0.5 * u3
+        x = (u1 - 0.5) * 7.0
+        z = (u2 - 0.5) * 7.0
+        h0 = 2.5 + 4.0 * u3
+        tau = jnp.maximum(t - u1 * 1.5, 0.0)
     y = _ballistic_height(tau, h0) + size * 0.5
     rotation = rotation_y(tau * (0.6 + 2.0 * u2) + u1 * 6.28)
     translation = jnp.stack([x, y, z], axis=-1)
@@ -208,7 +218,11 @@ def build_mesh_instances(name: str, frame):
 
 def mesh_kind_for_scene(name: str) -> str | None:
     """Which cached object-space BVH a mesh scene uses (None = no mesh)."""
-    return "box" if name == "02_physics-mesh" else None
+    if name == "02_physics-mesh":
+        return "box"
+    if name == "03_physics-2-mesh":
+        return "icosphere"
+    return None
 
 
 def build_scene(name: str, frame) -> Scene:
@@ -227,6 +241,8 @@ def build_scene(name: str, frame) -> Scene:
         spheres = _physics(frame, 12, 16, chaos=0.0)
     elif name == "03_physics-2":
         spheres = _physics(frame, 96, 128, chaos=1.0)
+    elif name == "03_physics-2-mesh":
+        spheres = _physics(frame, 16, 16, chaos=1.0)
     else:
         raise ValueError(f"Unknown scene: {name!r} (have {SCENE_NAMES})")
     centers, radii, albedo, emission = spheres
