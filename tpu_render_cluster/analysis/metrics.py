@@ -218,8 +218,9 @@ def phase_split_stats(traces: list[JobTrace]) -> dict:
 
 
 def run_statistics(traces: list[JobTrace]) -> dict:
-    """Run + reconnect counts per (size, strategy)
-    (reference: results_statistics.py:34-73)."""
+    """Run + reconnect counts per (size, strategy), plus the analyzing
+    process's peak RSS (reference: results_statistics.py:34-73; its
+    optional pympler memory profiling maps to the RSS figure here)."""
     grouped: dict[tuple[int, str], dict] = defaultdict(
         lambda: {"runs": 0, "reconnects": 0, "frames": 0}
     )
@@ -232,4 +233,13 @@ def run_statistics(traces: list[JobTrace]) -> dict:
         entry["frames"] += sum(
             len(w.frame_render_traces) for w in trace.worker_traces.values()
         )
-    return dict(grouped)
+    out: dict = dict(grouped)
+    try:
+        import resource
+
+        out["analysis_peak_rss_mb"] = round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1
+        )
+    except Exception:  # noqa: BLE001 - platform-dependent, best effort
+        pass
+    return out
