@@ -391,3 +391,67 @@ target_queue_size = 3
     trace_path = next(results.glob("*_raw-trace.json"))
     data = json.loads(trace_path.read_text())
     assert len(data["worker_traces"]) == 1
+
+
+def test_dead_worker_is_evicted_and_frames_requeue(monkeypatch):
+    # §5.3 failure recovery on the Python master (the C++ daemon has the
+    # equivalent test in test_cpp_master.py): a worker killed mid-job is
+    # marked dead by the sped-up heartbeat monitor, its queued frames
+    # return to the pending pool, and the survivor finishes the job.
+    from tpu_render_cluster.master import worker_handle as wh
+    from tpu_render_cluster.transport.reconnect import (
+        ReconnectableServerConnection,
+    )
+
+    monkeypatch.setattr(wh, "HEARTBEAT_INTERVAL_SECONDS", 0.15)
+    monkeypatch.setattr(wh, "HEARTBEAT_RESPONSE_TIMEOUT", 0.5)
+    # The master normally waits 30 s for a dead peer to reconnect before
+    # sends fail; shrink so heartbeat failure surfaces quickly.
+    monkeypatch.setattr(
+        ReconnectableServerConnection, "MAX_WAIT_FOR_RECONNECT", 0.6
+    )
+
+    frames = 12
+    job = make_job(
+        DistributionStrategy.dynamic_strategy(DynamicStrategyOptions(3, 1, 1, 2)),
+        frames,
+        2,
+    )
+    survivor = MockBackend(render_seconds_fn=lambda f: 0.10)
+    casualty = MockBackend(render_seconds_fn=lambda f: 0.10)
+
+    async def run() -> tuple:
+        from tpu_render_cluster.master.cluster import ClusterManager
+        from tpu_render_cluster.worker.runtime import Worker
+
+        manager = ClusterManager("127.0.0.1", 0, job)
+        server_task = asyncio.create_task(manager.initialize_server_and_run_job())
+        while manager._server is None:
+            await asyncio.sleep(0.01)
+        workers = [
+            Worker("127.0.0.1", manager.port, survivor),
+            Worker("127.0.0.1", manager.port, casualty),
+        ]
+        tasks = [
+            asyncio.create_task(w.connect_and_run_to_job_completion())
+            for w in workers
+        ]
+        # Let the job start (the worker barrier polls at 1 s) and queues
+        # fill, then kill worker 2 outright: cancel its tasks and sever
+        # its socket (no clean goodbye).
+        await asyncio.sleep(1.6)
+        tasks[1].cancel()
+        client = workers[1]._client
+        if client is not None:
+            await client._connection.close()
+        master_trace, worker_traces = await asyncio.wait_for(server_task, 60)
+        await asyncio.gather(tasks[0])
+        return master_trace, worker_traces
+
+    asyncio.run(run())
+    rendered = sorted(
+        set(survivor.rendered_frames) | set(casualty.rendered_frames)
+    )
+    assert rendered == list(range(1, frames + 1))
+    # The casualty died mid-job, so the survivor must have picked up work.
+    assert len(survivor.rendered_frames) > frames / 2
