@@ -368,21 +368,21 @@ def run_northstar_multiprocess(
                     master.kill()
 
     # 1-worker CPU baseline with the identical process topology.
-    for repeat in range(0 if only == "mesh" else max(2, repeats - 1)):
+    for repeat in range(max(2, repeats - 1) if only is None else 0):
         run_cluster(
             NORTHSTAR_FRAMES, 1, "eager-naive-coarse",
             results_root / "northstar-mp-10f/eager-naive-coarse_1w_cpu-baseline",
             worker_platform="cpu",
         )
         print(f"[northstar-mp cpu] r{repeat + 1} done", flush=True)
-    for repeat in range(0 if only == "mesh" else repeats):
+    for repeat in range(repeats if only is None else 0):
         run_cluster(
             NORTHSTAR_FRAMES, 4, "tpu-batch",
             results_root / "northstar-mp-10f/tpu-batch_4w_tpu-raytrace",
             worker_platform="tpu",
         )
         print(f"[northstar-mp tpu 10f] r{repeat + 1} done", flush=True)
-    for repeat in range(0 if only == "mesh" else 2):
+    for repeat in range(2 if only is None else 0):
         run_cluster(
             64, 4, "tpu-batch",
             results_root / "northstar-mp-64f/tpu-batch_4w_tpu-raytrace",
@@ -391,7 +391,7 @@ def run_northstar_multiprocess(
         print(f"[northstar-mp tpu 64f] r{repeat + 1} done", flush=True)
     # Mesh scene through the full distributed stack: tumbling-box frames
     # rendered by tpu-raytrace workers via the Pallas BVH traversal.
-    for repeat in range(2):
+    for repeat in range(2 if only in (None, "mesh") else 0):
         run_cluster(
             24, 4, "tpu-batch",
             results_root / "mesh-mp-24f/tpu-batch_4w_tpu-raytrace",
@@ -399,6 +399,18 @@ def run_northstar_multiprocess(
             job_name="02_physics-mesh",
         )
         print(f"[mesh-mp tpu 24f] r{repeat + 1} done", flush=True)
+    if only == "mesh":
+        return
+    # Remaining scene families on the chip (animation orbit + sphere rain):
+    # breadth evidence that every scene family runs through the cluster.
+    for scene in ("01_simple-animation", "03_physics-2"):
+        run_cluster(
+            24, 4, "tpu-batch",
+            results_root / f"scenes-mp-24f/{scene}_tpu-batch_4w",
+            worker_platform="tpu",
+            job_name=scene,
+        )
+        print(f"[scenes-mp tpu] {scene} done", flush=True)
 
 
 def run_all(results_root: Path, repeats: int) -> int:
@@ -475,7 +487,7 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--suite",
-        choices=["mock", "northstar-baseline", "northstar-tpu", "northstar-mp", "mesh-mp", "all"],
+        choices=["mock", "northstar-baseline", "northstar-tpu", "northstar-mp", "mesh-mp", "scenes-mp", "all"],
         default="all",
     )
     parser.add_argument("--results", default=None)
@@ -496,6 +508,9 @@ def main() -> int:
         return 0
     if args.suite == "mesh-mp":
         run_northstar_multiprocess(results_root, args.repeats, only="mesh")
+        return 0
+    if args.suite == "scenes-mp":
+        run_northstar_multiprocess(results_root, args.repeats, only="scenes")
         return 0
     if args.suite == "northstar-baseline":
         run_northstar(results_root, max(2, args.repeats - 1), tpu=False)
