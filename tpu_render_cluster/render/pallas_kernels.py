@@ -46,8 +46,9 @@ BLOCK_R = 4096
 # The BVH kernels use their own ray-block size: packet culling (the
 # block-wide any() on AABB tests and the instance-level world-AABB skip)
 # only bites when a block is spatially tight. Swept on the real chip
-# (bench-mesh): 512 -> 8.3 f/s, 1024 -> 9.0, 2048 -> 9.25, 4096 -> 9.1,
-# 8192 -> 8.6.
+# (bench-mesh, instanced nearest-hit + any-hit wired): 1024 -> 16.1 f/s,
+# 2048 -> 16.9, 4096 -> 16.7, 8192 -> 15.0. (Pre-instanced-nearest-hit the
+# same sweep peaked at 9.25.)
 BVH_BLOCK_R = 2048
 _SUBLANE = 8  # f32 sublane tile; sphere count is padded to a multiple
 
