@@ -54,6 +54,10 @@
 // integers, floats, booleans, and `#` comments — the complete grammar used
 // by the blender-projects/*.toml job matrix.
 
+// SIGUSR1 requests a frame-table + queue-mirror dump to the log (served on
+// the heartbeat thread; the handler itself only flips the flag).
+static std::atomic<bool> g_dump_state{false};
+
 static std::string trim(const std::string& s) {
     size_t a = s.find_first_not_of(" \t\r\n");
     if (a == std::string::npos) return "";
@@ -674,6 +678,8 @@ class MasterDaemon {
     std::atomic<bool> cancelled_{false};
     std::atomic<bool> job_started_{false};
     double job_start_time_ = 0;
+    double last_starved_log_ = 0;  // rate-limits the tpu-batch starvation WARN
+    double starved_since_ = 0;  // first fully-gated tick of the current streak
     double job_finish_time_ = 0;
 
     std::mutex state_mutex_;  // guards frames_ + every worker's queue mirror
@@ -1389,6 +1395,7 @@ class MasterDaemon {
         }
         double check_every = std::min(2.0, interval);
         while (!cancelled_.load()) {
+            maybe_dump_state();
             double now = now_ts();
             for (WorkerConn* worker : live_workers()) {
                 if (now - worker->last_heartbeat_sent >= interval) {
@@ -1415,6 +1422,48 @@ class MasterDaemon {
             }
             std::this_thread::sleep_for(
                 std::chrono::milliseconds(int64_t(check_every * 1000)));
+        }
+    }
+
+    // SIGUSR1 diagnostic: dump every non-finished frame slot plus the
+    // queue mirrors to the log. The handler only sets a flag; the dump
+    // runs here on the heartbeat thread (which stays alive even when a
+    // scheduler tick is parked inside an RPC wait).
+    void maybe_dump_state() {
+        if (!g_dump_state.exchange(false)) return;
+        // workers_ never erases entries (eviction only flags), so the
+        // pointers stay valid after workers_mutex_ is released; the queue
+        // mirrors themselves are guarded by state_mutex_.
+        std::vector<WorkerConn*> workers;
+        {
+            std::lock_guard<std::mutex> lock(workers_mutex_);
+            for (auto& pair : workers_) workers.push_back(pair.second.get());
+        }
+        std::lock_guard<std::mutex> lock(state_mutex_);
+        int counts[4] = {0, 0, 0, 0};
+        for (const FrameSlot& slot : frames_) counts[int(slot.status)]++;
+        LOG_INFO("STATE: pending=%d queued=%d rendering=%d finished=%d "
+                 "hint=%zu",
+                 counts[0], counts[1], counts[2], counts[3],
+                 next_pending_hint_);
+        int listed = 0;
+        for (const FrameSlot& slot : frames_) {
+            if (slot.status == FrameStatus::Finished) continue;
+            if (listed++ >= 128) break;
+            LOG_INFO("STATE: frame %d status=%d worker=%08x",
+                     slot.frame_index, int(slot.status), slot.worker);
+        }
+        for (WorkerConn* worker : workers) {
+            std::string queue_repr;
+            for (const FrameOnWorker& entry : worker->queue) {
+                char buf[64];
+                snprintf(buf, sizeof(buf), " %d%s", entry.frame_index,
+                         entry.rendering ? "*" : "");
+                queue_repr += buf;
+            }
+            LOG_INFO("STATE: worker %08x evicted=%d connected=%d queue=[%s ]",
+                     worker->id, int(worker->evicted.load()),
+                     int(worker->connected.load()), queue_repr.c_str());
         }
     }
 
@@ -1663,8 +1712,18 @@ class MasterDaemon {
             // workers that drain faster than the lookahead window.
             // Cold-start workers get a conservative target until their
             // speed is known.
+            // Slots are interleaved breadth-first by position (every
+            // worker's front slot before any second slot): the
+            // kMaxSlotsPerTick truncation must never hide an idle
+            // worker's front slot behind another worker's deep queue
+            // positions — at the job tail that starved the scheduler
+            // (all surviving slots were deep, the makespan gate rejected
+            // every one, and the job hung with frames pending).
             std::vector<std::pair<WorkerConn*, int>> slots;
-            for (WorkerConn* worker : workers) {
+            std::vector<int> deficits(workers.size());
+            int max_deficit = 0;
+            for (size_t w = 0; w < workers.size(); w++) {
+                WorkerConn* worker = workers[w];
                 int target;
                 if (cost_model.has_history(worker->id)) {
                     double frame_seconds =
@@ -1678,9 +1737,14 @@ class MasterDaemon {
                 } else {
                     target = std::min(2, job_.target_queue_size);
                 }
-                int deficit = target - int(queue_size(worker));
-                for (int position = 0; position < deficit; position++) {
-                    slots.emplace_back(worker, position);
+                deficits[w] = target - int(queue_size(worker));
+                max_deficit = std::max(max_deficit, deficits[w]);
+            }
+            for (int position = 0; position < max_deficit; position++) {
+                for (size_t w = 0; w < workers.size(); w++) {
+                    if (position < deficits[w]) {
+                        slots.emplace_back(workers[w], position);
+                    }
                 }
             }
             if (slots.size() > kMaxSlotsPerTick) slots.resize(kMaxSlotsPerTick);
@@ -1712,8 +1776,9 @@ class MasterDaemon {
                     }
 
                     std::vector<int> result;
-                    if (!assignment_.solve(cost, &result) ||
-                        result.size() != frames.size()) {
+                    bool solver_ok = assignment_.solve(cost, &result) &&
+                                     result.size() == frames.size();
+                    if (!solver_ok) {
                         result = greedy_assignment(cost);
                     }
 
@@ -1750,9 +1815,12 @@ class MasterDaemon {
                         }
                     }
 
+                    int unassigned = 0, gated = 0, queued = 0, failed = 0;
                     for (size_t i = 0; i < frames.size(); i++) {
-                        if (result[i] < 0 || result[i] >= int(slots.size()))
+                        if (result[i] < 0 || result[i] >= int(slots.size())) {
+                            unassigned++;
                             continue;
+                        }
                         WorkerConn* worker = slots[size_t(result[i])].first;
                         double others_rate =
                             cluster_rate -
@@ -1766,9 +1834,75 @@ class MasterDaemon {
                                 : std::numeric_limits<double>::infinity();
                         double horizon =
                             rest_seconds + fastest_speed * complexity[i];
-                        if (double(cost[i][size_t(result[i])]) > horizon)
+                        if (double(cost[i][size_t(result[i])]) > horizon) {
+                            gated++;
                             continue;  // leave pending for a better slot
-                        queue_frame(*worker, frames[i]);
+                        }
+                        if (queue_frame(*worker, frames[i])) {
+                            queued++;
+                        } else {
+                            failed++;
+                        }
+                    }
+                    // Forced progress: the gate's invariant is that the
+                    // fastest worker's front slot always passes, but the
+                    // auction is free to return an epsilon-suboptimal
+                    // matching that never proposes that pair — gating
+                    // every assignment, forever (observed at the tail of
+                    // a 14400f x 40w run). If a whole tick was gated
+                    // away, queue the cheapest frame on the GLOBALLY
+                    // fastest worker (the one the gate's invariant is
+                    // about — this cannot lengthen the makespan). When
+                    // that worker's queue is full the gate may be right
+                    // to wait for it to drain, so a slower worker is only
+                    // settled for after the starvation has persisted —
+                    // a transient gate rejection stays respected.
+                    if (queued == 0 && failed == 0 && !frames.empty()) {
+                        double now = now_ts();
+                        if (starved_since_ == 0) starved_since_ = now;
+                        WorkerConn* fastest_eligible = nullptr;
+                        WorkerConn* fastest_overall = nullptr;
+                        for (WorkerConn* worker : workers) {
+                            if (fastest_overall == nullptr ||
+                                speeds[worker->id] <
+                                    speeds[fastest_overall->id])
+                                fastest_overall = worker;
+                            if (int(queue_size(worker)) >=
+                                std::max(1, job_.target_queue_size))
+                                continue;
+                            if (fastest_eligible == nullptr ||
+                                speeds[worker->id] <
+                                    speeds[fastest_eligible->id])
+                                fastest_eligible = worker;
+                        }
+                        bool engage =
+                            fastest_eligible != nullptr &&
+                            (fastest_eligible == fastest_overall ||
+                             now - starved_since_ > 1.0);
+                        size_t best = 0;
+                        for (size_t i = 1; i < frames.size(); i++) {
+                            if (complexity[i] < complexity[best]) best = i;
+                        }
+                        if (engage &&
+                            queue_frame(*fastest_eligible, frames[best])) {
+                            queued++;
+                        }
+                    }
+                    if (queued > 0) starved_since_ = 0;
+                    // Starvation diagnostic: a tick that assigns nothing
+                    // while frames sit pending is the signature of a
+                    // scheduler bug — say why, rate-limited.
+                    if (queued == 0) {
+                        double now = now_ts();
+                        if (now - last_starved_log_ >= 5.0) {
+                            last_starved_log_ = now;
+                            LOG_WARN(
+                                "tpu-batch tick queued nothing: frames=%zu "
+                                "slots=%zu solver_ok=%d unassigned=%d "
+                                "gated=%d rpc_failed=%d",
+                                frames.size(), slots.size(), int(solver_ok),
+                                unassigned, gated, failed);
+                        }
                     }
                     std::this_thread::sleep_for(
                         std::chrono::milliseconds(100));
@@ -2042,6 +2176,7 @@ int main(int argc, char** argv) {
     // A dead assignment-service pipe must surface as write()==-1 (EPIPE) so
     // the greedy fallback engages, not as a process-killing SIGPIPE.
     signal(SIGPIPE, SIG_IGN);
+    signal(SIGUSR1, [](int) { g_dump_state.store(true); });
     if (!options.log_file_path.empty()) {
         g_log_file = fopen(options.log_file_path.c_str(), "a");
     }

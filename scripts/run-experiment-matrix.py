@@ -401,9 +401,16 @@ def run_northstar_multiprocess(
         print(f"[mesh-mp tpu 24f] r{repeat + 1} done", flush=True)
     if only == "mesh":
         return
-    # Remaining scene families on the chip (animation orbit + sphere rain):
-    # breadth evidence that every scene family runs through the cluster.
-    for scene in ("01_simple-animation", "03_physics-2"):
+    # Remaining scene families on the chip (animation orbit, tower scatter,
+    # sphere rain, chaotic icosphere instances): breadth evidence that every
+    # scene family — sphere-procedural and triangle-mesh alike — runs
+    # through the cluster.
+    for scene in (
+        "01_simple-animation",
+        "02_physics",
+        "03_physics-2",
+        "03_physics-2-mesh",
+    ):
         run_cluster(
             24, 4, "tpu-batch",
             results_root / f"scenes-mp-24f/{scene}_tpu-batch_4w",

@@ -165,6 +165,36 @@ def test_native_cluster_completes(tmp_path, strategy_lines):
     assert next(results.glob("*_processed-results.json")).is_file()
 
 
+def test_tpu_batch_tail_does_not_starve_at_scale(tmp_path):
+    # Regression for a tail-starvation hang found by the 14400f x 40w
+    # scale demo (scripts/run-scale-demo.py): with many workers the
+    # per-tick slot cap truncated away idle workers' front slots and the
+    # makespan gate then rejected every epsilon-suboptimal auction
+    # assignment, every tick — the job sat forever with frames pending.
+    # Breadth-first slot interleaving + the forced-progress fallback fix
+    # it; this runs the same shape at CI scale and must simply complete.
+    master = build_master_daemon()
+    worker = build_worker_daemon()
+    assert master is not None and worker is not None
+    port = _free_port()
+    frames, n_workers = 2400, 24
+    job_path = _write_job(
+        tmp_path, name="tail-scale", frames=frames, workers=n_workers,
+        strategy_lines=TPU_BATCH,
+    )
+    results = tmp_path / "results"
+    master_proc = _spawn_master(master, port, job_path, results)
+    time.sleep(0.8)
+    workers = [
+        _spawn_cpp_worker(worker, port, mock_ms=5) for _ in range(n_workers)
+    ]
+    assert _wait(master_proc, 120) == 0
+    for proc in workers:
+        _wait(proc, 30)
+    rendered = list((tmp_path / "frames").glob("rendered-*.png"))
+    assert len(rendered) == frames
+
+
 def test_cpp_master_with_python_workers(tmp_path):
     master = build_master_daemon()
     assert master is not None

@@ -216,6 +216,7 @@ async def tpu_batch_strategy(
     cost_model = JointCostModel(options.cost_ema_alpha)
     dynamic_options = _as_dynamic_options(options)
     observed_frames: set[tuple[int, int]] = set()
+    starved_since: float | None = None  # first fully-gated tick of a streak
 
     while not cancellation.is_cancelled():
         if state.all_frames_finished():
@@ -241,7 +242,13 @@ async def tpu_batch_strategy(
         batch_mean_complexity = (
             float(np.mean(list(complexity_memo.values()))) if upcoming else 1.0
         )
-        slots: list[tuple["WorkerHandle", int]] = []
+        # Slots are interleaved breadth-first by position (every worker's
+        # front slot before any second slot): the slot-cap truncation below
+        # must never hide an idle worker's front slot behind another
+        # worker's deep queue positions — at the job tail that starves the
+        # scheduler (only deep slots survive, the makespan gate rejects
+        # every assignment, and the job hangs with frames pending).
+        deficits: list[tuple["WorkerHandle", int]] = []
         for worker in workers:
             if cost_model.worker_speed.has_history(worker.worker_id):
                 frame_seconds = max(
@@ -268,9 +275,13 @@ async def tpu_batch_strategy(
                 # a worker of unknown speed parks frames on what may be the
                 # slowest node, and short jobs never recover via stealing.
                 target = min(2, options.target_queue_size)
-            deficit = target - len(worker.queue)
-            for position in range(max(0, deficit)):
-                slots.append((worker, position))
+            deficits.append((worker, max(0, target - len(worker.queue))))
+        slots: list[tuple["WorkerHandle", int]] = []
+        max_deficit = max((d for _, d in deficits), default=0)
+        for position in range(max_deficit):
+            for worker, deficit in deficits:
+                if position < deficit:
+                    slots.append((worker, position))
         # Stay within pre-compiled auction buckets (late-joining workers can
         # push the slot count past what the barrier-time warmup covered);
         # excess workers are topped up on later ticks.
@@ -366,6 +377,45 @@ async def tpu_batch_strategy(
                         continue  # leave pending; a better slot will open
                     state.mark_frame_as_queued(frame_index, worker.worker_id, time.time())
                     tasks.append(assign(frame_index, worker))
+                if not tasks and frames:
+                    # Forced progress: the gate's invariant is that the
+                    # fastest worker's front slot always passes, but the
+                    # auction may return an epsilon-suboptimal matching
+                    # that never proposes that pair — gating the whole
+                    # tick, every tick (observed in the C++ master at the
+                    # tail of a 14400f x 40w run). Queue the cheapest
+                    # frame on the GLOBALLY fastest worker (the one the
+                    # invariant is about — cannot lengthen the makespan).
+                    # When that worker's queue is full the gate may be
+                    # right to wait for it to drain, so a slower worker
+                    # is only settled for after the starvation persists —
+                    # transient gate rejections stay respected.
+                    if starved_since is None:
+                        starved_since = time.time()
+                    eligible = [
+                        w for w in workers
+                        if len(w.queue) < max(1, options.target_queue_size)
+                    ]
+                    if eligible:
+                        fastest = min(
+                            eligible, key=lambda w: speeds[w.worker_id]
+                        )
+                        fastest_overall = min(
+                            workers, key=lambda w: speeds[w.worker_id]
+                        )
+                        if (
+                            fastest is fastest_overall
+                            or time.time() - starved_since > 1.0
+                        ):
+                            frame_index = min(
+                                frames, key=lambda f: complexity[f]
+                            )
+                            state.mark_frame_as_queued(
+                                frame_index, fastest.worker_id, time.time()
+                            )
+                            tasks.append(assign(frame_index, fastest))
+                if tasks:
+                    starved_since = None
                 await asyncio.gather(*tasks)
                 await asyncio.sleep(TPU_BATCH_TICK)
                 continue
