@@ -375,20 +375,24 @@ def run_northstar_multiprocess(
             worker_platform="cpu",
         )
         print(f"[northstar-mp cpu] r{repeat + 1} done", flush=True)
-    for repeat in range(repeats if only is None else 0):
+    for repeat in range(
+        repeats if only in (None, "northstar-mp-tpu") else 0
+    ):
         run_cluster(
             NORTHSTAR_FRAMES, 4, "tpu-batch",
             results_root / "northstar-mp-10f/tpu-batch_4w_tpu-raytrace",
             worker_platform="tpu",
         )
         print(f"[northstar-mp tpu 10f] r{repeat + 1} done", flush=True)
-    for repeat in range(2 if only is None else 0):
+    for repeat in range(2 if only in (None, "northstar-mp-tpu") else 0):
         run_cluster(
             64, 4, "tpu-batch",
             results_root / "northstar-mp-64f/tpu-batch_4w_tpu-raytrace",
             worker_platform="tpu",
         )
         print(f"[northstar-mp tpu 64f] r{repeat + 1} done", flush=True)
+    if only == "northstar-mp-tpu":
+        return
     # Mesh scene through the full distributed stack: tumbling-box frames
     # rendered by tpu-raytrace workers via the Pallas BVH traversal.
     for repeat in range(2 if only in (None, "mesh") else 0):
@@ -399,7 +403,9 @@ def run_northstar_multiprocess(
             job_name="02_physics-mesh",
         )
         print(f"[mesh-mp tpu 24f] r{repeat + 1} done", flush=True)
-    if only == "mesh":
+    if only is not None and only != "scenes":
+        # Explicit allowlist: a future `only` value must opt in to each
+        # block, never fall through into extra TPU suites.
         return
     # Remaining scene families on the chip (animation orbit, tower scatter,
     # sphere rain, chaotic icosphere instances): breadth evidence that every
@@ -494,7 +500,7 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--suite",
-        choices=["mock", "northstar-baseline", "northstar-tpu", "northstar-mp", "mesh-mp", "scenes-mp", "all"],
+        choices=["mock", "northstar-baseline", "northstar-tpu", "northstar-mp", "northstar-mp-tpu", "mesh-mp", "scenes-mp", "all"],
         default="all",
     )
     parser.add_argument("--results", default=None)
@@ -512,6 +518,13 @@ def main() -> int:
         return 0
     if args.suite == "northstar-mp":
         run_northstar_multiprocess(results_root, args.repeats)
+        return 0
+    if args.suite == "northstar-mp-tpu":
+        # TPU-side northstar runs only (the 1-worker CPU baseline is
+        # scheduler-independent and stays recorded).
+        run_northstar_multiprocess(
+            results_root, args.repeats, only="northstar-mp-tpu"
+        )
         return 0
     if args.suite == "mesh-mp":
         run_northstar_multiprocess(results_root, args.repeats, only="mesh")
