@@ -1888,7 +1888,13 @@ class MasterDaemon {
                             queued++;
                         }
                     }
-                    if (queued > 0) starved_since_ = 0;
+                    // The streak is CONSECUTIVE fully-gated ticks only:
+                    // any tick that queued work, failed an RPC, or (in
+                    // the branches below) had nothing to assign resets
+                    // it — a stale timestamp from an earlier streak must
+                    // not let the fallback fire instantly and park a
+                    // tail frame on a slow worker.
+                    if (queued > 0 || failed > 0) starved_since_ = 0;
                     // Starvation diagnostic: a tick that assigns nothing
                     // while frames sit pending is the signature of a
                     // scheduler bug — say why, rate-limited.
@@ -1908,6 +1914,7 @@ class MasterDaemon {
                         std::chrono::milliseconds(100));
                     continue;
                 }
+                starved_since_ = 0;  // nothing pending: not a gated streak
                 // Pending dry -> dynamic-style stealing.
                 std::sort(workers.begin(), workers.end(),
                           [this](WorkerConn* a, WorkerConn* b) {
@@ -1923,6 +1930,9 @@ class MasterDaemon {
                         break;
                     steal_frame(thief, victim, frame_index);
                 }
+            }
+            if (slots.empty()) {
+                starved_since_ = 0;  // no slots this tick: not a gated streak
             }
             std::this_thread::sleep_for(std::chrono::milliseconds(100));
         }

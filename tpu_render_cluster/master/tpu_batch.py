@@ -415,11 +415,17 @@ async def tpu_batch_strategy(
                             )
                             tasks.append(assign(frame_index, fastest))
                 if tasks:
+                    # The streak is CONSECUTIVE fully-gated ticks only; any
+                    # tick that queues work (and, below, any tick with
+                    # nothing to assign) resets it — a stale timestamp from
+                    # an earlier streak must not let the fallback fire
+                    # instantly and park a tail frame on a slow worker.
                     starved_since = None
                 await asyncio.gather(*tasks)
                 await asyncio.sleep(TPU_BATCH_TICK)
                 continue
 
+            starved_since = None
             # Pending pool dry -> steal like the dynamic strategy.
             workers_sorted = sorted(workers, key=lambda w: len(w.queue))
             for thief in workers_sorted:
@@ -433,4 +439,6 @@ async def tpu_batch_strategy(
                 victim, frame = found
                 await steal_frame(job, state, thief, victim, frame.frame_index)
 
+        if not slots:
+            starved_since = None  # no slots this tick: not a gated streak
         await asyncio.sleep(TPU_BATCH_TICK)

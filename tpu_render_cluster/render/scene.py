@@ -216,6 +216,20 @@ def build_mesh_instances(name: str, frame):
     )
 
 
+def obj_stage_scene(frame) -> Scene:
+    """Minimal stage for user OBJ meshes (``render.cli --obj``): two accent
+    spheres beside the turntable, default plane/sun/sky."""
+    del frame  # static stage; the OBJ instance itself rotates per frame
+    centers = jnp.array(
+        [[2.6, 0.45, -1.4], [-2.4, 0.35, 1.6]], jnp.float32
+    )
+    radii = jnp.array([0.45, 0.35], jnp.float32)
+    albedo = jnp.array([[0.8, 0.35, 0.3], [0.3, 0.45, 0.8]], jnp.float32)
+    emission = jnp.zeros((2, 3), jnp.float32)
+    padded = _pad_spheres(centers, radii, albedo, emission, 8)
+    return Scene(*padded, **_default_lighting())
+
+
 def mesh_kind_for_scene(name: str) -> str | None:
     """Which cached object-space BVH a mesh scene uses (None = no mesh)."""
     if name == "02_physics-mesh":
