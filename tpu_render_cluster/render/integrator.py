@@ -138,14 +138,26 @@ def trace_paths(
     """
     from tpu_render_cluster.render import pallas_kernels
 
-    if pallas_kernels.pallas_enabled() and mesh is None:
-        # The fused megakernel covers sphere+plane scenes; mesh scenes run
-        # the XLA bounce scan whose intersections still dispatch to the
-        # Pallas sphere kernels and the Pallas BVH traversal per bounce.
+    if pallas_kernels.pallas_enabled():
         seed = jax.random.key_data(key).ravel()[-1].astype(jnp.int32)
-        return pallas_kernels.trace_paths_fused(
-            scene, origins, directions, seed, max_bounces=max_bounces
-        )
+        if mesh is None:
+            return pallas_kernels.trace_paths_fused(
+                scene, origins, directions, seed, max_bounces=max_bounces
+            )
+        # Mesh scenes: the megakernel (whole bounce loop incl. the
+        # instanced BVH walk in one kernel) wins when the per-bounce walk
+        # is shallow — its in-walk normal/albedo tracking adds work to
+        # EVERY leaf visit, so deep-tree x many-instance scenes come out
+        # behind the per-bounce instanced kernels (measured on-chip,
+        # 256x256 4spp: 02_physics-mesh [3 nodes x 24 inst] 16.9 -> 38.9
+        # f/s; 03_physics-2-mesh [127 nodes x 48 inst] 1.89 -> 1.52).
+        if pallas_kernels.mesh_megakernel_eligible(mesh):
+            return pallas_kernels.trace_paths_fused_mesh(
+                scene, mesh, origins, directions, seed,
+                max_bounces=max_bounces,
+            )
+        # Deep scenes fall through to the XLA bounce scan below, whose
+        # intersections still dispatch to the Pallas instanced kernels.
     n = origins.shape[0]
     carry = (
         origins,
@@ -216,7 +228,15 @@ def render_tile(
 
     from tpu_render_cluster.render import pallas_kernels
 
-    if pallas_kernels.pallas_enabled() and mesh is None:
+    # Deep-walk mesh scenes keep the sequential per-sample scan: flattening
+    # interleaves independently-jittered sample streams into each ray
+    # block, which widens the packets the BVH walk culls on (measured
+    # 1.89 -> 1.64 f/s on 03_physics-2-mesh). Sphere scenes and
+    # megakernel-eligible meshes have no such coherence cliff.
+    flatten_samples = pallas_kernels.pallas_enabled() and (
+        mesh is None or pallas_kernels.mesh_megakernel_eligible(mesh)
+    )
+    if flatten_samples:
         # Samples ride the ray axis instead of a sequential lax.scan: one
         # [samples * n]-ray trace keeps every bounce step 'samples'x larger
         # (better VPU/MXU occupancy, fewer serialized steps) for the same
@@ -230,6 +250,7 @@ def render_tile(
             directions.reshape(samples * n, 3),
             jax.random.fold_in(base_key, jnp.int32(-1)),
             max_bounces=max_bounces,
+            mesh=mesh,
         )
         image = radiance.reshape(samples, n, 3).mean(axis=0)
     else:

@@ -558,6 +558,25 @@ def trace_paths_fused(scene, origins, directions, seed, *, max_bounces: int):
 # construction).
 
 BVH_DONE_EPS = 1e-12
+# Mesh-megakernel dispatch bound: use the fused whole-bounce-loop kernel
+# when bvh_nodes x instances is at most this; deeper walks pay more for
+# the in-kernel normal tracking than the fusion saves (see
+# integrator.trace_paths for the on-chip measurements).
+MESH_MEGAKERNEL_MAX_WALK = 1024
+
+
+def mesh_megakernel_eligible(mesh) -> bool:
+    """Single source of truth for the megakernel/per-bounce dispatch.
+
+    Both trace_paths (which kernel) and render_tile (whether to flatten
+    sample streams onto the ray axis) must agree — a drifted copy would
+    flatten samples for a scene that then takes the per-bounce walk,
+    hitting the packet-coherence cliff flattening is gated against.
+    """
+    return (
+        mesh.bvh.skip.shape[0] * mesh.instances.translation.shape[0]
+        <= MESH_MEGAKERNEL_MAX_WALK
+    )
 
 
 def _bvh_kernel_factory(n_nodes: int, leaf_size: int):
@@ -1107,10 +1126,12 @@ def _bvh_instanced_kernel_factory(n_nodes: int, leaf_size: int, anyhit: bool):
     return kernel
 
 
-def _instance_table(rotation, translation, scale, bounds_min, bounds_max):
-    """[K, 19] SMEM table: rotation row-major, translation, 1/scale, and
-    the instance's WORLD-space AABB (rows 13..18) — the top-level cull the
-    kernel applies before paying for the object-space walk.
+def _instance_table(rotation, translation, scale, bounds_min, bounds_max,
+                    albedo=None):
+    """[K, 22] SMEM table: rotation row-major (0..8), translation (9..11),
+    1/scale (12), the instance's WORLD-space AABB (13..18) — the top-level
+    cull the kernel applies before paying for the object-space walk — and
+    the instance albedo (19..21; zeros when the caller doesn't need it).
 
     World AABB of a transformed box: center_w = s R c_o + t,
     half_w = s |R| h_o (elementwise absolute rotation).
@@ -1127,6 +1148,8 @@ def _instance_table(rotation, translation, scale, bounds_min, bounds_max):
     half_w = scale[:, None] * jnp.einsum(
         "kij,j->ki", jnp.abs(rotation), half_obj, precision="highest"
     )
+    if albedo is None:
+        albedo = jnp.zeros((k, 3), jnp.float32)
     return jnp.concatenate(
         [
             rotation.reshape(k, 9),
@@ -1134,6 +1157,7 @@ def _instance_table(rotation, translation, scale, bounds_min, bounds_max):
             (1.0 / scale)[:, None],
             center_w - half_w,
             center_w + half_w,
+            albedo,
         ],
         axis=1,
     )
@@ -1234,6 +1258,649 @@ def _bvh_anyhit_instanced(
     )(o_t, d_t, inst_table, v0, e1, e2, bounds_min, bounds_max, skip, first,
       count)
     return (occ[0, :rays] > 0.0) | already
+
+
+# ---------------------------------------------------------------------------
+# Mesh megakernel: the WHOLE bounce loop for mesh scenes in one kernel.
+#
+# The sphere megakernel (_trace_kernel_factory) keeps path state
+# VMEM-resident across bounces; mesh scenes previously fell back to the
+# per-bounce XLA scan with 2 BVH kernel launches + HBM round trips of every
+# [R, 3] state buffer per bounce. This kernel subsumes both: per bounce it
+# runs the sphere/plane nearest hit, an IN-KERNEL instanced threaded-BVH
+# walk (fori over instances, while over nodes — same two-level TLAS/BLAS
+# shape as the standalone instanced kernels), sun NEE with both sphere and
+# mesh any-hit occlusion, and the counter-based PCG resample. Per-lane
+# mesh normals/albedo are tracked through winner one-hots during the leaf
+# pass (TPU Pallas has no per-lane vector gather); shadow rays toward the
+# uniform sun direction transform per instance as SCALARS.
+#
+# The sphere/plane/sky/NEE/resample physics is intentionally the same
+# code shape as _trace_kernel_factory; both kernels are pinned to the ONE
+# XLA reference implementation by deterministic single-bounce equivalence
+# tests (test_pallas_kernels.py, test_mesh_megakernel.py), so a physics
+# edit applied to only one kernel fails its test rather than silently
+# diverging.
+
+
+def _mesh_trace_kernel_factory(
+    max_bounces: int, n_padded: int, n_nodes: int, leaf_size: int,
+    k_count: int,
+):
+    contract_first = (((0,), (0,)), ((), ()))
+
+    def kernel(seed_ref, o_ref, d_ref, c_ref, r2_ref, csq_ref, rad_ref,
+               albedo_ref, emission_ref, dcsun_ref, params_ref, sunsm_ref,
+               inst_ref, v0_ref, e1_ref, e2_ref, nrm_ref, bmin_ref,
+               bmax_ref, skip_ref, first_ref, count_ref, out_ref):
+        o = o_ref[:, :]  # [3, BR]
+        d = d_ref[:, :]
+        c = c_ref[:, :]
+        r2 = r2_ref[:, :]
+        csq = csq_ref[:, :]
+        radius = rad_ref[:, :]
+        albedo_t = albedo_ref[:, :]
+        emission_t = emission_ref[:, :]
+        dc_sun = dcsun_ref[:, :]
+        params = params_ref[:, :]
+        sun = params[0:1, :].T
+        sun_color = params[1:2, :].T
+        sky_horizon = params[2:3, :].T
+        sky_zenith = params[3:4, :].T
+        plane_a = params[4:5, :].T
+        plane_b = params[5:6, :].T
+
+        block = o.shape[1]
+        seed = seed_ref[0, 0].astype(jnp.uint32)
+        ray_index = (
+            jax.lax.broadcasted_iota(jnp.int32, (1, block), 1).astype(jnp.uint32)
+            + jnp.uint32(pl.program_id(0) * block)
+        )
+        sphere_iota = jax.lax.broadcasted_iota(jnp.int32, (n_padded, block), 0)
+        lanes = jax.lax.broadcasted_iota(jnp.int32, (leaf_size, block), 0)
+
+        def winv(v):
+            small = jnp.abs(v) < 1e-12
+            return 1.0 / jnp.where(small, jnp.where(v < 0, -1e-12, 1e-12), v)
+
+        def world_cull(k, wox, woy, woz, wix, wiy, wiz, limit_t):
+            """Block-wide test of the untransformed rays against instance
+            k's world AABB (SMEM cols 13..18); returns a scalar bool."""
+            lox = (inst_ref[k, 13] - wox) * wix
+            hix = (inst_ref[k, 16] - wox) * wix
+            loy = (inst_ref[k, 14] - woy) * wiy
+            hiy = (inst_ref[k, 17] - woy) * wiy
+            loz = (inst_ref[k, 15] - woz) * wiz
+            hiz = (inst_ref[k, 18] - woz) * wiz
+            near = jnp.maximum(
+                jnp.maximum(jnp.minimum(lox, hix), jnp.minimum(loy, hiy)),
+                jnp.minimum(loz, hiz),
+            )
+            far = jnp.minimum(
+                jnp.minimum(jnp.maximum(lox, hix), jnp.maximum(loy, hiy)),
+                jnp.maximum(loz, hiz),
+            )
+            return jnp.any((far >= jnp.maximum(near, 0.0)) & (near < limit_t))
+
+        def mesh_nearest(o, d):
+            """Nearest mesh hit over all instances.
+
+            Returns (t [1,BR], world normal [3 x (1,BR)], albedo
+            [3 x (1,BR)]). Same walk as _bvh_instanced_kernel_factory with
+            the winning triangle's normal and the instance albedo tracked
+            in-kernel.
+            """
+            wox, woy, woz = o[0:1, :], o[1:2, :], o[2:3, :]
+            wdx, wdy, wdz = d[0:1, :], d[1:2, :], d[2:3, :]
+            wix, wiy, wiz = winv(wdx), winv(wdy), winv(wdz)
+
+            def per_instance(k, carry):
+                best_t, bnx, bny, bnz, bar, bag, bab = carry
+                r00, r01, r02 = inst_ref[k, 0], inst_ref[k, 1], inst_ref[k, 2]
+                r10, r11, r12 = inst_ref[k, 3], inst_ref[k, 4], inst_ref[k, 5]
+                r20, r21, r22 = inst_ref[k, 6], inst_ref[k, 7], inst_ref[k, 8]
+                tx, ty, tz = inst_ref[k, 9], inst_ref[k, 10], inst_ref[k, 11]
+                inv_s = inst_ref[k, 12]
+                ar, ag, ab = inst_ref[k, 19], inst_ref[k, 20], inst_ref[k, 21]
+                touch = world_cull(k, wox, woy, woz, wix, wiy, wiz, best_t)
+
+                sx, sy, sz = wox - tx, woy - ty, woz - tz
+                ox = (sx * r00 + sy * r10 + sz * r20) * inv_s
+                oy = (sx * r01 + sy * r11 + sz * r21) * inv_s
+                oz = (sx * r02 + sy * r12 + sz * r22) * inv_s
+                dx = (wdx * r00 + wdy * r10 + wdz * r20) * inv_s
+                dy = (wdx * r01 + wdy * r11 + wdz * r21) * inv_s
+                dz = (wdx * r02 + wdy * r12 + wdz * r22) * inv_s
+                invx, invy, invz = winv(dx), winv(dy), winv(dz)
+
+                def cond(walk):
+                    return walk[0] < n_nodes
+
+                def body(walk):
+                    node, best_t, bnx, bny, bnz, bar_, bag_, bab_ = walk
+                    lox = (bmin_ref[node, 0] - ox) * invx
+                    hix = (bmax_ref[node, 0] - ox) * invx
+                    loy = (bmin_ref[node, 1] - oy) * invy
+                    hiy = (bmax_ref[node, 1] - oy) * invy
+                    loz = (bmin_ref[node, 2] - oz) * invz
+                    hiz = (bmax_ref[node, 2] - oz) * invz
+                    tnear = jnp.maximum(
+                        jnp.maximum(
+                            jnp.minimum(lox, hix), jnp.minimum(loy, hiy)
+                        ),
+                        jnp.minimum(loz, hiz),
+                    )
+                    tfar = jnp.minimum(
+                        jnp.minimum(
+                            jnp.maximum(lox, hix), jnp.maximum(loy, hiy)
+                        ),
+                        jnp.maximum(loz, hiz),
+                    )
+                    packet_hit = (
+                        (tfar >= jnp.maximum(tnear, 0.0)) & (tnear < best_t)
+                    )
+                    hit_any = jnp.any(packet_hit)
+                    count = count_ref[node]
+                    is_leaf = count > 0
+                    start = first_ref[node]
+
+                    v0b = v0_ref[pl.dslice(start, leaf_size), :]
+                    e1b = e1_ref[pl.dslice(start, leaf_size), :]
+                    e2b = e2_ref[pl.dslice(start, leaf_size), :]
+                    v0x, v0y, v0z = v0b[:, 0:1], v0b[:, 1:2], v0b[:, 2:3]
+                    e1x, e1y, e1z = e1b[:, 0:1], e1b[:, 1:2], e1b[:, 2:3]
+                    e2x, e2y, e2z = e2b[:, 0:1], e2b[:, 1:2], e2b[:, 2:3]
+                    pvx = dy * e2z - dz * e2y
+                    pvy = dz * e2x - dx * e2z
+                    pvz = dx * e2y - dy * e2x
+                    det = e1x * pvx + e1y * pvy + e1z * pvz
+                    inv_det = 1.0 / jnp.where(
+                        jnp.abs(det) < BVH_DONE_EPS, BVH_DONE_EPS, det
+                    )
+                    tvx, tvy, tvz = ox - v0x, oy - v0y, oz - v0z
+                    u = (tvx * pvx + tvy * pvy + tvz * pvz) * inv_det
+                    qvx = tvy * e1z - tvz * e1y
+                    qvy = tvz * e1x - tvx * e1z
+                    qvz = tvx * e1y - tvy * e1x
+                    v = (dx * qvx + dy * qvy + dz * qvz) * inv_det
+                    tt = (e2x * qvx + e2y * qvy + e2z * qvz) * inv_det
+                    tri_hit = (
+                        (jnp.abs(det) > BVH_DONE_EPS)
+                        & (u >= 0.0)
+                        & (v >= 0.0)
+                        & (u + v <= 1.0)
+                        & (tt > EPS)
+                        & (lanes < count)
+                        & is_leaf
+                        & hit_any
+                    )
+                    t_cand = jnp.where(tri_hit, tt, INF)
+                    t_leaf = jnp.min(t_cand, axis=0, keepdims=True)
+                    local = jnp.min(
+                        jnp.where(t_cand == t_leaf, lanes, leaf_size),
+                        axis=0,
+                        keepdims=True,
+                    )
+                    closer = t_leaf < best_t
+                    # Winning row's OBJECT normal via a one-hot reduce
+                    # (exactly one row: the first tying lane).
+                    nb = nrm_ref[pl.dslice(start, leaf_size), :]
+                    winner = (lanes == local).astype(jnp.float32)
+                    nox = jnp.sum(winner * nb[:, 0:1], axis=0, keepdims=True)
+                    noy = jnp.sum(winner * nb[:, 1:2], axis=0, keepdims=True)
+                    noz = jnp.sum(winner * nb[:, 2:3], axis=0, keepdims=True)
+                    # Object -> world (rigid): w_i = sum_j R[i][j] n_j.
+                    wnx = r00 * nox + r01 * noy + r02 * noz
+                    wny = r10 * nox + r11 * noy + r12 * noz
+                    wnz = r20 * nox + r21 * noy + r22 * noz
+                    best_t = jnp.where(closer, t_leaf, best_t)
+                    bnx = jnp.where(closer, wnx, bnx)
+                    bny = jnp.where(closer, wny, bny)
+                    bnz = jnp.where(closer, wnz, bnz)
+                    bar_ = jnp.where(closer, ar, bar_)
+                    bag_ = jnp.where(closer, ag, bag_)
+                    bab_ = jnp.where(closer, ab, bab_)
+                    next_node = jnp.where(
+                        hit_any,
+                        jnp.where(is_leaf, skip_ref[node], node + 1),
+                        skip_ref[node],
+                    )
+                    return (
+                        next_node, best_t, bnx, bny, bnz, bar_, bag_, bab_
+                    )
+
+                node0 = jnp.where(touch, jnp.int32(0), jnp.int32(n_nodes))
+                walked = jax.lax.while_loop(
+                    cond, body,
+                    (node0, best_t, bnx, bny, bnz, bar, bag, bab),
+                )
+                return walked[1:]
+
+            init = (
+                jnp.full((1, block), INF, jnp.float32),
+                jnp.zeros((1, block), jnp.float32),
+                jnp.zeros((1, block), jnp.float32),
+                jnp.zeros((1, block), jnp.float32),
+                jnp.zeros((1, block), jnp.float32),
+                jnp.zeros((1, block), jnp.float32),
+                jnp.zeros((1, block), jnp.float32),
+            )
+            best_t, bnx, bny, bnz, bar, bag, bab = jax.lax.fori_loop(
+                0, k_count, per_instance, init
+            )
+            # Flip toward the incoming ray (matches mesh.intersect_instances).
+            facing = (
+                bnx * d[0:1, :] + bny * d[1:2, :] + bnz * d[2:3, :]
+            ) < 0.0
+            sign = jnp.where(facing, 1.0, -1.0)
+            return best_t, (bnx * sign, bny * sign, bnz * sign), (bar, bag, bab)
+
+        def mesh_occluded(o):
+            """Any-hit toward the (uniform) sun for shadow origins ``o``.
+
+            The sun direction transforms per instance as scalars; occluded
+            lanes stop driving the walk via the best_t=-INF trick (same as
+            _bvh_anyhit_kernel_factory).
+            """
+            wox, woy, woz = o[0:1, :], o[1:2, :], o[2:3, :]
+            # TRUE rank-0 scalars from SMEM: a [1,1] vector operand here
+            # ends up needing a both-sublanes-and-lanes vector.broadcast
+            # against the walk's [L, BR] intermediates, which Mosaic does
+            # not implement; scalar-vector ops use scalar registers.
+            sunx = sunsm_ref[0]
+            suny = sunsm_ref[1]
+            sunz = sunsm_ref[2]
+            wix, wiy, wiz = winv(sunx), winv(suny), winv(sunz)
+
+            def per_instance(k, occluded):
+                r00, r01, r02 = inst_ref[k, 0], inst_ref[k, 1], inst_ref[k, 2]
+                r10, r11, r12 = inst_ref[k, 3], inst_ref[k, 4], inst_ref[k, 5]
+                r20, r21, r22 = inst_ref[k, 6], inst_ref[k, 7], inst_ref[k, 8]
+                tx, ty, tz = inst_ref[k, 9], inst_ref[k, 10], inst_ref[k, 11]
+                inv_s = inst_ref[k, 12]
+                limit = jnp.where(occluded > 0.0, -INF, INF)
+                touch = world_cull(k, wox, woy, woz, wix, wiy, wiz, limit)
+
+                sx, sy, sz = wox - tx, woy - ty, woz - tz
+                ox = (sx * r00 + sy * r10 + sz * r20) * inv_s
+                oy = (sx * r01 + sy * r11 + sz * r21) * inv_s
+                oz = (sx * r02 + sy * r12 + sz * r22) * inv_s
+                # All-scalar transform of the (uniform) sun direction into
+                # this instance's object space — stays in scalar registers.
+                dx = (sunx * r00 + suny * r10 + sunz * r20) * inv_s
+                dy = (sunx * r01 + suny * r11 + sunz * r21) * inv_s
+                dz = (sunx * r02 + suny * r12 + sunz * r22) * inv_s
+                invx, invy, invz = winv(dx), winv(dy), winv(dz)
+
+                def cond(walk):
+                    return walk[0] < n_nodes
+
+                def body(walk):
+                    node, occluded = walk
+                    best_t = jnp.where(occluded > 0.0, -INF, INF)
+                    lox = (bmin_ref[node, 0] - ox) * invx
+                    hix = (bmax_ref[node, 0] - ox) * invx
+                    loy = (bmin_ref[node, 1] - oy) * invy
+                    hiy = (bmax_ref[node, 1] - oy) * invy
+                    loz = (bmin_ref[node, 2] - oz) * invz
+                    hiz = (bmax_ref[node, 2] - oz) * invz
+                    tnear = jnp.maximum(
+                        jnp.maximum(
+                            jnp.minimum(lox, hix), jnp.minimum(loy, hiy)
+                        ),
+                        jnp.minimum(loz, hiz),
+                    )
+                    tfar = jnp.minimum(
+                        jnp.minimum(
+                            jnp.maximum(lox, hix), jnp.maximum(loy, hiy)
+                        ),
+                        jnp.maximum(loz, hiz),
+                    )
+                    packet_hit = (
+                        (tfar >= jnp.maximum(tnear, 0.0)) & (tnear < best_t)
+                    )
+                    hit_any = jnp.any(packet_hit)
+                    count = count_ref[node]
+                    is_leaf = count > 0
+                    start = first_ref[node]
+
+                    v0b = v0_ref[pl.dslice(start, leaf_size), :]
+                    e1b = e1_ref[pl.dslice(start, leaf_size), :]
+                    e2b = e2_ref[pl.dslice(start, leaf_size), :]
+                    v0x, v0y, v0z = v0b[:, 0:1], v0b[:, 1:2], v0b[:, 2:3]
+                    e1x, e1y, e1z = e1b[:, 0:1], e1b[:, 1:2], e1b[:, 2:3]
+                    e2x, e2y, e2z = e2b[:, 0:1], e2b[:, 1:2], e2b[:, 2:3]
+                    pvx = dy * e2z - dz * e2y
+                    pvy = dz * e2x - dx * e2z
+                    pvz = dx * e2y - dy * e2x
+                    det = e1x * pvx + e1y * pvy + e1z * pvz
+                    inv_det = 1.0 / jnp.where(
+                        jnp.abs(det) < BVH_DONE_EPS, BVH_DONE_EPS, det
+                    )
+                    tvx, tvy, tvz = ox - v0x, oy - v0y, oz - v0z
+                    u = (tvx * pvx + tvy * pvy + tvz * pvz) * inv_det
+                    qvx = tvy * e1z - tvz * e1y
+                    qvy = tvz * e1x - tvx * e1z
+                    qvz = tvx * e1y - tvy * e1x
+                    v = (dx * qvx + dy * qvy + dz * qvz) * inv_det
+                    tt = (e2x * qvx + e2y * qvy + e2z * qvz) * inv_det
+                    tri_hit = (
+                        (jnp.abs(det) > BVH_DONE_EPS)
+                        & (u >= 0.0)
+                        & (v >= 0.0)
+                        & (u + v <= 1.0)
+                        & (tt > EPS)
+                        & (lanes < count)
+                        & is_leaf
+                        & hit_any
+                    )
+                    occluded = jnp.maximum(
+                        occluded,
+                        jnp.max(
+                            jnp.where(tri_hit, 1.0, 0.0),
+                            axis=0,
+                            keepdims=True,
+                        ),
+                    )
+                    next_node = jnp.where(
+                        hit_any,
+                        jnp.where(is_leaf, skip_ref[node], node + 1),
+                        skip_ref[node],
+                    )
+                    return next_node, occluded
+
+                node0 = jnp.where(touch, jnp.int32(0), jnp.int32(n_nodes))
+                _, occluded = jax.lax.while_loop(
+                    cond, body, (node0, occluded)
+                )
+                return occluded
+
+            return jax.lax.fori_loop(
+                0, k_count, per_instance, jnp.zeros((1, block), jnp.float32)
+            )
+
+        throughput = jnp.ones((3, block), jnp.float32)
+        radiance = jnp.zeros((3, block), jnp.float32)
+        alive = jnp.ones((1, block), jnp.float32)
+
+        def bounce_step(bounce, carry):
+            o, d, throughput, radiance, alive = carry
+            # -- nearest sphere hit (same math as _trace_kernel_factory) --
+            dc = jax.lax.dot_general(
+                c, d, contract_first, preferred_element_type=jnp.float32
+            )
+            oc = jax.lax.dot_general(
+                c, o, contract_first, preferred_element_type=jnp.float32
+            )
+            od = jnp.sum(o * d, axis=0, keepdims=True)
+            o_sq = jnp.sum(o * o, axis=0, keepdims=True)
+            oc_dot_d = dc - od
+            oc_sq = o_sq - 2.0 * oc + csq
+            disc = oc_dot_d * oc_dot_d - (oc_sq - r2)
+            valid = (disc > 0.0) & (r2 > 0.0)
+            sqrt_disc = jnp.sqrt(jnp.maximum(disc, 0.0))
+            t0 = oc_dot_d - sqrt_disc
+            t1 = oc_dot_d + sqrt_disc
+            t_all = jnp.where(t0 > EPS, t0, jnp.where(t1 > EPS, t1, INF))
+            t_all = jnp.where(valid, t_all, INF)
+            t_sphere = jnp.min(t_all, axis=0, keepdims=True)
+            idx = jnp.min(
+                jnp.where(t_all == t_sphere, sphere_iota, n_padded),
+                axis=0,
+                keepdims=True,
+            )
+            idx = jnp.minimum(idx, n_padded - 1)
+
+            # -- ground plane ---------------------------------------------
+            d_y = d[1:2, :]
+            o_y = o[1:2, :]
+            denom = jnp.where(jnp.abs(d_y) < 1e-8, 1e-8, d_y)
+            t_plane = -o_y / denom
+            t_plane = jnp.where(
+                (t_plane > EPS) & (jnp.abs(d_y) >= 1e-8), t_plane, INF
+            )
+
+            # -- mesh instances -------------------------------------------
+            t_mesh, (mnx, mny, mnz), (mar, mag, mab) = mesh_nearest(o, d)
+
+            t_sp = jnp.minimum(t_sphere, t_plane)
+            is_plane = ((t_plane < t_sphere) & (t_mesh >= t_sp)).astype(
+                jnp.float32
+            )
+            is_mesh = (t_mesh < t_sp).astype(jnp.float32)
+            t = jnp.minimum(t_sp, t_mesh)
+            hit = (t < INF).astype(jnp.float32)
+
+            # -- sky on escape --------------------------------------------
+            blend = jnp.clip(d[1:2, :], 0.0, 1.0)
+            sun_cos_dir = jnp.sum(d * sun, axis=0, keepdims=True)
+            sun_disc = jnp.where(sun_cos_dir > 0.9995, 8.0, 0.0)
+            sky = (1.0 - blend) * sky_horizon + blend * sky_zenith
+            sky = sky + sun_disc * sun_color
+            radiance = radiance + throughput * sky * (alive * (1.0 - hit))
+
+            alive = alive * hit
+            p = o + d * t
+
+            one_hot = (sphere_iota == idx).astype(jnp.float32)
+            c_hit = jax.lax.dot_general(
+                c, one_hot, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            r_hit = jnp.sum(radius * one_hot, axis=0, keepdims=True)
+            albedo_hit = jax.lax.dot_general(
+                albedo_t, one_hot, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            emission_hit = jax.lax.dot_general(
+                emission_t, one_hot, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+            sphere_normal = (p - c_hit) / jnp.maximum(r_hit, 1e-6)
+            plane_normal = jnp.concatenate(
+                [
+                    jnp.zeros((1, block), jnp.float32),
+                    jnp.ones((1, block), jnp.float32),
+                    jnp.zeros((1, block), jnp.float32),
+                ],
+                axis=0,
+            )
+            mesh_normal = jnp.concatenate([mnx, mny, mnz], axis=0)
+            normal = (
+                is_plane * plane_normal
+                + is_mesh * mesh_normal
+                + (1.0 - is_plane - is_mesh) * sphere_normal
+            )
+
+            checker = (
+                jnp.floor(p[0:1, :]).astype(jnp.int32)
+                + jnp.floor(p[2:3, :]).astype(jnp.int32)
+            ) % 2
+            checker_rgb = jnp.where(checker == 0, plane_a, plane_b)
+            mesh_albedo = jnp.concatenate([mar, mag, mab], axis=0)
+            albedo = (
+                is_plane * checker_rgb
+                + is_mesh * mesh_albedo
+                + (1.0 - is_plane - is_mesh) * albedo_hit
+            )
+            emission = (1.0 - is_plane - is_mesh) * emission_hit
+            radiance = radiance + throughput * emission * alive
+
+            # -- sun NEE: sphere any-hit + mesh any-hit -------------------
+            shadow_o = p + normal * (EPS * 4.0)
+            oc_s = jax.lax.dot_general(
+                c, shadow_o, contract_first, preferred_element_type=jnp.float32
+            )
+            od_s = jnp.sum(shadow_o * sun, axis=0, keepdims=True)
+            osq_s = jnp.sum(shadow_o * shadow_o, axis=0, keepdims=True)
+            ocd_s = dc_sun - od_s
+            ocsq_s = osq_s - 2.0 * oc_s + csq
+            disc_s = ocd_s * ocd_s - (ocsq_s - r2)
+            valid_s = (disc_s > 0.0) & (r2 > 0.0)
+            t1_s = ocd_s + jnp.sqrt(jnp.maximum(disc_s, 0.0))
+            shadowed = jnp.max(
+                jnp.where(valid_s & (t1_s > EPS), 1.0, 0.0),
+                axis=0,
+                keepdims=True,
+            )
+            shadowed = jnp.maximum(shadowed, mesh_occluded(shadow_o))
+            cos_sun = jnp.maximum(
+                jnp.sum(normal * sun, axis=0, keepdims=True), 0.0
+            )
+            direct = (
+                albedo * sun_color * (cos_sun * (1.0 - shadowed) * alive)
+                / jnp.float32(jnp.pi)
+            )
+            radiance = radiance + throughput * direct
+
+            # -- cosine-weighted resample (counter PCG) -------------------
+            throughput = throughput * (alive * albedo + (1.0 - alive))
+            counter = ray_index * jnp.uint32(2 * max_bounces + 2) + jnp.uint32(2) * bounce.astype(jnp.uint32)
+            u1 = _uniform_from_hash(_pcg_hash(counter ^ seed))
+            u2 = _uniform_from_hash(_pcg_hash((counter + jnp.uint32(1)) ^ seed))
+            r = jnp.sqrt(u1)
+            phi = jnp.float32(2.0 * jnp.pi) * u2
+            x = r * jnp.cos(phi)
+            y = r * jnp.sin(phi)
+            z = jnp.sqrt(jnp.maximum(0.0, 1.0 - u1))
+            helper_x = jnp.where(jnp.abs(normal[0:1, :]) > 0.9, 0.0, 1.0)
+            helper_y = 1.0 - helper_x
+            tx = helper_y * normal[2:3, :]
+            ty = -helper_x * normal[2:3, :]
+            tz = helper_x * normal[1:2, :] - helper_y * normal[0:1, :]
+            tangent = jnp.concatenate([tx, ty, tz], axis=0)
+            tangent = tangent / jnp.maximum(
+                jnp.sqrt(jnp.sum(tangent * tangent, axis=0, keepdims=True)),
+                1e-8,
+            )
+            bx = normal[1:2, :] * tangent[2:3, :] - normal[2:3, :] * tangent[1:2, :]
+            by = normal[2:3, :] * tangent[0:1, :] - normal[0:1, :] * tangent[2:3, :]
+            bz = normal[0:1, :] * tangent[1:2, :] - normal[1:2, :] * tangent[0:1, :]
+            bitangent = jnp.concatenate([bx, by, bz], axis=0)
+            new_d = x * tangent + y * bitangent + z * normal
+            new_o = p + normal * (EPS * 4.0)
+            live = alive > 0.5
+            o = jnp.where(live, new_o, o)
+            d = jnp.where(live, new_d, d)
+            return (o, d, throughput, radiance, alive)
+
+        _, _, _, radiance, _ = jax.lax.fori_loop(
+            0, max_bounces, bounce_step,
+            (o, d, throughput, radiance, alive),
+        )
+        out_ref[:, :] = radiance
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("max_bounces", "interpret"))
+def _trace_fused_mesh(
+    origins, directions, centers, radii, albedo, emission,
+    sun_direction, sun_color, sky_horizon, sky_zenith,
+    plane_albedo_a, plane_albedo_b, seed,
+    rotation, translation, scale, inst_albedo,
+    v0, e1, e2, normal, bounds_min, bounds_max, skip, first, count,
+    *, max_bounces: int, interpret: bool,
+):
+    from tpu_render_cluster.render.mesh import LEAF_SIZE
+
+    # Pad lanes must provably MISS (far origin, perpendicular unit dir):
+    # zero-padded directions would degenerate the slab tests and strip the
+    # packet culling from the final block (see _pad_rays_to_miss).
+    o_t, d_t, rays, padded_rays = _pad_rays_to_miss(origins, directions)
+
+    n = centers.shape[0]
+    padded_n = -(-n // _SUBLANE) * _SUBLANE
+    sphere_pad = padded_n - n
+    c_t = jnp.pad(centers, ((0, sphere_pad), (0, 0))).T
+    radii_p = jnp.pad(radii, (0, sphere_pad))
+    r2 = (radii_p * radii_p)[:, None]
+    csq = jnp.sum(c_t * c_t, axis=0)[:, None]
+    rad = radii_p[:, None]
+    albedo_t = jnp.pad(albedo, ((0, sphere_pad), (0, 0))).T
+    emission_t = jnp.pad(emission, ((0, sphere_pad), (0, 0))).T
+    dc_sun = (c_t.T @ sun_direction)[:, None]
+
+    params = jnp.zeros((8, 3), jnp.float32)
+    params = params.at[0].set(sun_direction)
+    params = params.at[1].set(sun_color)
+    params = params.at[2].set(sky_horizon)
+    params = params.at[3].set(sky_zenith)
+    params = params.at[4].set(plane_albedo_a)
+    params = params.at[5].set(plane_albedo_b)
+    seed_arr = jnp.asarray(seed, jnp.int32).reshape(1, 1)
+
+    inst_table = _instance_table(
+        rotation, translation, scale, bounds_min, bounds_max, inst_albedo
+    )
+    n_nodes = skip.shape[0]
+    k_count = rotation.shape[0]
+
+    grid = (padded_rays // BVH_BLOCK_R,)
+    whole = lambda i: (0, 0)  # noqa: E731
+    flat = lambda i: (0,)  # noqa: E731
+    out = pl.pallas_call(
+        _mesh_trace_kernel_factory(
+            max_bounces, padded_n, n_nodes, LEAF_SIZE, k_count
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), whole, memory_space=pltpu.SMEM),
+            pl.BlockSpec((3, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, padded_n), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, padded_n), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, padded_n), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, 3), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((3,), flat, memory_space=pltpu.SMEM),
+            pl.BlockSpec(inst_table.shape, whole, memory_space=pltpu.SMEM),
+            pl.BlockSpec(v0.shape, whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec(e1.shape, whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec(e2.shape, whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec(normal.shape, whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec(bounds_min.shape, whole, memory_space=pltpu.SMEM),
+            pl.BlockSpec(bounds_max.shape, whole, memory_space=pltpu.SMEM),
+            pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
+            pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
+            pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((3, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((3, padded_rays), jnp.float32)],
+        interpret=interpret,
+    )(seed_arr, o_t, d_t, c_t, r2, csq, rad, albedo_t, emission_t, dc_sun,
+      params, sun_direction, inst_table, v0, e1, e2, normal, bounds_min,
+      bounds_max, skip, first, count)[0]
+    return out.T[:rays]
+
+
+def trace_paths_fused_mesh(
+    scene, mesh, origins, directions, seed, *, max_bounces: int
+):
+    """Fused megakernel path trace for mesh scenes; drop-in for
+    integrator.trace_paths with a MeshSet. Same physics as the XLA bounce
+    scan + per-pass kernels; different (in-kernel counter PCG) RNG stream.
+    """
+    bvh = mesh.bvh
+    instances = mesh.instances
+    return _trace_fused_mesh(
+        origins, directions,
+        scene.centers, scene.radii, scene.albedo, scene.emission,
+        scene.sun_direction, scene.sun_color, scene.sky_horizon,
+        scene.sky_zenith, scene.plane_albedo_a, scene.plane_albedo_b,
+        seed,
+        instances.rotation, instances.translation, instances.scale,
+        instances.albedo,
+        bvh.v0, bvh.e1, bvh.e2, bvh.normal,
+        bvh.bounds_min, bvh.bounds_max, bvh.skip, bvh.first, bvh.count,
+        max_bounces=max_bounces, interpret=_interpret(),
+    )
 
 
 def intersect_instances_pallas(bvh, instances, origins, directions):
