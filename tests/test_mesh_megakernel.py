@@ -53,6 +53,50 @@ def test_deterministic_mesh_render_matches_reference_path(monkeypatch, scene):
     np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-3)
 
 
+def test_megakernel_deep_tree_matches_xla(monkeypatch):
+    """The megakernel's in-kernel walk on a DEEP BVH, tested directly.
+
+    03_physics-2-mesh (127-node icosphere BVH x 48 instances) is not
+    megakernel-eligible, so the render_frame tests above only exercise its
+    fallback path — a skip-link traversal bug that needs depth to manifest
+    would otherwise ship untested until MESH_MEGAKERNEL_MAX_WALK is ever
+    raised. Call trace_paths_fused_mesh directly (bypassing the gate) on
+    primary camera rays and pin it to the XLA reference at one bounce.
+    """
+    import jax.numpy as jnp
+
+    from tpu_render_cluster.render.camera import camera_rays, scene_camera
+    from tpu_render_cluster.render.integrator import trace_paths
+    from tpu_render_cluster.render.mesh import scene_mesh_set
+    from tpu_render_cluster.render.pallas_kernels import trace_paths_fused_mesh
+    from tpu_render_cluster.render.scene import build_scene
+
+    scene_name = "03_physics-2-mesh"
+    monkeypatch.setenv("TRC_PALLAS", "0")
+    jax.clear_caches()
+    scene = build_scene(scene_name, 30)
+    mesh = scene_mesh_set(scene_name, 30)
+    camera = scene_camera(scene_name, 30)
+    side = 16
+    origins, directions = camera_rays(
+        camera, side, side, y0=0, x0=0, tile_height=side, tile_width=side,
+        jitter=jnp.full((side * side, 2), 0.5),
+    )
+    ref = np.asarray(
+        trace_paths(
+            scene, origins, directions, jax.random.PRNGKey(3),
+            max_bounces=1, mesh=mesh,
+        )
+    )
+    out = np.asarray(
+        trace_paths_fused_mesh(
+            scene, mesh, origins, directions, 3, max_bounces=1
+        )
+    )
+    jax.clear_caches()
+    np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-3)
+
+
 def test_stochastic_mesh_render_agrees_statistically(monkeypatch):
     """Multi-bounce renders from the two RNG streams converge together."""
     out, ref = _render_both_paths(

@@ -1,0 +1,83 @@
+"""Multi-host (DCN) initialization surface (SURVEY.md §2.7/§5.8).
+
+Real multi-host needs multiple machines; what is testable here: the
+no-config no-op contract, env-variable plumbing, and an actual
+single-process distributed bring-up (num_processes=1) — JAX starts the
+coordinator service and connects to it, exercising the same code path a
+multi-host worker runs, in a subprocess so this process's JAX state stays
+untouched.
+"""
+
+from __future__ import annotations
+
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def test_initialize_multihost_is_noop_without_config(monkeypatch):
+    for var in (
+        "JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"
+    ):
+        monkeypatch.delenv(var, raising=False)
+    from tpu_render_cluster.parallel.mesh import initialize_multihost
+
+    assert initialize_multihost() is False
+
+
+def test_initialize_multihost_rejects_partial_config(monkeypatch):
+    for var in (
+        "JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"
+    ):
+        monkeypatch.delenv(var, raising=False)
+    import pytest
+
+    from tpu_render_cluster.parallel.mesh import initialize_multihost
+
+    with pytest.raises(ValueError, match="incomplete"):
+        initialize_multihost(num_processes=4)
+    monkeypatch.setenv("JAX_PROCESS_ID", "2")
+    with pytest.raises(ValueError, match="incomplete"):
+        initialize_multihost()
+
+
+def test_worker_cli_exposes_multihost_flags():
+    from tpu_render_cluster.worker.main import build_parser
+
+    args = build_parser().parse_args(
+        [
+            "--masterServerHost", "h", "--masterServerPort", "1",
+            "--baseDirectory", ".", "--coordinatorAddress", "127.0.0.1:9000",
+            "--numProcesses", "2", "--processId", "1",
+        ]
+    )
+    assert args.num_processes == 2
+    assert args.process_id == 1
+
+
+def test_single_process_distributed_bringup():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    code = f"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, {str(REPO_ROOT)!r})
+from tpu_render_cluster.parallel.mesh import device_mesh, initialize_multihost
+assert initialize_multihost("127.0.0.1:{port}", 1, 0) is True
+import jax
+assert jax.process_count() == 1
+mesh = device_mesh()
+print("OK", len(mesh.devices))
+"""
+    result = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert result.returncode == 0, result.stderr[-2000:]
+    assert "OK" in result.stdout

@@ -1,9 +1,71 @@
-"""Mesh construction helpers."""
+"""Mesh construction helpers (single-host ICI and multi-host DCN).
+
+The reference scales across hosts purely via the master/worker protocol
+(one Blender process per SLURM task); this build additionally scales each
+WORKER across hosts the TPU way: ``initialize_multihost`` brings up JAX's
+distributed runtime (reference analog: the NCCL/MPI world the survey's
+checklist names — here it is XLA collectives over DCN between hosts, ICI
+within a slice, SURVEY.md §2.7/§5.8), after which ``device_mesh`` spans
+the global device set and the sharded render paths
+(parallel/sharded_render.py) work unchanged.
+"""
 
 from __future__ import annotations
 
+import os
+
 import jax
 from jax.sharding import Mesh
+
+
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Join (or skip) the multi-host JAX distributed runtime.
+
+    Explicit arguments win; otherwise the standard environment is used
+    (``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``,
+    or cloud auto-detection inside ``jax.distributed.initialize``). With no
+    configuration at all this is a no-op returning False — the single-host
+    path stays untouched. Returns True when the distributed runtime came
+    up; after that ``jax.devices()`` is the GLOBAL device set and
+    ``device_mesh`` spans hosts (DCN) as well as the local slice (ICI).
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    env_processes = os.environ.get("JAX_NUM_PROCESSES")
+    env_process_id = os.environ.get("JAX_PROCESS_ID")
+    if num_processes is None and env_processes is not None:
+        num_processes = int(env_processes)
+    if process_id is None and env_process_id is not None:
+        process_id = int(env_process_id)
+    if (
+        coordinator_address is None
+        and num_processes is None
+        and process_id is None
+    ):
+        return False  # single-host: nothing to join
+    if coordinator_address is None or num_processes is None or process_id is None:
+        # A partially-set triple is a launcher bug (e.g. the line exporting
+        # JAX_COORDINATOR_ADDRESS dropped from a SLURM script): silently
+        # coming up single-host would "work" with the cross-host mesh
+        # never forming. Fail loudly instead.
+        raise ValueError(
+            "Multi-host configuration is incomplete: coordinator_address="
+            f"{coordinator_address!r}, num_processes={num_processes!r}, "
+            f"process_id={process_id!r} — set all three (flags or "
+            "JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/JAX_PROCESS_ID) "
+            "or none."
+        )
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
 
 
 def local_device_count() -> int:

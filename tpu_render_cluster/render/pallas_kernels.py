@@ -1323,6 +1323,74 @@ def _mesh_trace_kernel_factory(
             small = jnp.abs(v) < 1e-12
             return 1.0 / jnp.where(small, jnp.where(v < 0, -1e-12, 1e-12), v)
 
+        def walk_step(node, ox, oy, oz, dx, dy, dz, invx, invy, invz, limit):
+            """One threaded-BVH step shared by BOTH in-kernel walks.
+
+            Slab-tests the node, runs the aligned leaf slot through
+            Möller–Trumbore (branchless; masked out on inner nodes and
+            packet misses), and advances the skip-link cursor. Direction
+            components may be [1, BR] vectors (nearest) or scalars
+            (shadow rays toward the uniform sun). Returns
+            (next_node, leaf start, tri_hit [L, BR], t_cand [L, BR]).
+            """
+            lox = (bmin_ref[node, 0] - ox) * invx
+            hix = (bmax_ref[node, 0] - ox) * invx
+            loy = (bmin_ref[node, 1] - oy) * invy
+            hiy = (bmax_ref[node, 1] - oy) * invy
+            loz = (bmin_ref[node, 2] - oz) * invz
+            hiz = (bmax_ref[node, 2] - oz) * invz
+            tnear = jnp.maximum(
+                jnp.maximum(jnp.minimum(lox, hix), jnp.minimum(loy, hiy)),
+                jnp.minimum(loz, hiz),
+            )
+            tfar = jnp.minimum(
+                jnp.minimum(jnp.maximum(lox, hix), jnp.maximum(loy, hiy)),
+                jnp.maximum(loz, hiz),
+            )
+            packet_hit = (tfar >= jnp.maximum(tnear, 0.0)) & (tnear < limit)
+            hit_any = jnp.any(packet_hit)
+            count = count_ref[node]
+            is_leaf = count > 0
+            start = first_ref[node]
+
+            v0b = v0_ref[pl.dslice(start, leaf_size), :]
+            e1b = e1_ref[pl.dslice(start, leaf_size), :]
+            e2b = e2_ref[pl.dslice(start, leaf_size), :]
+            v0x, v0y, v0z = v0b[:, 0:1], v0b[:, 1:2], v0b[:, 2:3]
+            e1x, e1y, e1z = e1b[:, 0:1], e1b[:, 1:2], e1b[:, 2:3]
+            e2x, e2y, e2z = e2b[:, 0:1], e2b[:, 1:2], e2b[:, 2:3]
+            pvx = dy * e2z - dz * e2y
+            pvy = dz * e2x - dx * e2z
+            pvz = dx * e2y - dy * e2x
+            det = e1x * pvx + e1y * pvy + e1z * pvz
+            inv_det = 1.0 / jnp.where(
+                jnp.abs(det) < BVH_DONE_EPS, BVH_DONE_EPS, det
+            )
+            tvx, tvy, tvz = ox - v0x, oy - v0y, oz - v0z
+            u = (tvx * pvx + tvy * pvy + tvz * pvz) * inv_det
+            qvx = tvy * e1z - tvz * e1y
+            qvy = tvz * e1x - tvx * e1z
+            qvz = tvx * e1y - tvy * e1x
+            v = (dx * qvx + dy * qvy + dz * qvz) * inv_det
+            tt = (e2x * qvx + e2y * qvy + e2z * qvz) * inv_det
+            tri_hit = (
+                (jnp.abs(det) > BVH_DONE_EPS)
+                & (u >= 0.0)
+                & (v >= 0.0)
+                & (u + v <= 1.0)
+                & (tt > EPS)
+                & (lanes < count)
+                & is_leaf
+                & hit_any
+            )
+            t_cand = jnp.where(tri_hit, tt, INF)
+            next_node = jnp.where(
+                hit_any,
+                jnp.where(is_leaf, skip_ref[node], node + 1),
+                skip_ref[node],
+            )
+            return next_node, start, tri_hit, t_cand
+
         def world_cull(k, wox, woy, woz, wix, wiy, wiz, limit_t):
             """Block-wide test of the untransformed rays against instance
             k's world AABB (SMEM cols 13..18); returns a scalar bool."""
@@ -1378,63 +1446,10 @@ def _mesh_trace_kernel_factory(
 
                 def body(walk):
                     node, best_t, bnx, bny, bnz, bar_, bag_, bab_ = walk
-                    lox = (bmin_ref[node, 0] - ox) * invx
-                    hix = (bmax_ref[node, 0] - ox) * invx
-                    loy = (bmin_ref[node, 1] - oy) * invy
-                    hiy = (bmax_ref[node, 1] - oy) * invy
-                    loz = (bmin_ref[node, 2] - oz) * invz
-                    hiz = (bmax_ref[node, 2] - oz) * invz
-                    tnear = jnp.maximum(
-                        jnp.maximum(
-                            jnp.minimum(lox, hix), jnp.minimum(loy, hiy)
-                        ),
-                        jnp.minimum(loz, hiz),
+                    next_node, start, _tri_hit, t_cand = walk_step(
+                        node, ox, oy, oz, dx, dy, dz, invx, invy, invz,
+                        best_t,
                     )
-                    tfar = jnp.minimum(
-                        jnp.minimum(
-                            jnp.maximum(lox, hix), jnp.maximum(loy, hiy)
-                        ),
-                        jnp.maximum(loz, hiz),
-                    )
-                    packet_hit = (
-                        (tfar >= jnp.maximum(tnear, 0.0)) & (tnear < best_t)
-                    )
-                    hit_any = jnp.any(packet_hit)
-                    count = count_ref[node]
-                    is_leaf = count > 0
-                    start = first_ref[node]
-
-                    v0b = v0_ref[pl.dslice(start, leaf_size), :]
-                    e1b = e1_ref[pl.dslice(start, leaf_size), :]
-                    e2b = e2_ref[pl.dslice(start, leaf_size), :]
-                    v0x, v0y, v0z = v0b[:, 0:1], v0b[:, 1:2], v0b[:, 2:3]
-                    e1x, e1y, e1z = e1b[:, 0:1], e1b[:, 1:2], e1b[:, 2:3]
-                    e2x, e2y, e2z = e2b[:, 0:1], e2b[:, 1:2], e2b[:, 2:3]
-                    pvx = dy * e2z - dz * e2y
-                    pvy = dz * e2x - dx * e2z
-                    pvz = dx * e2y - dy * e2x
-                    det = e1x * pvx + e1y * pvy + e1z * pvz
-                    inv_det = 1.0 / jnp.where(
-                        jnp.abs(det) < BVH_DONE_EPS, BVH_DONE_EPS, det
-                    )
-                    tvx, tvy, tvz = ox - v0x, oy - v0y, oz - v0z
-                    u = (tvx * pvx + tvy * pvy + tvz * pvz) * inv_det
-                    qvx = tvy * e1z - tvz * e1y
-                    qvy = tvz * e1x - tvx * e1z
-                    qvz = tvx * e1y - tvy * e1x
-                    v = (dx * qvx + dy * qvy + dz * qvz) * inv_det
-                    tt = (e2x * qvx + e2y * qvy + e2z * qvz) * inv_det
-                    tri_hit = (
-                        (jnp.abs(det) > BVH_DONE_EPS)
-                        & (u >= 0.0)
-                        & (v >= 0.0)
-                        & (u + v <= 1.0)
-                        & (tt > EPS)
-                        & (lanes < count)
-                        & is_leaf
-                        & hit_any
-                    )
-                    t_cand = jnp.where(tri_hit, tt, INF)
                     t_leaf = jnp.min(t_cand, axis=0, keepdims=True)
                     local = jnp.min(
                         jnp.where(t_cand == t_leaf, lanes, leaf_size),
@@ -1460,11 +1475,6 @@ def _mesh_trace_kernel_factory(
                     bar_ = jnp.where(closer, ar, bar_)
                     bag_ = jnp.where(closer, ag, bag_)
                     bab_ = jnp.where(closer, ab, bab_)
-                    next_node = jnp.where(
-                        hit_any,
-                        jnp.where(is_leaf, skip_ref[node], node + 1),
-                        skip_ref[node],
-                    )
                     return (
                         next_node, best_t, bnx, bny, bnz, bar_, bag_, bab_
                     )
@@ -1537,62 +1547,12 @@ def _mesh_trace_kernel_factory(
 
                 def body(walk):
                     node, occluded = walk
-                    best_t = jnp.where(occluded > 0.0, -INF, INF)
-                    lox = (bmin_ref[node, 0] - ox) * invx
-                    hix = (bmax_ref[node, 0] - ox) * invx
-                    loy = (bmin_ref[node, 1] - oy) * invy
-                    hiy = (bmax_ref[node, 1] - oy) * invy
-                    loz = (bmin_ref[node, 2] - oz) * invz
-                    hiz = (bmax_ref[node, 2] - oz) * invz
-                    tnear = jnp.maximum(
-                        jnp.maximum(
-                            jnp.minimum(lox, hix), jnp.minimum(loy, hiy)
-                        ),
-                        jnp.minimum(loz, hiz),
-                    )
-                    tfar = jnp.minimum(
-                        jnp.minimum(
-                            jnp.maximum(lox, hix), jnp.maximum(loy, hiy)
-                        ),
-                        jnp.maximum(loz, hiz),
-                    )
-                    packet_hit = (
-                        (tfar >= jnp.maximum(tnear, 0.0)) & (tnear < best_t)
-                    )
-                    hit_any = jnp.any(packet_hit)
-                    count = count_ref[node]
-                    is_leaf = count > 0
-                    start = first_ref[node]
-
-                    v0b = v0_ref[pl.dslice(start, leaf_size), :]
-                    e1b = e1_ref[pl.dslice(start, leaf_size), :]
-                    e2b = e2_ref[pl.dslice(start, leaf_size), :]
-                    v0x, v0y, v0z = v0b[:, 0:1], v0b[:, 1:2], v0b[:, 2:3]
-                    e1x, e1y, e1z = e1b[:, 0:1], e1b[:, 1:2], e1b[:, 2:3]
-                    e2x, e2y, e2z = e2b[:, 0:1], e2b[:, 1:2], e2b[:, 2:3]
-                    pvx = dy * e2z - dz * e2y
-                    pvy = dz * e2x - dx * e2z
-                    pvz = dx * e2y - dy * e2x
-                    det = e1x * pvx + e1y * pvy + e1z * pvz
-                    inv_det = 1.0 / jnp.where(
-                        jnp.abs(det) < BVH_DONE_EPS, BVH_DONE_EPS, det
-                    )
-                    tvx, tvy, tvz = ox - v0x, oy - v0y, oz - v0z
-                    u = (tvx * pvx + tvy * pvy + tvz * pvz) * inv_det
-                    qvx = tvy * e1z - tvz * e1y
-                    qvy = tvz * e1x - tvx * e1z
-                    qvz = tvx * e1y - tvy * e1x
-                    v = (dx * qvx + dy * qvy + dz * qvz) * inv_det
-                    tt = (e2x * qvx + e2y * qvy + e2z * qvz) * inv_det
-                    tri_hit = (
-                        (jnp.abs(det) > BVH_DONE_EPS)
-                        & (u >= 0.0)
-                        & (v >= 0.0)
-                        & (u + v <= 1.0)
-                        & (tt > EPS)
-                        & (lanes < count)
-                        & is_leaf
-                        & hit_any
+                    # Occluded lanes stop driving the walk: their packet
+                    # limit is -INF so no node can pass their slab test.
+                    limit = jnp.where(occluded > 0.0, -INF, INF)
+                    next_node, _start, tri_hit, _t_cand = walk_step(
+                        node, ox, oy, oz, dx, dy, dz, invx, invy, invz,
+                        limit,
                     )
                     occluded = jnp.maximum(
                         occluded,
@@ -1601,11 +1561,6 @@ def _mesh_trace_kernel_factory(
                             axis=0,
                             keepdims=True,
                         ),
-                    )
-                    next_node = jnp.where(
-                        hit_any,
-                        jnp.where(is_leaf, skip_ref[node], node + 1),
-                        skip_ref[node],
                     )
                     return next_node, occluded
 

@@ -43,6 +43,22 @@ def build_parser() -> argparse.ArgumentParser:
         "over ICI; tpu_render_cluster/parallel/sharded_render.py).",
     )
     parser.add_argument(
+        "--coordinatorAddress",
+        dest="coordinator_address",
+        default=None,
+        help="tpu-raytrace only: join a multi-host JAX distributed runtime "
+        "at this coordinator (host:port); with --numProcesses/--processId "
+        "the worker's device mesh then spans hosts (DCN) as well as its "
+        "local slice (ICI). Env fallbacks: JAX_COORDINATOR_ADDRESS / "
+        "JAX_NUM_PROCESSES / JAX_PROCESS_ID.",
+    )
+    parser.add_argument(
+        "--numProcesses", dest="num_processes", type=int, default=None,
+    )
+    parser.add_argument(
+        "--processId", dest="process_id", type=int, default=None,
+    )
+    parser.add_argument(
         "--renderSize",
         dest="render_size",
         default="512x512",
@@ -76,6 +92,13 @@ def make_backend(args: argparse.Namespace):
             append_arguments=args.append_arguments,
         )
     if args.backend == "tpu-raytrace":
+        from tpu_render_cluster.parallel.mesh import initialize_multihost
+
+        # Must happen before any other JAX use: afterwards jax.devices()
+        # is the global (cross-host) set and sharded rendering spans DCN.
+        initialize_multihost(
+            args.coordinator_address, args.num_processes, args.process_id
+        )
         cache_dir = os.environ.get("TRC_COMPILE_CACHE")
         if cache_dir:
             # Persistent XLA compilation cache: the first worker process
