@@ -87,6 +87,24 @@ def test_sharded_tile_render_matches_single_device():
     assert abs(single.mean() - tiled.mean()) < 0.05 * max(single.mean(), 1e-6)
 
 
+def test_sharded_tile_mesh_render_matches_single_device():
+    # Triangle-mesh scenes through tile sharding: the dryrun only checks
+    # shapes; this pins the radiance statistics against the single-device
+    # render (band y0s differ per band, so exact per-pixel equality is not
+    # expected — same comparison as the sphere-scene tile test).
+    from tpu_render_cluster.parallel.sharded_render import render_frame_sharded
+
+    kwargs = dict(width=16, height=32, samples=2, max_bounces=2)
+    single = np.asarray(render_frame("02_physics-mesh", 1, **kwargs))
+    tiled = np.asarray(
+        render_frame_sharded(
+            "02_physics-mesh", 1, mode="tile", n_devices=2, **kwargs
+        )
+    )
+    assert tiled.shape == single.shape
+    assert abs(single.mean() - tiled.mean()) < 0.05 * max(single.mean(), 1e-6)
+
+
 def test_sharded_spp_render_matches_single_device():
     # VERDICT round-3 weak #4: the psum-average must be asserted against a
     # single-device reference, not just for shape. The spp mode gives each
