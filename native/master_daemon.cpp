@@ -727,6 +727,19 @@ class MasterDaemon {
     std::map<uint64_t, std::pair<uint32_t, int>> timed_out_adds_;
     std::set<uint64_t> ignored_responses_;
 
+    // In-flight PIPELINED queue_add requests (tpu-batch only): the tick
+    // issues adds without waiting, the reader thread reconciles acks, and
+    // sweep_pending_adds() expires silent ones into timed_out_adds_. At
+    // 80 workers the old serial ack-wait capped assignment throughput at
+    // ~1/RTT per frame (~1.3k frames/s); pipelining removes that wall.
+    struct PendingAdd {
+        uint32_t worker_id;
+        int frame_index;
+        double sent_at;
+    };
+    std::mutex pending_adds_mutex_;
+    std::map<uint64_t, PendingAdd> pending_adds_;
+
     AssignmentService assignment_;
     struct CompletionObservation {
         uint32_t worker_id;
@@ -1074,6 +1087,21 @@ class MasterDaemon {
             const Json* context = payload.get("message_request_context_id");
             if (context == nullptr) return;
             uint64_t id = context->as_u64();
+            bool was_pending_add = false;
+            PendingAdd pending_add{};
+            {
+                std::lock_guard<std::mutex> lock(pending_adds_mutex_);
+                auto pending = pending_adds_.find(id);
+                if (pending != pending_adds_.end()) {
+                    pending_add = pending->second;
+                    pending_adds_.erase(pending);
+                    was_pending_add = true;
+                }
+            }
+            if (was_pending_add) {
+                handle_async_add_result(worker, pending_add, payload);
+                return;
+            }
             {
                 std::lock_guard<std::mutex> lock(timed_out_adds_mutex_);
                 if (ignored_responses_.erase(id) != 0) return;
@@ -1361,6 +1389,126 @@ class MasterDaemon {
         }
         note_sched_rpc_result(worker, rpc_ok);
         return ok;
+    }
+
+    // Pipelined add: mark + mirror optimistically, send, return without
+    // waiting. The ack is reconciled by handle_async_add_result (reader
+    // thread); silence is expired by sweep_pending_adds into the same
+    // timed_out_adds_ machinery the blocking path uses for late acks.
+    bool queue_frame_async(WorkerConn& worker, int frame_index) {
+        {
+            std::lock_guard<std::mutex> lock(state_mutex_);
+            FrameSlot* slot = slot_for(frame_index);
+            if (slot == nullptr || slot->status != FrameStatus::Pending)
+                return false;
+            slot->status = FrameStatus::Queued;
+            slot->worker = worker.id;
+            FrameOnWorker entry;
+            entry.frame_index = frame_index;
+            entry.queued_at = now_ts();
+            worker.queue.push_back(entry);
+        }
+        Json payload = Json::make_object();
+        payload.set("job", job_.json);
+        payload.set("frame_index", Json::make_int(frame_index));
+        uint64_t request_id = rng()();
+        payload.set("message_request_id", Json::make_uint(request_id));
+        {
+            std::lock_guard<std::mutex> lock(pending_adds_mutex_);
+            pending_adds_[request_id] = {worker.id, frame_index, now_ts()};
+        }
+        send_to_worker(worker, "request_frame-queue_add", std::move(payload));
+        return true;
+    }
+
+    void revert_async_add(uint32_t worker_id, int frame_index) {
+        // Resolve the worker pointer BEFORE taking state_mutex_ (workers_
+        // never erases entries, so the pointer stays valid) — nesting the
+        // two mutexes would establish a lock order nothing else uses.
+        WorkerConn* worker = nullptr;
+        {
+            std::lock_guard<std::mutex> workers_lock(workers_mutex_);
+            auto it = workers_.find(worker_id);
+            if (it != workers_.end()) worker = it->second.get();
+        }
+        std::lock_guard<std::mutex> lock(state_mutex_);
+        if (worker != nullptr) {
+            for (auto it = worker->queue.begin(); it != worker->queue.end();
+                 ++it) {
+                if (it->frame_index == frame_index) {
+                    worker->queue.erase(it);
+                    break;
+                }
+            }
+        }
+        FrameSlot* slot = slot_for(frame_index);
+        if (slot != nullptr && slot->status == FrameStatus::Queued &&
+            slot->worker == worker_id) {
+            slot->status = FrameStatus::Pending;
+            slot->worker = 0;
+            next_pending_hint_ = 0;
+        }
+    }
+
+    void handle_async_add_result(WorkerConn* worker, const PendingAdd& add,
+                                 const Json& payload) {
+        const Json* result = payload.get("result");
+        const Json* value =
+            result != nullptr ? result->get("result") : nullptr;
+        bool ok = value != nullptr && value->as_string() == "added-to-queue";
+        // ANY delivered response resets the half-open strike counter — a
+        // worker that answers (even with a rejection) is not half-open,
+        // matching the blocking path's rpc_ok semantics.
+        note_sched_rpc_result(*worker, true);
+        if (ok) {
+            return;  // the optimistic mirror/slot state is already correct
+        }
+        LOG_WARN("Async queue_add of frame %d on %08x rejected; reverting.",
+                 add.frame_index, add.worker_id);
+        revert_async_add(add.worker_id, add.frame_index);
+    }
+
+    void sweep_pending_adds() {
+        std::vector<std::pair<uint64_t, PendingAdd>> expired;
+        {
+            // The pending->timed_out transfer must be atomic with respect
+            // to dispatch(): an ack racing the sweep either still finds
+            // the pending entry (it blocks on pending_adds_mutex_ until
+            // the transfer completes, then takes the timed_out late-ack
+            // path) or was already handled. An erase-then-insert gap
+            // would let the ack miss BOTH maps and the frame render
+            // twice. Lock order pending->timed_out is unique to here;
+            // dispatch() never holds both at once.
+            std::lock_guard<std::mutex> lock(pending_adds_mutex_);
+            double now = now_ts();
+            for (auto it = pending_adds_.begin();
+                 it != pending_adds_.end();) {
+                if (now - it->second.sent_at > sched_rpc_timeout()) {
+                    {
+                        std::lock_guard<std::mutex> timed_lock(
+                            timed_out_adds_mutex_);
+                        if (timed_out_adds_.size() > 1024)
+                            timed_out_adds_.clear();
+                        timed_out_adds_[it->first] = {
+                            it->second.worker_id, it->second.frame_index};
+                    }
+                    expired.emplace_back(it->first, it->second);
+                    it = pending_adds_.erase(it);
+                } else {
+                    ++it;
+                }
+            }
+        }
+        for (const auto& pair : expired) {
+            revert_async_add(pair.second.worker_id, pair.second.frame_index);
+            WorkerConn* worker = nullptr;
+            {
+                std::lock_guard<std::mutex> lock(workers_mutex_);
+                auto it = workers_.find(pair.second.worker_id);
+                if (it != workers_.end()) worker = it->second.get();
+            }
+            if (worker != nullptr) note_sched_rpc_result(*worker, false);
+        }
     }
 
     // -- job lifecycle --------------------------------------------------------
@@ -1668,13 +1816,13 @@ class MasterDaemon {
     bool tpu_batch_loop() {
         const double kRateTargetLookahead = 0.25;
         const int kRateTargetCap = 16;
-        const size_t kMaxSlotsPerTick = 128;
         JointCostModel cost_model(job_.cost_ema_alpha);
         std::set<std::pair<uint32_t, int>> observed_frames;
         while (!cancelled_.load()) {
             if (all_frames_finished()) return true;
             if (!cluster_alive()) return false;
             assignment_.poll_ready();
+            sweep_pending_adds();
 
             // Feed the joint cost model from completion observations
             // (first completion per (worker, frame) only, like Python's
@@ -1714,7 +1862,7 @@ class MasterDaemon {
             // speed is known.
             // Slots are interleaved breadth-first by position (every
             // worker's front slot before any second slot): the
-            // kMaxSlotsPerTick truncation must never hide an idle
+            // slot-cap truncation must never hide an idle
             // worker's front slot behind another worker's deep queue
             // positions — at the job tail that starved the scheduler
             // (all surviving slots were deep, the makespan gate rejected
@@ -1747,7 +1895,12 @@ class MasterDaemon {
                     }
                 }
             }
-            if (slots.size() > kMaxSlotsPerTick) slots.resize(kMaxSlotsPerTick);
+            // Per-tick assignment budget: bounds the cost matrix while
+            // scaling with the cluster — a fixed 128 becomes the
+            // throughput ceiling at 80 workers (128 x 10 ticks/s < the
+            // 1600 frames/s an 80-worker 50 ms cluster consumes).
+            const size_t slot_cap = std::max<size_t>(128, 2 * workers.size());
+            if (slots.size() > slot_cap) slots.resize(slot_cap);
 
             if (!slots.empty()) {
                 std::vector<int> frames = pending_frames(slots.size());
@@ -1838,7 +1991,7 @@ class MasterDaemon {
                             gated++;
                             continue;  // leave pending for a better slot
                         }
-                        if (queue_frame(*worker, frames[i])) {
+                        if (queue_frame_async(*worker, frames[i])) {
                             queued++;
                         } else {
                             failed++;
@@ -1884,7 +2037,8 @@ class MasterDaemon {
                             if (complexity[i] < complexity[best]) best = i;
                         }
                         if (engage &&
-                            queue_frame(*fastest_eligible, frames[best])) {
+                            queue_frame_async(*fastest_eligible,
+                                              frames[best])) {
                             queued++;
                         }
                     }
@@ -1910,8 +2064,12 @@ class MasterDaemon {
                                 unassigned, gated, failed);
                         }
                     }
+                    // 50 ms assign-path tick, matching the Python
+                    // master's TPU_BATCH_TICK: with pipelined adds the
+                    // tick rate (x slot cap) IS the assignment
+                    // throughput ceiling.
                     std::this_thread::sleep_for(
-                        std::chrono::milliseconds(100));
+                        std::chrono::milliseconds(50));
                     continue;
                 }
                 starved_since_ = 0;  // nothing pending: not a gated streak
