@@ -287,7 +287,11 @@ async def tpu_batch_strategy(
         # excess workers are topped up on later ticks.
         from tpu_render_cluster.ops.assignment import warmed_max_slots
 
-        slot_cap = MAX_SLOTS_PER_TICK
+        # Scale the per-tick budget with the cluster (C++ twin: slot_cap
+        # in tpu_batch_loop) — a fixed cap becomes the assignment
+        # throughput ceiling on many-worker clusters. Warmed auction
+        # buckets still bound it: an unwarmed size would compile mid-job.
+        slot_cap = max(MAX_SLOTS_PER_TICK, 2 * len(workers))
         if 0 < warmed_max_slots() < slot_cap:
             slot_cap = warmed_max_slots()
         del slots[slot_cap:]
