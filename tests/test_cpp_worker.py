@@ -142,3 +142,72 @@ def test_cpp_workers_dynamic_strategy_two_daemons(tmp_path):
     # Both daemons did real work.
     for _, trace in worker_traces:
         assert trace.total_queued_frames > 0
+
+
+def test_cpp_worker_cli_backend_renders_real_pixels(tmp_path):
+    # The full native path producing REAL images: C++ worker daemon with
+    # --backend cli drives the TPU render CLI per frame (the daemon's
+    # counterpart of the Blender subprocess, native/worker_daemon.cpp
+    # render_frame). Tiny frames keep the CPU-XLA renders fast; the
+    # persistent compile cache makes the second frame's spawn cheap.
+    import os
+    import sys
+
+    job = _job(
+        tmp_path, frames=2, workers=1,
+        strategy=DistributionStrategy.naive_fine(),
+    )
+
+    async def run():
+        port = _free_port()
+        manager = ClusterManager("127.0.0.1", port, job)
+        daemon = build_worker_daemon()
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["TRC_PALLAS"] = "0"
+        env.setdefault("TRC_COMPILE_CACHE", str(tmp_path / "jit-cache"))
+        process = subprocess.Popen(
+            [
+                str(daemon),
+                "--masterServerHost", "127.0.0.1",
+                "--masterServerPort", str(port),
+                "--baseDirectory", str(tmp_path),
+                "--backend", "cli",
+                "--pythonBinary", sys.executable,
+                "--renderWidth", "48", "--renderHeight", "48",
+                "--renderSamples", "2",
+            ],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE,
+            env=env,
+        )
+        try:
+            result = await asyncio.wait_for(
+                manager.initialize_server_and_run_job(), timeout=300
+            )
+        finally:
+            try:
+                process.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                process.kill()
+        assert process.returncode == 0, process.stderr.read().decode()[-2000:]
+        return result
+
+    _, worker_traces = asyncio.run(run())
+    assert len(worker_traces) == 1
+    import numpy as np
+    from PIL import Image
+
+    for i in (1, 2):
+        path = tmp_path / "frames" / f"rendered-{i:04d}.png"
+        assert path.is_file(), path
+        image = np.asarray(Image.open(path))
+        assert image.shape == (48, 48, 3)
+        assert image.std() > 5.0, "render must have non-trivial content"
+    # The cli backend's RESULTS contract fills all 7 phase timestamps.
+    _, trace = worker_traces[0]
+    assert len(trace.frame_render_traces) == 2
+    for frame in trace.frame_render_traces:
+        details = frame.details
+        assert details.finished_rendering_at >= details.started_rendering_at
+        assert details.file_saving_finished_at >= details.file_saving_started_at
