@@ -258,8 +258,13 @@ static int run_subprocess(const std::string& command, std::string* stdout_text) 
 // falling back to the job-name prefix convention used across the repo's job
 // matrix (tpu_render_cluster/render/scene.py scene_for_job_name).
 static std::string scene_for_job(const RenderRequest& request) {
-    static const char* kScenes[] = {"01_simple-animation", "02_physics",
-                                    "03_physics-2", "04_very-simple"};
+    // Longest-prefix-first: the mesh variants must be checked before their
+    // sphere-procedural prefixes or "02_physics-mesh.blend" would render
+    // 02_physics.
+    static const char* kScenes[] = {"01_simple-animation",
+                                    "02_physics-mesh", "02_physics",
+                                    "03_physics-2-mesh", "03_physics-2",
+                                    "04_very-simple"};
     std::string stem = request.project_file_path;
     size_t slash = stem.find_last_of('/');
     if (slash != std::string::npos) stem = stem.substr(slash + 1);
