@@ -86,7 +86,8 @@ output_file_format = "PNG"
     return job_path
 
 
-def run_one(strategy_name: str, strategy_lines: str, scratch: Path) -> dict:
+def run_one(strategy_name: str, strategy_lines: str, scratch: Path,
+            kill: int = 0, kill_after: float = 3.0) -> dict:
     from tpu_render_cluster.native import build_master_daemon, build_worker_daemon
 
     master = build_master_daemon()
@@ -100,10 +101,16 @@ def run_one(strategy_name: str, strategy_lines: str, scratch: Path) -> dict:
     port = free_port()
     job_path = write_job(run_dir, strategy_lines, frames_dir)
 
+    master_args = [
+        str(master), "--host", "127.0.0.1", "--port", str(port),
+        "run-job", str(job_path), "--resultsDirectory", str(results_dir),
+    ]
+    if kill:
+        # Chaos runs need prompt failure detection: evict after 5 s of
+        # heartbeat silence instead of the 120 s default.
+        master_args += ["--evictAfterSeconds", "5"]
     master_proc = subprocess.Popen(
-        [str(master), "--host", "127.0.0.1", "--port", str(port),
-         "run-job", str(job_path), "--resultsDirectory", str(results_dir)],
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        master_args, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
     )
     worker_procs: list[subprocess.Popen] = []
     try:
@@ -118,9 +125,24 @@ def run_one(strategy_name: str, strategy_lines: str, scratch: Path) -> dict:
             for _ in range(WORKERS)
         ]
         t0 = time.perf_counter()
-        # Ceiling scales with the configured workload: --workers 1 at
-        # 100 ms frames legitimately needs FRAMES * MOCK_MS seconds.
-        ideal_s = FRAMES * MOCK_MS / 1000.0 / max(1, WORKERS)
+        if kill:
+            # Kill only once the job is actually rendering: a victim that
+            # dies BEFORE registering would hold the barrier at
+            # wait_for_number_of_workers forever and the run would
+            # demonstrate nothing about eviction.
+            deadline = time.perf_counter() + 60
+            while (
+                not any(frames_dir.glob("rendered-*"))
+                and time.perf_counter() < deadline
+            ):
+                time.sleep(0.1)
+            time.sleep(kill_after)
+            for victim in worker_procs[:kill]:
+                victim.kill()
+        # Ceiling scales with the configured workload ON THE SURVIVORS:
+        # --workers 1 at 100 ms frames legitimately needs
+        # FRAMES * MOCK_MS seconds.
+        ideal_s = FRAMES * MOCK_MS / 1000.0 / max(1, WORKERS - kill)
         rc = master_proc.wait(timeout=120 + 3 * ideal_s)
         wall = time.perf_counter() - t0
         for proc in worker_procs:
@@ -142,14 +164,43 @@ def run_one(strategy_name: str, strategy_lines: str, scratch: Path) -> dict:
 
     raw_trace = next(results_dir.glob("*_raw-trace.json"))
 
-    # Our analysis pipeline.
-    from tpu_render_cluster.analysis.models import JobTrace
-    from tpu_render_cluster.analysis.metrics import utilization_stats, tail_delay_stats
+    if kill:
+        # Evicted workers contribute no trace, so the (reference-mirrored)
+        # strict worker-count validation rightly rejects chaos traces;
+        # account from the raw JSON instead. The completion proof is the
+        # frame count on disk plus per-survivor render totals.
+        data = json.loads(raw_trace.read_text())
+        duration = (data["master_trace"]["job_finish_time"]
+                    - data["master_trace"]["job_start_time"])
+        survivors = data["worker_traces"]
+        rendered_by_survivors = sum(
+            len(w["frame_render_traces"]) for w in survivors.values()
+        )
+        util = {"n/a": "evicted workers void the utilization contract"}
+        tail = {
+            "survivors": len(survivors),
+            "frames_rendered_by_survivors": rendered_by_survivors,
+        }
+    else:
+        # Our analysis pipeline.
+        from tpu_render_cluster.analysis.models import JobTrace
+        from tpu_render_cluster.analysis.metrics import (
+            tail_delay_stats,
+            utilization_stats,
+        )
 
-    trace = JobTrace.load_from_trace_file(raw_trace)
-    duration = trace.job_finished_at - trace.job_started_at
-    util = utilization_stats([trace])
-    tail = tail_delay_stats([trace])
+        trace = JobTrace.load_from_trace_file(raw_trace)
+        duration = trace.job_finished_at - trace.job_started_at
+        # Stats dicts are keyed by (cluster_size, strategy) tuples;
+        # stringify for JSON.
+        util = {
+            f"{k[0]}w_{k[1]}": v
+            for k, v in utilization_stats([trace]).items()
+        }
+        tail = {
+            f"{k[0]}w_{k[1]}": v
+            for k, v in tail_delay_stats([trace]).items()
+        }
 
     # Acceptance: the REFERENCE's loader parses the same file (its
     # validation includes the worker-count invariant, reference
@@ -157,7 +208,9 @@ def run_one(strategy_name: str, strategy_lines: str, scratch: Path) -> dict:
     # the reference's enum knows — `tpu-batch` is this repo's addition, so
     # its traces are validated by our loader alone.
     reference_loader = "n/a (novel strategy tag)"
-    if strategy_name in ("naive-fine", "eager-naive-coarse", "dynamic"):
+    if kill:
+        reference_loader = "n/a (evicted workers void the count invariant)"
+    elif strategy_name in ("naive-fine", "eager-naive-coarse", "dynamic"):
         sys.path.insert(0, "/root/reference/analysis")
         try:
             from core.models import JobTrace as RefJobTrace  # type: ignore
@@ -173,12 +226,9 @@ def run_one(strategy_name: str, strategy_lines: str, scratch: Path) -> dict:
             ]:
                 del sys.modules[name]
 
-    # Stats dicts are keyed by (cluster_size, strategy) tuples; stringify
-    # for JSON.
-    util = {f"{k[0]}w_{k[1]}": v for k, v in util.items()}
-    tail = {f"{k[0]}w_{k[1]}": v for k, v in tail.items()}
     summary = {
         "strategy": strategy_name,
+        "workers_killed": kill,
         "frames": FRAMES,
         "workers": WORKERS,
         "mock_render_ms": MOCK_MS,
@@ -207,9 +257,23 @@ def main() -> int:
     parser.add_argument(
         "--mockRenderMs", dest="mock_ms", type=int, default=MOCK_MS,
     )
+    parser.add_argument(
+        "--kill", type=int, default=0,
+        help="chaos: SIGKILL this many workers a few seconds into each "
+        "run; the master must evict them, requeue their frames, and "
+        "still finish all 14400 (beyond-reference failure recovery, "
+        "SURVEY 5.3).",
+    )
+    parser.add_argument(
+        "--killAfter", dest="kill_after", type=float, default=3.0,
+    )
     args = parser.parse_args()
     WORKERS = args.workers
     MOCK_MS = args.mock_ms
+    if args.kill and not 0 < args.kill < WORKERS:
+        parser.error(
+            f"--kill must leave at least one survivor (0 < kill < {WORKERS})"
+        )
     if args.out is None:
         args.out = f"results/cluster-runs/scale-14400f-{WORKERS}w"
     out_dir = REPO_ROOT / args.out
@@ -220,7 +284,10 @@ def main() -> int:
     try:
         for name, lines in (("dynamic", DYNAMIC), ("tpu-batch", TPU_BATCH)):
             print(f"=== {name}: {FRAMES}f x {WORKERS}w ===", flush=True)
-            summary = run_one(name, lines, scratch)
+            summary = run_one(
+                name, lines, scratch, kill=args.kill,
+                kill_after=args.kill_after,
+            )
             print(json.dumps(
                 {k: v for k, v in summary.items() if not k.startswith("_")
                  and k not in ("utilization", "tail_delay")},
