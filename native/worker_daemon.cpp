@@ -818,7 +818,9 @@ static void print_usage() {
             "  --blenderBinary B      blender executable (blender backend)\n"
             "  --pythonBinary B       python executable (cli backend)\n"
             "  --prependArguments S   extra args before the blend file\n"
+            "                         (aliases: -p, --blenderPrependArguments)\n"
             "  --appendArguments S    extra args at the end\n"
+            "                         (aliases: -a, --blenderAppendArguments)\n"
             "  --mockRenderMs N       mock render duration (default 100)\n"
             "  --mockComplexityRamp R scale mock duration by (1 + frame/R)\n"
             "  --renderWidth/Height/Samples N   cli backend quality knobs\n"
@@ -830,7 +832,22 @@ int main(int argc, char** argv) {
     Options options;
     for (int i = 1; i < argc; i++) {
         std::string flag = argv[i];
+        // Accept the =-form for long flags ("--flag=value") — required when
+        // the value itself starts with "--" (e.g.
+        // --blenderPrependArguments=--factory-startup), matching the Python
+        // worker's argparse behavior.
+        std::string inline_value;
+        bool has_inline_value = false;
+        if (flag.rfind("--", 0) == 0) {
+            size_t equals = flag.find('=');
+            if (equals != std::string::npos) {
+                inline_value = flag.substr(equals + 1);
+                flag = flag.substr(0, equals);
+                has_inline_value = true;
+            }
+        }
         auto next = [&]() -> std::string {
+            if (has_inline_value) return inline_value;
             if (i + 1 >= argc) {
                 fprintf(stderr, "Missing value for %s\n", flag.c_str());
                 exit(2);
@@ -843,8 +860,12 @@ int main(int argc, char** argv) {
         else if (flag == "--backend") options.backend = next();
         else if (flag == "--blenderBinary") options.blender_binary = next();
         else if (flag == "--pythonBinary") options.python_binary = next();
-        else if (flag == "--prependArguments") options.prepend_arguments = next();
-        else if (flag == "--appendArguments") options.append_arguments = next();
+        else if (flag == "--prependArguments" || flag == "-p" ||
+                 flag == "--blenderPrependArguments")
+            options.prepend_arguments = next();
+        else if (flag == "--appendArguments" || flag == "-a" ||
+                 flag == "--blenderAppendArguments")
+            options.append_arguments = next();
         else if (flag == "--mockRenderMs") options.mock_render_ms = atoi(next().c_str());
         else if (flag == "--mockComplexityRamp") options.mock_complexity_ramp = atof(next().c_str());
         else if (flag == "--renderWidth") options.render_width = atoi(next().c_str());

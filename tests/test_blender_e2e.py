@@ -1,0 +1,249 @@
+"""End-to-end proof of the Blender subprocess path, in BOTH workers.
+
+VERDICT round-4 item 1: the Blender backend was implemented but never
+*executed* by a test. ``tests/fake-blender`` consumes the real CLI the
+workers assemble (reference: worker/src/rendering/runner/mod.rs:138-176),
+writes the output file, and prints reference-shaped stdout
+(``Saved: '…'``, `` Time: mm:ss.ff (Saving: …)``, ``RESULTS={json}`` —
+reference scrape: worker/src/rendering/runner/utilities.rs:105-203).
+
+Covered here, per worker implementation:
+- argument assembly incl. shlex prepend/append injection and %BASE%
+  resolution at run time (asserted at the subprocess boundary via the
+  fake's argv log);
+- output-dir creation and ``#####`` placeholder expansion;
+- stdout scrape -> 7-point FrameRenderTime monotonicity;
+- subprocess failure round-tripping as an errored finished-event that the
+  master reschedules (fail-once frames complete the job on retry).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tpu_render_cluster.jobs.models import BlenderJob, DistributionStrategy
+from tpu_render_cluster.master.cluster import ClusterManager
+from tpu_render_cluster.worker.backends.blender import BlenderBackend
+
+FAKE_BLENDER = Path(__file__).resolve().parent / "fake-blender"
+RENDER_SCRIPT = (
+    Path(__file__).resolve().parent.parent / "scripts" / "render-timing-script.py"
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _make_job(frames: int, workers: int) -> BlenderJob:
+    # %BASE%-relative paths: resolution must happen at run time in the
+    # worker (reference: worker/src/utilities.rs:5-21).
+    return BlenderJob(
+        job_name="blender-e2e",
+        job_description="fake-blender end-to-end",
+        project_file_path="%BASE%/project.blend",
+        render_script_path="%BASE%/render-timing-script.py",
+        frame_range_from=1,
+        frame_range_to=frames,
+        wait_for_number_of_workers=workers,
+        frame_distribution_strategy=DistributionStrategy.naive_fine(),
+        output_directory_path="%BASE%/frames",
+        output_file_name_format="rendered-#####",
+        output_file_format="PNG",
+    )
+
+
+def _populate_base(tmp_path: Path) -> None:
+    (tmp_path / "project.blend").write_bytes(b"BLENDER-fake")
+    shutil.copy(RENDER_SCRIPT, tmp_path / "render-timing-script.py")
+
+
+def _invocations(state_dir: Path) -> list[dict]:
+    log = state_dir / "invocations.jsonl"
+    if not log.is_file():
+        return []
+    return [json.loads(line) for line in log.read_text().splitlines()]
+
+
+def _backend(tmp_path: Path) -> BlenderBackend:
+    return BlenderBackend(
+        blender_binary=str(FAKE_BLENDER),
+        base_directory=tmp_path,
+        prepend_arguments="--factory-startup --enable-autoexec",
+        append_arguments="--verbose 1",
+    )
+
+
+def test_python_backend_renders_one_frame(tmp_path, monkeypatch):
+    _populate_base(tmp_path)
+    monkeypatch.setenv("TRC_FAKE_BLENDER_STATE_DIR", str(tmp_path / "state"))
+    job = _make_job(frames=9, workers=1)
+    timing = asyncio.run(_backend(tmp_path).render_frame(job, 7))
+
+    output = tmp_path / "frames" / "rendered-00007.png"
+    assert output.is_file(), "fake-blender must have written the expanded path"
+
+    # 7-point monotonicity (the performance reducer's requirement).
+    points = [
+        timing.started_process_at,
+        timing.finished_loading_at,
+        timing.started_rendering_at,
+        timing.finished_rendering_at,
+        timing.file_saving_started_at,
+        timing.file_saving_finished_at,
+        timing.exited_process_at,
+    ]
+    assert points == sorted(points)
+    assert timing.file_saving_finished_at > timing.finished_rendering_at
+
+    # Argument assembly at the subprocess boundary: prepend args before the
+    # project file, append args last (reference: runner/mod.rs:138-163).
+    (invocation,) = _invocations(tmp_path / "state")
+    argv = invocation["argv"]
+    assert argv[:2] == ["--factory-startup", "--enable-autoexec"]
+    assert argv[2] == str(tmp_path / "project.blend"), "%BASE% resolved at run time"
+    assert argv[-2:] == ["--verbose", "1"]
+    assert argv[argv.index("--python") + 1] == str(tmp_path / "render-timing-script.py")
+
+
+def test_python_backend_subprocess_failure_raises(tmp_path, monkeypatch):
+    _populate_base(tmp_path)
+    monkeypatch.setenv("TRC_FAKE_BLENDER_FAIL_FRAMES", "3")
+    monkeypatch.setenv("TRC_FAKE_BLENDER_STATE_DIR", str(tmp_path / "state"))
+    job = _make_job(frames=9, workers=1)
+    with pytest.raises(RuntimeError, match="exited with code 1"):
+        asyncio.run(_backend(tmp_path).render_frame(job, 3))
+    assert not (tmp_path / "frames" / "rendered-00003.png").exists()
+
+
+def test_python_backend_missing_project_file(tmp_path):
+    # Blender is never spawned when the project file is absent.
+    shutil.copy(RENDER_SCRIPT, tmp_path / "render-timing-script.py")
+    job = _make_job(frames=9, workers=1)
+    with pytest.raises(FileNotFoundError, match="Project file"):
+        asyncio.run(_backend(tmp_path).render_frame(job, 1))
+
+
+async def _run_master_with_worker_process(
+    job: BlenderJob, worker_command: list[str], env: dict
+):
+    port = _free_port()
+    manager = ClusterManager("127.0.0.1", port, job)
+    command = [
+        argument.replace("@PORT@", str(port)) for argument in worker_command
+    ]
+    process = subprocess.Popen(
+        command, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, env=env
+    )
+    try:
+        master_trace, worker_traces = await asyncio.wait_for(
+            manager.initialize_server_and_run_job(), timeout=120
+        )
+    finally:
+        try:
+            process.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            process.kill()
+    assert process.returncode == 0, process.stderr.read().decode()[-2000:]
+    return master_trace, worker_traces
+
+
+def _assert_full_job_completed(tmp_path: Path, worker_traces, frames: int) -> None:
+    rendered = sorted(path.name for path in (tmp_path / "frames").iterdir())
+    assert rendered == [f"rendered-{i:05d}.png" for i in range(1, frames + 1)]
+    traced = sorted(
+        t.frame_index for _, trace in worker_traces for t in trace.frame_render_traces
+    )
+    assert traced == list(range(1, frames + 1))
+    # The fail-once frame was invoked twice: crash, errored finished-event,
+    # master reschedule, success (reference would hang here — SURVEY.md §7).
+    attempts = [entry["frame"] for entry in _invocations(tmp_path / "state")]
+    assert attempts.count(3) == 2, attempts
+    assert len(attempts) == frames + 1
+
+
+def _cluster_env(tmp_path: Path) -> dict:
+    return {
+        **os.environ,
+        "TRC_FAKE_BLENDER_FAIL_ONCE_FRAMES": "3",
+        "TRC_FAKE_BLENDER_STATE_DIR": str(tmp_path / "state"),
+    }
+
+
+def test_python_worker_cli_full_job_through_fake_blender(tmp_path):
+    # The real worker CLI (python -m …worker.main --backend blender) against
+    # an in-process master: full job incl. a fail-once frame.
+    _populate_base(tmp_path)
+    frames = 6
+    job = _make_job(frames=frames, workers=1)
+    _, worker_traces = asyncio.run(
+        _run_master_with_worker_process(
+            job,
+            [
+                sys.executable, "-m", "tpu_render_cluster.worker.main",
+                "--masterServerHost", "127.0.0.1",
+                "--masterServerPort", "@PORT@",
+                "--baseDirectory", str(tmp_path),
+                "--backend", "blender",
+                "--blenderBinary", str(FAKE_BLENDER),
+                # argparse needs =-form when the value itself starts with
+                # "--" (clap in the reference has the same constraint).
+                "--blenderPrependArguments=--factory-startup",
+                "--blenderAppendArguments=--verbose 1",
+            ],
+            _cluster_env(tmp_path),
+        )
+    )
+    _assert_full_job_completed(tmp_path, worker_traces, frames)
+    # Prepend/append reached the real subprocess through the CLI tier too.
+    argv = _invocations(tmp_path / "state")[0]["argv"]
+    assert argv[0] == "--factory-startup" and argv[-2:] == ["--verbose", "1"]
+
+
+def test_cpp_worker_blender_backend_full_job(tmp_path):
+    # The C++ daemon's blender branch (native/worker_daemon.cpp render_frame)
+    # driving fake-blender: full job incl. the errored-event reschedule.
+    if shutil.which("g++") is None:
+        pytest.skip("g++ unavailable")
+    from tpu_render_cluster.native import build_worker_daemon
+
+    daemon = build_worker_daemon()
+    assert daemon is not None, "worker daemon failed to compile"
+    _populate_base(tmp_path)
+    frames = 6
+    job = _make_job(frames=frames, workers=1)
+    _, worker_traces = asyncio.run(
+        _run_master_with_worker_process(
+            job,
+            [
+                str(daemon),
+                "--masterServerHost", "127.0.0.1",
+                "--masterServerPort", "@PORT@",
+                "--baseDirectory", str(tmp_path),
+                "--backend", "blender",
+                "--blenderBinary", str(FAKE_BLENDER),
+                "-p", "--factory-startup",
+                "-a", "--verbose 1",
+            ],
+            _cluster_env(tmp_path),
+        )
+    )
+    _assert_full_job_completed(tmp_path, worker_traces, frames)
+    # Phase scrape parity: saving duration subtracted from render-end, so
+    # rendering strictly precedes saving in every trace.
+    for _, trace in worker_traces:
+        for frame in trace.frame_render_traces:
+            details = frame.details
+            assert details.finished_rendering_at <= details.file_saving_started_at
+            assert details.file_saving_started_at < details.file_saving_finished_at

@@ -121,6 +121,32 @@ def test_obj_errors():
         load_obj(os.devnull)
 
 
+def test_load_obj_forward_references(tmp_path):
+    # Spec-legal OBJ whose `f` statements absolutely reference `v` lines
+    # that appear LATER in the file (ADVICE round-4: the single-pass loader
+    # rejected these). Negative indices stay relative to the vertex count
+    # at the f statement, so -1 here is vertex 1.
+    path = tmp_path / "forward.obj"
+    path.write_text(
+        "v 0 0 0\n"
+        "f 1 2 3\n"  # 2 and 3 are not defined yet
+        "f -1 2 4\n"  # -1 -> vertex 1 (count at this statement is 1)
+        "v 1 0 0\n"
+        "v 0 1 0\n"
+        "v 0 0 1\n"
+    )
+    vertices, faces = load_obj(path)
+    assert vertices.shape == (4, 3)
+    assert faces.tolist() == [[0, 1, 2], [0, 1, 3]]
+
+
+def test_load_obj_out_of_range_forward_reference_still_fatal(tmp_path):
+    path = tmp_path / "broken.obj"
+    path.write_text("v 0 0 0\nv 1 0 0\nv 0 1 0\nf 1 2 9\n")
+    with pytest.raises(ValueError, match="out of range"):
+        load_obj(path)
+
+
 def test_cli_obj_turntable(tmp_path):
     from tpu_render_cluster.render import cli
 

@@ -1,0 +1,166 @@
+"""Deterministic unit tests of the tpu-batch scheduler's decision math.
+
+VERDICT round-4 item 4: the heterogeneous-cluster e2e test asserted
+wall-clock margins of tens of ms, which flakes under CI load. The decision
+*structure* that test was really after lives in pure functions — the joint
+cost model, the cost matrix + auction routing, and the makespan gate — so
+it is pinned here with zero sleeping and zero sockets. The e2e test keeps
+only coarse, load-tolerant assertions.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tpu_render_cluster.master.tpu_batch import (
+    FrameComplexityModel,
+    JointCostModel,
+    WorkerCostModel,
+    build_cost_matrix,
+    makespan_horizon,
+)
+from tpu_render_cluster.ops.assignment import solve_assignment
+
+FAST, SLOW = 1, 2
+
+
+def _converged_model(ramp=lambda f: 1.0 + f / 10.0) -> JointCostModel:
+    """Feed the joint model an 8x speed gap over a complexity ramp,
+    alternating workers over disjoint frames (as a real run would)."""
+    model = JointCostModel(alpha=0.5)
+    for sweep in range(6):
+        for frame in range(1, 37):
+            worker = FAST if (frame + sweep) % 2 else SLOW
+            seconds = (0.010 if worker == FAST else 0.080) * ramp(frame)
+            model.observe(worker, frame, seconds)
+    return model
+
+
+def test_joint_model_recovers_speed_ratio_and_ramp():
+    model = _converged_model()
+    fast = model.worker_speed.predict(FAST)
+    slow = model.worker_speed.predict(SLOW)
+    assert slow / fast == pytest.approx(8.0, rel=0.15)
+    # Complexity ramp recovered up to scale: frame 30 vs frame 10 is ideally
+    # (1+3.0)/(1+1.0) = 2.0x; the alternating joint update leaves some ramp
+    # absorbed in the speed EMAs, so accept a generous band — the routing
+    # only needs the ordering and rough magnitude.
+    ratio = model.frame_complexity.predict(30) / model.frame_complexity.predict(10)
+    assert 1.4 < ratio < 2.6
+    # Monotone in frame index (the ramp's shape).
+    predictions = [model.frame_complexity.predict(f) for f in (5, 15, 25, 35)]
+    assert predictions == sorted(predictions)
+
+
+def test_complexity_interpolates_unseen_frames():
+    model = FrameComplexityModel(alpha=1.0)
+    model.observe(10, 2.0)
+    model.observe(20, 4.0)
+    assert model.predict(15) == pytest.approx(3.0)
+    assert model.predict(5) == pytest.approx(2.0)  # edge: nearest neighbor
+    assert model.predict(25) == pytest.approx(4.0)
+
+
+class _StubQueue(list):
+    def all_frames(self):
+        return list(self)
+
+
+class _StubWorker:
+    def __init__(self, worker_id: int, queue_length: int = 0) -> None:
+        self.worker_id = worker_id
+        self.queue = _StubQueue([None] * queue_length)
+
+
+def test_auction_routes_heavy_frames_to_fast_worker():
+    # Two frames, one slot on each worker: the auction must put the heavy
+    # frame on the fast worker and the light one on the slow worker — the
+    # routing the e2e test observed only statistically.
+    speed = WorkerCostModel(alpha=1.0)
+    speed.observe(FAST, 0.010)
+    speed.observe(SLOW, 0.080)
+    fast_worker, slow_worker = _StubWorker(FAST), _StubWorker(SLOW)
+    slots = [(fast_worker, 0), (slow_worker, 0)]
+    frames = [30, 2]  # heavy, light
+    complexity = {30: 4.0, 2: 1.2}
+    cost = build_cost_matrix(frames, slots, speed, frame_complexity=complexity)
+    assert cost.shape == (2, 2)
+    # cost[i, j] = (queue + position + 1) * speed[j] * complexity[i]
+    assert cost[0, 0] == pytest.approx(0.010 * 4.0)
+    assert cost[0, 1] == pytest.approx(0.080 * 4.0)
+    assignment = solve_assignment(cost)
+    assert int(assignment[0]) == 0, "heavy frame -> fast worker"
+    assert int(assignment[1]) == 1, "light frame -> slow worker"
+
+
+def test_deeper_queue_raises_slot_cost():
+    speed = WorkerCostModel(alpha=1.0)
+    speed.observe(FAST, 0.010)
+    busy = _StubWorker(FAST, queue_length=3)
+    idle = _StubWorker(FAST, queue_length=0)
+    cost = build_cost_matrix([1], [(busy, 0), (idle, 0)], speed)
+    assert cost[0, 0] == pytest.approx(4 * 0.010)
+    assert cost[0, 1] == pytest.approx(1 * 0.010)
+
+
+def test_makespan_gate_keeps_slow_worker_off_the_tail():
+    # End-of-job scenario: 2 pending frames of complexity 1.0, fast worker
+    # (0.01 s/unit) has an empty queue, slow worker (0.08 s/unit) too.
+    # Putting a frame on the slow worker completes at 0.08 s, but the rest
+    # of the cluster (the fast worker) can drain the remaining pool in
+    # 0.01 s + slack 0.01 s = 0.02 s -> gate must REFUSE the slow worker.
+    fast_speed, slow_speed = 0.010, 0.080
+    pool_units_after = 1.0  # one other pending frame
+    horizon_slow = makespan_horizon(
+        rest_units=pool_units_after,
+        others_rate=1.0 / fast_speed,
+        fastest_speed=fast_speed,
+        frame_complexity=1.0,
+    )
+    slow_completion = 1 * slow_speed * 1.0
+    assert slow_completion > horizon_slow, "slow worker would become the tail"
+
+    # The fast worker's own front slot always passes (the strategy's
+    # forced-progress invariant): completion 0.01 <= rest-drain via slow
+    # (0.08) + slack.
+    horizon_fast = makespan_horizon(
+        rest_units=pool_units_after,
+        others_rate=1.0 / slow_speed,
+        fastest_speed=fast_speed,
+        frame_complexity=1.0,
+    )
+    assert 1 * fast_speed * 1.0 <= horizon_fast
+
+
+def test_makespan_gate_feeds_slow_worker_while_pool_is_deep():
+    # Mid-job: 100 frames pending. The slow worker finishes one frame in
+    # 0.08 s while the fast worker needs ~1 s for the rest -> the gate must
+    # ALLOW the slow worker to keep contributing (utilization), only the
+    # tail is protected.
+    horizon = makespan_horizon(
+        rest_units=99.0,
+        others_rate=1.0 / 0.010,
+        fastest_speed=0.010,
+        frame_complexity=1.0,
+    )
+    assert 1 * 0.080 * 1.0 <= horizon
+
+
+def test_makespan_gate_sole_worker_never_starves():
+    # Degenerate cluster of one: others_rate == 0 -> infinite horizon, every
+    # assignment passes (a gate that starves a 1-worker cluster hangs the
+    # job forever).
+    horizon = makespan_horizon(
+        rest_units=10.0, others_rate=0.0, fastest_speed=0.05, frame_complexity=2.0
+    )
+    assert horizon == float("inf")
+
+
+def test_cold_start_has_flat_complexity_and_default_speed():
+    model = JointCostModel(alpha=0.5)
+    assert model.frame_complexity.predict(123) == 1.0
+    assert model.frame_complexity.mean_observed() == 1.0
+    from tpu_render_cluster.master.tpu_batch import DEFAULT_FRAME_TIME_GUESS
+
+    assert model.worker_speed.predict(99) == DEFAULT_FRAME_TIME_GUESS

@@ -188,17 +188,20 @@ class ClusterManager:
             # Compile the auction kernel while workers connect so the first
             # scheduling tick doesn't pay XLA compilation inside the job.
             from tpu_render_cluster.master.tpu_batch import (
-                MAX_SLOTS_PER_TICK,
                 RATE_TARGET_CAP,
+                scaled_slot_cap,
             )
             from tpu_render_cluster.ops.assignment import warmup
 
             assert strategy.tpu_batch is not None
-            max_slots = min(
-                MAX_SLOTS_PER_TICK,
-                max(strategy.tpu_batch.target_queue_size, RATE_TARGET_CAP)
-                * max(1, target),
-            )
+            # Warm up to the tick loop's scaled slot cap — warming only
+            # MAX_SLOTS_PER_TICK would clamp >64-worker clusters back to
+            # 128 slots/tick — bounded by the cluster's actual slot demand
+            # (target-or-rate-cap per worker).
+            demand_bound = max(
+                strategy.tpu_batch.target_queue_size, RATE_TARGET_CAP
+            ) * max(1, target)
+            max_slots = min(scaled_slot_cap(target), demand_bound)
             warmup_task = asyncio.create_task(asyncio.to_thread(warmup, max_slots))
         try:
             while len(self.workers) < target:

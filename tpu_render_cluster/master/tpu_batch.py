@@ -195,6 +195,34 @@ def build_cost_matrix(
     return cost
 
 
+def scaled_slot_cap(worker_count: int) -> int:
+    """Per-tick slot budget for a cluster of ``worker_count`` workers.
+
+    A fixed cap becomes the assignment throughput ceiling on many-worker
+    clusters. Shared by the tick loop (which clamps it to the warmed
+    auction buckets) and the ClusterManager's barrier-time warmup (which
+    must compile buckets covering it, or warmed_max_slots() clamps the
+    tick right back to the fixed cap)."""
+    return max(MAX_SLOTS_PER_TICK, 2 * max(1, worker_count))
+
+
+def makespan_horizon(
+    rest_units: float, others_rate: float, fastest_speed: float, frame_complexity: float
+) -> float:
+    """Latest acceptable completion time for a candidate assignment.
+
+    ``rest_units`` is everything the REST of the cluster still has to chew
+    through (pending pool + other queues, in complexity units) and
+    ``others_rate`` their combined rate; an assignment whose predicted
+    completion exceeds this drain window (plus one fastest-worker frame of
+    slack) would make its worker the job's tail, so the gate skips it.
+    Pure so the gate's decision structure is unit-testable without a
+    cluster (tests/test_tpu_batch_model.py).
+    """
+    rest_seconds = rest_units / others_rate if others_rate > 0 else float("inf")
+    return rest_seconds + fastest_speed * frame_complexity
+
+
 def _as_dynamic_options(options: TpuBatchStrategyOptions) -> DynamicStrategyOptions:
     return DynamicStrategyOptions(
         target_queue_size=options.target_queue_size,
@@ -288,10 +316,9 @@ async def tpu_batch_strategy(
         from tpu_render_cluster.ops.assignment import warmed_max_slots
 
         # Scale the per-tick budget with the cluster (C++ twin: slot_cap
-        # in tpu_batch_loop) — a fixed cap becomes the assignment
-        # throughput ceiling on many-worker clusters. Warmed auction
-        # buckets still bound it: an unwarmed size would compile mid-job.
-        slot_cap = max(MAX_SLOTS_PER_TICK, 2 * len(workers))
+        # in tpu_batch_loop). Warmed auction buckets still bound it: an
+        # unwarmed size would compile mid-job.
+        slot_cap = scaled_slot_cap(len(workers))
         if 0 < warmed_max_slots() < slot_cap:
             slot_cap = warmed_max_slots()
         del slots[slot_cap:]
@@ -373,10 +400,9 @@ async def tpu_batch_strategy(
                     rest_units = max(
                         0.0, pool_units - complexity[frame_index]
                     ) + (total_queued_units - queued_units[worker.worker_id])
-                    rest_seconds = (
-                        rest_units / others_rate if others_rate > 0 else float("inf")
+                    horizon = makespan_horizon(
+                        rest_units, others_rate, fastest_speed, complexity[frame_index]
                     )
-                    horizon = rest_seconds + fastest_speed * complexity[frame_index]
                     if cost[i, int(assignment[i])] > horizon:
                         continue  # leave pending; a better slot will open
                     state.mark_frame_as_queued(frame_index, worker.worker_id, time.time())

@@ -11,8 +11,8 @@ like the built-ins.
 
 Supported OBJ subset: `v` positions, `f` faces with any of the index
 forms (`v`, `v/vt`, `v/vt/vn`, `v//vn`), negative (relative) indices,
-polygon faces (triangulated as a fan), comments, and all other statements
-ignored (normals are recomputed per-face by `build_bvh`; materials are a
+absolute indices forward-referencing later `v` lines, polygon faces
+(triangulated as a fan), comments, and all other statements ignored (normals are recomputed per-face by `build_bvh`; materials are a
 per-instance albedo in this renderer).
 """
 
@@ -27,21 +27,12 @@ import numpy as np
 def load_obj(path: str | Path) -> tuple[np.ndarray, np.ndarray]:
     """Parse an OBJ file into (vertices [V,3] f32, faces [F,3] i32)."""
     vertices: list[tuple[float, float, float]] = []
-    faces: list[tuple[int, int, int]] = []
-
-    def resolve(token: str) -> int:
-        # "v", "v/vt", "v/vt/vn", "v//vn" -> vertex index (1-based;
-        # negative = relative to the vertices seen so far).
-        raw = token.split("/", 1)[0]
-        index = int(raw)
-        if index < 0:
-            index += len(vertices)
-            if index < 0:
-                raise ValueError(f"OBJ relative index out of range: {token}")
-            return index
-        if not 1 <= index <= len(vertices):
-            raise ValueError(f"OBJ vertex index out of range: {token}")
-        return index - 1
+    # Faces are collected as raw tokens and resolved only after the whole
+    # file is read: absolute indices may legally forward-reference `v`
+    # lines that appear later. Negative (relative) indices are resolved
+    # against the vertex count AT the `f` statement, per the OBJ spec, so
+    # that count is recorded alongside the tokens.
+    pending_faces: list[tuple[int, int, list[str]]] = []
 
     with open(path, encoding="utf-8", errors="replace") as handle:
         for line_number, line in enumerate(handle, 1):
@@ -64,10 +55,32 @@ def load_obj(path: str | Path) -> tuple[np.ndarray, np.ndarray]:
                     raise ValueError(
                         f"{path}:{line_number}: face needs >=3 vertices"
                     )
-                ring = [resolve(token) for token in parts[1:]]
-                for i in range(1, len(ring) - 1):  # fan triangulation
-                    faces.append((ring[0], ring[i], ring[i + 1]))
+                pending_faces.append((line_number, len(vertices), parts[1:]))
             # vn/vt/o/g/s/usemtl/mtllib: ignored (see module docstring).
+
+    def resolve(token: str, line_number: int, vertex_count_at_face: int) -> int:
+        # "v", "v/vt", "v/vt/vn", "v//vn" -> vertex index (1-based;
+        # negative = relative to the vertices seen up to the f statement).
+        raw = token.split("/", 1)[0]
+        index = int(raw)
+        if index < 0:
+            index += vertex_count_at_face
+            if index < 0:
+                raise ValueError(
+                    f"{path}:{line_number}: OBJ relative index out of range: {token}"
+                )
+            return index
+        if not 1 <= index <= len(vertices):
+            raise ValueError(
+                f"{path}:{line_number}: OBJ vertex index out of range: {token}"
+            )
+        return index - 1
+
+    faces: list[tuple[int, int, int]] = []
+    for line_number, vertex_count_at_face, tokens in pending_faces:
+        ring = [resolve(token, line_number, vertex_count_at_face) for token in tokens]
+        for i in range(1, len(ring) - 1):  # fan triangulation
+            faces.append((ring[0], ring[i], ring[i + 1]))
 
     if not vertices or not faces:
         raise ValueError(f"{path}: no triangles found")
