@@ -354,6 +354,11 @@ class AssignmentService {
             assignment->clear();
             for (const Json& v : result->arr)
                 assignment->push_back(int(v.as_i64()));
+            // The service piggybacks its cumulative auction-non-convergence
+            // count on every response (assignment_service.py).
+            const Json* fallbacks = parsed.get("greedy_fallbacks");
+            if (fallbacks != nullptr)
+                service_greedy_fallbacks_ = fallbacks->as_u64();
             return true;
         }
         return false;
@@ -392,7 +397,14 @@ class AssignmentService {
 
     bool ready() const { return ready_ && !dead_; }
 
+    // Auction non-convergence fallbacks inside the service (cumulative
+    // since its warmup), as last reported.
+    uint64_t service_greedy_fallbacks() const {
+        return service_greedy_fallbacks_;
+    }
+
   private:
+    uint64_t service_greedy_fallbacks_ = 0;
     pid_t pid_ = -1;
     int write_fd_ = -1;
     int read_fd_ = -1;
@@ -741,6 +753,11 @@ class MasterDaemon {
     std::map<uint64_t, PendingAdd> pending_adds_;
 
     AssignmentService assignment_;
+    // tpu-batch telemetry for the processed-results "scheduler" section:
+    // greedy fallbacks with the service UP (silent degradation — must be 0
+    // in healthy runs) vs expected cold-start ticks before it warmed.
+    uint64_t scheduler_greedy_fallbacks_ = 0;
+    uint64_t scheduler_coldstart_greedy_ticks_ = 0;
     struct CompletionObservation {
         uint32_t worker_id;
         int frame_index;
@@ -1929,10 +1946,22 @@ class MasterDaemon {
                     }
 
                     std::vector<int> result;
+                    bool service_up = assignment_.ready();
                     bool solver_ok = assignment_.solve(cost, &result) &&
                                      result.size() == frames.size();
                     if (!solver_ok) {
                         result = greedy_assignment(cost);
+                        // Telemetry split: a tick greedy-solved because the
+                        // service wasn't warm yet is expected at startup; a
+                        // fallback with the service UP means the solve
+                        // failed/timed out and the "TPU scheduler" silently
+                        // degraded — surfaced in processed-results and
+                        // asserted zero in the northstar populations.
+                        if (service_up) {
+                            scheduler_greedy_fallbacks_++;
+                        } else {
+                            scheduler_coldstart_greedy_ticks_++;
+                        }
                     }
 
                     // Makespan-balance gate (unit-consistent complexity
@@ -2258,6 +2287,14 @@ class MasterDaemon {
             performance.set(pair.first, std::move(reduced));
         }
         processed.set("worker_performance", std::move(performance));
+        Json scheduler = Json::make_object();
+        scheduler.set(
+            "auction_greedy_fallbacks",
+            Json::make_uint(scheduler_greedy_fallbacks_ +
+                            assignment_.service_greedy_fallbacks()));
+        scheduler.set("coldstart_greedy_ticks",
+                      Json::make_uint(scheduler_coldstart_greedy_ticks_));
+        processed.set("scheduler", std::move(scheduler));
         std::string processed_path = prefix + "_processed-results.json";
         write_file(processed_path, json_dumps(processed));
         double duration = job_finish_time_ - job_start_time_;

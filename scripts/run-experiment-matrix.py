@@ -11,14 +11,18 @@ the canonical results/cluster-runs directory):
   04_very-simple 14400-frame matrix, shrunk to laptop scale — reference:
   analysis/results_statistics.py:34-73 counts the same strategy x size
   populations).
-- ``northstar-baseline`` — 1-worker eager-naive-coarse job with the
-  tpu-raytrace backend forced onto CPU: the stand-in for the reference's
-  1-worker CPU Blender baseline (BASELINE.md "Sequential baseline").
-- ``northstar-tpu``      — the north-star config: 10-frame 04_very-simple
-  job, tpu-batch scheduler + tpu-raytrace workers on the TPU chip.
-- ``all``                — orchestrates the three above as subprocesses
-  with the right JAX_PLATFORMS per suite, then runs the analysis pipeline
-  over each result set.
+- ``northstar-mp``       — the RECORDED north-star configuration: master
+  and every worker as separate OS processes (the reference's deployment
+  shape), covering the CPU baseline, the 10f/64f tpu-batch+tpu-raytrace
+  runs, and the mesh/scene sweeps.
+- ``colocated-diagnostic-{baseline,tpu}`` — single-process colocated
+  harness, DIAGNOSTIC ONLY: shared event-loop/GIL contention caps its
+  utilization ~35 points below the multi-process truth, so its outputs
+  land under ``<results>/colocated-diagnostic/`` and are never part of
+  the recorded populations.
+- ``all``                — mock + northstar-mp as subprocesses with the
+  right JAX_PLATFORMS per suite, then the analysis pipeline over each
+  recorded result set.
 
 The render jit cache is pre-warmed before the timed job (both baseline and
 TPU pay compilation equally outside the measured window), mirroring how the
@@ -366,6 +370,20 @@ def run_northstar_multiprocess(
                         proc.kill()
                 if master.poll() is None:
                     master.kill()
+            # Northstar populations must never run on the silent greedy
+            # fallback: a nonzero count means "TPU scheduler" numbers were
+            # actually host-greedy numbers (VERDICT round-4 weak #5).
+            newest = max(
+                results_directory.glob("*_processed-results.json"),
+                key=lambda p: p.stat().st_mtime,
+            )
+            fallbacks = json.loads(newest.read_text())["scheduler"][
+                "auction_greedy_fallbacks"
+            ]
+            if fallbacks != 0:
+                raise RuntimeError(
+                    f"auction degraded to greedy {fallbacks}x in {newest}"
+                )
 
     # 1-worker CPU baseline with the identical process topology.
     for repeat in range(max(2, repeats - 1) if only is None else 0):
@@ -445,10 +463,13 @@ def run_all(results_root: Path, repeats: int) -> int:
             env.pop("JAX_PLATFORMS", None)  # let the plugin pick the chip
         return env
 
+    # Every RECORDED suite is multi-process (the reference's deployment
+    # shape and the configuration the NORTHSTAR.md claims are measured
+    # on). The colocated harness is NOT part of the default matrix — it
+    # under-reports utilization by ~35 points (event-loop/GIL contention
+    # between frames) and exists only as an explicitly-named diagnostic.
     suites = [
         ("mock", "cpu"),
-        ("northstar-baseline", "cpu"),
-        ("northstar-tpu", "tpu"),
         ("northstar-mp", "cpu"),  # orchestrator only; workers pick their own
     ]
     for suite, platform in suites:
@@ -476,12 +497,18 @@ def run_all(results_root: Path, repeats: int) -> int:
     analysis_root = results_root.parent / "analysis"
     for name in (
         "mock-matrix",
+        # Colocated diagnostic populations (northstar-10f,
+        # northstar-util-64f) are only regenerated when their committed
+        # traces are present — the default matrix no longer records them.
         "northstar-10f",
         "northstar-util-64f",
         "northstar-mp-10f",
         "northstar-mp-64f",
         "mesh-mp-24f",
     ):
+        if not (results_root / name).is_dir():
+            print(f"[analysis] skipping {name}: no recorded traces", flush=True)
+            continue
         rc = analysis.main(
             [
                 "--results",
@@ -500,7 +527,21 @@ def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--suite",
-        choices=["mock", "northstar-baseline", "northstar-tpu", "northstar-mp", "northstar-mp-tpu", "mesh-mp", "scenes-mp", "all"],
+        choices=[
+            "mock",
+            "northstar-mp",
+            "northstar-mp-tpu",
+            "mesh-mp",
+            "scenes-mp",
+            # Colocated (single-process) harness: DIAGNOSTIC ONLY. Its
+            # utilization numbers are capped ~35 points below the
+            # multi-process truth by shared event-loop/GIL contention;
+            # outputs land under <results>/colocated-diagnostic/ so they
+            # can never be mistaken for the recorded populations.
+            "colocated-diagnostic-baseline",
+            "colocated-diagnostic-tpu",
+            "all",
+        ],
         default="all",
     )
     parser.add_argument("--results", default=None)
@@ -532,10 +573,14 @@ def main() -> int:
     if args.suite == "scenes-mp":
         run_northstar_multiprocess(results_root, args.repeats, only="scenes")
         return 0
-    if args.suite == "northstar-baseline":
-        run_northstar(results_root, max(2, args.repeats - 1), tpu=False)
+    if args.suite == "colocated-diagnostic-baseline":
+        run_northstar(
+            results_root / "colocated-diagnostic", max(2, args.repeats - 1),
+            tpu=False,
+        )
         return 0
-    run_northstar(results_root, args.repeats, tpu=True)
+    assert args.suite == "colocated-diagnostic-tpu"
+    run_northstar(results_root / "colocated-diagnostic", args.repeats, tpu=True)
     return 0
 
 

@@ -57,6 +57,13 @@ min_queue_size_to_steal = 2
 min_seconds_before_resteal_to_elsewhere = 1
 min_seconds_before_resteal_to_original_worker = 2"""
 
+# Reference sequential-baseline semantics: 1 worker, eager-naive-coarse
+# with a deep queue (reference BASELINE.md "Strategies measured": tqs=100
+# for 1w; speedup = mean 1w time / mean parallel time,
+# reference analysis/speedup.py:35-40).
+BASELINE_1W = """strategy_type = "eager-naive-coarse"
+target_queue_size = 100"""
+
 
 def free_port() -> int:
     with socket.socket() as s:
@@ -247,9 +254,14 @@ def run_one(strategy_name: str, strategy_lines: str, scratch: Path,
 
 
 def main() -> int:
-    global WORKERS, MOCK_MS
+    global WORKERS, MOCK_MS, FRAMES
     parser = argparse.ArgumentParser()
     parser.add_argument("--out", default=None)
+    parser.add_argument(
+        "--frames", type=int, default=FRAMES,
+        help="frame count (default: the reference's 14400; smaller values "
+        "are for smoke-testing the harness itself)",
+    )
     parser.add_argument(
         "--workers", type=int, default=WORKERS,
         help="cluster size (reference sizes: 1,5,10,20,40,80)",
@@ -267,25 +279,48 @@ def main() -> int:
     parser.add_argument(
         "--killAfter", dest="kill_after", type=float, default=3.0,
     )
+    parser.add_argument(
+        "--with-baseline", action="store_true",
+        help="also run the 1-worker eager-naive-coarse sequential baseline "
+        "(same frames x mock_ms workload) and write the full analysis "
+        "statistics — incl. speedup/efficiency — for this population "
+        "under results/analysis/scale-14400f-<W>w/. The baseline leg "
+        "takes 14400 * mockRenderMs of real time.",
+    )
     args = parser.parse_args()
     WORKERS = args.workers
     MOCK_MS = args.mock_ms
+    FRAMES = args.frames
     if args.kill and not 0 < args.kill < WORKERS:
         parser.error(
             f"--kill must leave at least one survivor (0 < kill < {WORKERS})"
         )
     if args.out is None:
-        args.out = f"results/cluster-runs/scale-14400f-{WORKERS}w"
+        # The frame count is part of the population name so a smoke run
+        # (--frames 120) can never overwrite the recorded 14400-frame
+        # populations or their analysis directories.
+        args.out = f"results/cluster-runs/scale-{FRAMES}f-{WORKERS}w"
     out_dir = REPO_ROOT / args.out
     out_dir.mkdir(parents=True, exist_ok=True)
 
     scratch = Path(tempfile.mkdtemp(prefix="trc-scale-"))
     summaries = []
     try:
-        for name, lines in (("dynamic", DYNAMIC), ("tpu-batch", TPU_BATCH)):
-            print(f"=== {name}: {FRAMES}f x {WORKERS}w ===", flush=True)
+        runs = [("dynamic", DYNAMIC), ("tpu-batch", TPU_BATCH)]
+        if args.with_baseline:
+            # The sequential baseline that makes speedup/efficiency
+            # computable for this population (same frames x mock_ms
+            # workload on ONE worker — 14400 * mock_ms seconds of real
+            # time, so this is the long leg of the run).
+            runs.append(("eager-naive-coarse-1w-baseline", BASELINE_1W))
+        for name, lines in runs:
+            baseline_run = name.endswith("1w-baseline")
+            cluster = 1 if baseline_run else args.workers
+            WORKERS = cluster  # run_one/write_job read the global
+            print(f"=== {name}: {FRAMES}f x {cluster}w ===", flush=True)
             summary = run_one(
-                name, lines, scratch, kill=args.kill,
+                name, lines, scratch,
+                kill=0 if baseline_run else args.kill,
                 kill_after=args.kill_after,
             )
             print(json.dumps(
@@ -300,6 +335,33 @@ def main() -> int:
                     processed[0], out_dir / f"{name}_{processed[0].name}"
                 )
             summaries.append(summary)
+
+        if args.with_baseline and not args.kill:
+            # With the 1w baseline in the same trace population, the full
+            # analysis pipeline produces non-empty speedup/efficiency for
+            # this cluster size (reference analysis/speedup.py:35-40
+            # semantics). Raw 14400-frame traces stay in scratch; only the
+            # computed statistics/plots are committed.
+            from tpu_render_cluster.analysis import run_all as analysis
+
+            canonical = REPO_ROOT / "results" / "cluster-runs"
+            if out_dir.parent == canonical:
+                analysis_out = REPO_ROOT / "results" / "analysis" / out_dir.name
+            else:  # smoke-test runs keep their analysis next to their out
+                analysis_out = out_dir / "analysis"
+            rc = analysis.main(
+                ["--results", str(scratch), "--out", str(analysis_out)]
+            )
+            assert rc == 0, "analysis pipeline failed on the scale traces"
+            stats = json.loads((analysis_out / "statistics.json").read_text())
+            assert stats["speedup"], (
+                "speedup must populate once the 1w baseline is present"
+            )
+            print(
+                f"analysis -> {analysis_out} "
+                f"(speedup keys: {list(stats['speedup'])})",
+                flush=True,
+            )
     finally:
         shutil.rmtree(scratch, ignore_errors=True)
 

@@ -400,6 +400,13 @@ target_queue_size = 3
     trace_path = next(results.glob("*_raw-trace.json"))
     data = json.loads(trace_path.read_text())
     assert len(data["worker_traces"]) == 1
+    # The master CLI's processed results carry the scheduler-telemetry
+    # section (auction fallbacks are trivially 0 for non-tpu-batch runs,
+    # but the field must be present — VERDICT round-4 weak #5).
+    processed = json.loads(
+        next(results.glob("*_processed-results.json")).read_text()
+    )
+    assert processed["scheduler"]["auction_greedy_fallbacks"] == 0
 
 
 def test_dead_worker_is_evicted_and_frames_requeue(monkeypatch):

@@ -64,10 +64,21 @@ def run_and_persist(
     timeout: float = 600.0,
 ) -> Path:
     """Run and write ``*_raw-trace.json`` + processed results; returns the raw path."""
+    from tpu_render_cluster.ops import assignment as assignment_ops
+
     start = datetime.now()
+    assignment_ops.reset_greedy_fallback_count()
     master_trace, worker_traces = run_local_job(job, backends, timeout=timeout)
     results_directory = Path(results_directory)
     raw_path = save_raw_traces(start, job, results_directory, master_trace, worker_traces)
     performance = parse_worker_traces(worker_traces)
-    save_processed_results(start, job, results_directory, performance)
+    save_processed_results(
+        start,
+        job,
+        results_directory,
+        performance,
+        scheduler_stats={
+            "auction_greedy_fallbacks": assignment_ops.greedy_fallback_count(),
+        },
+    )
     return raw_path

@@ -34,7 +34,11 @@ import sys
 def main() -> int:
     import numpy as np
 
-    from tpu_render_cluster.ops.assignment import solve_assignment
+    from tpu_render_cluster.ops.assignment import (
+        greedy_fallback_count,
+        reset_greedy_fallback_count,
+        solve_assignment,
+    )
 
     # Warm the solver across shape buckets so scheduling ticks never absorb
     # an XLA compile: solve_assignment pads to square power-of-two buckets
@@ -43,6 +47,8 @@ def main() -> int:
     for bucket in (8, 16, 32, 64, 128):
         warmup = np.ones((bucket // 2, bucket), dtype=np.float32)
         solve_assignment(warmup)
+    # Warmup solves don't count toward the job's fallback telemetry.
+    reset_greedy_fallback_count()
     sys.stdout.write(json.dumps({"ready": True}) + "\n")
     sys.stdout.flush()
 
@@ -65,8 +71,17 @@ def main() -> int:
             sys.stdout.flush()
             continue
         assignment = solve_assignment(cost)
+        # Cumulative non-convergence fallback count rides every response so
+        # the C++ master can surface it in its processed-results scheduler
+        # section without an extra request.
         sys.stdout.write(
-            json.dumps({"id": request_id, "assignment": [int(s) for s in assignment]})
+            json.dumps(
+                {
+                    "id": request_id,
+                    "assignment": [int(s) for s in assignment],
+                    "greedy_fallbacks": greedy_fallback_count(),
+                }
+            )
             + "\n"
         )
         sys.stdout.flush()

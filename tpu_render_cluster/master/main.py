@@ -79,14 +79,31 @@ async def run_job_command(args: argparse.Namespace) -> int:
             trace = MasterTrace(job_start_time=now, job_finish_time=now)
             results_directory = Path(args.results_directory)
             save_raw_traces(start_time, job, results_directory, trace, [])
-            save_processed_results(start_time, job, results_directory, [])
+            # Keep the scheduler section present on every processed-results
+            # file (consumers index it unconditionally); a fully-resumed
+            # job scheduled nothing, so the count is trivially zero.
+            save_processed_results(
+                start_time, job, results_directory, [],
+                scheduler_stats={"auction_greedy_fallbacks": 0},
+            )
             return 0
+    from tpu_render_cluster.ops import assignment as assignment_ops
+
+    assignment_ops.reset_greedy_fallback_count()
     master_trace, worker_traces = await manager.initialize_server_and_run_job()
 
     results_directory = Path(args.results_directory)
     save_raw_traces(start_time, job, results_directory, master_trace, worker_traces)
     performance = parse_worker_traces(worker_traces)
-    save_processed_results(start_time, job, results_directory, performance)
+    save_processed_results(
+        start_time,
+        job,
+        results_directory,
+        performance,
+        scheduler_stats={
+            "auction_greedy_fallbacks": assignment_ops.greedy_fallback_count(),
+        },
+    )
     print_results(master_trace, performance)
     return 0
 

@@ -67,6 +67,7 @@ def save_processed_results(
     job: BlenderJob,
     output_directory: Path,
     worker_performance: list[tuple[str, WorkerPerformance]],
+    scheduler_stats: dict | None = None,
 ) -> Path:
     output_directory.mkdir(parents=True, exist_ok=True)
     path = output_directory / f"{_file_prefix(start_time, job)}_processed-results.json"
@@ -75,6 +76,12 @@ def save_processed_results(
             name: performance.to_dict() for name, performance in worker_performance
         }
     }
+    if scheduler_stats is not None:
+        # e.g. {"auction_greedy_fallbacks": 0} — how often the tpu-batch
+        # auction degraded to the greedy host solve this job (the C++
+        # master writes the same section; asserted zero in the northstar
+        # populations).
+        payload["scheduler"] = scheduler_stats
     path.write_text(json.dumps(payload, indent=2), encoding="utf-8")
     logger.info("Processed results saved to %s", path)
     return path

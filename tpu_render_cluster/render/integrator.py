@@ -53,16 +53,35 @@ def _cosine_sample_hemisphere(normals, key):
 
 
 def _shade_bounce(scene: Scene, carry, key, mesh=None):
-    origins, directions, throughput, radiance, alive = carry
+    """One bounce; returns the new path state and this bounce's radiance
+    CONTRIBUTION (not accumulated — the caller owns accumulation, which
+    under re-sorting travels with the lane and unsorts once at the
+    end)."""
+    origins, directions, throughput, alive = carry
+    radiance = jnp.zeros_like(throughput)
     t, sphere_index, is_plane = intersect_scene(scene, origins, directions)
     mesh_closer = None
     if mesh is not None:
         from tpu_render_cluster.render.mesh import intersect_instances
 
-        t_mesh, mesh_normals, mesh_albedo = intersect_instances(
-            mesh.bvh, mesh.instances, origins, directions
+        # Dead lanes contribute nothing but would still drive the packet
+        # walks with stale rays; replace them with guaranteed-miss rays so
+        # blocks of compacted dead lanes (see _ray_sort_order) cull every
+        # instance at the top level.
+        mesh_origins = jnp.where(alive[:, None], origins, 1e7)
+        mesh_directions = jnp.where(
+            alive[:, None],
+            directions,
+            jnp.array([0.0, 1.0, 0.0], jnp.float32)[None, :],
         )
-        mesh_closer = t_mesh < t
+        # Seeding with the sphere/plane t culls mesh-instance walks the
+        # known hit already beats; a mesh miss returns t_mesh == t, which
+        # the strict < below reads as "not closer".
+        t_mesh, mesh_normals, mesh_albedo = intersect_instances(
+            mesh.bvh, mesh.instances, mesh_origins, mesh_directions,
+            init_t=jnp.where(alive, t, INF),
+        )
+        mesh_closer = alive & (t_mesh < t)
         t = jnp.minimum(t, t_mesh)
         is_plane = is_plane & ~mesh_closer
     hit = t < INF
@@ -105,8 +124,15 @@ def _shade_bounce(scene: Scene, carry, key, mesh=None):
     if mesh is not None:
         from tpu_render_cluster.render.mesh import occluded_instances
 
-        in_shadow = in_shadow | occluded_instances(
-            mesh.bvh, mesh.instances, shadow_origin, sun_dir
+        # Lanes whose shadow result can't matter stop driving the mesh
+        # walks (the result folds the mask back in): already shadowed by
+        # the sphere any-hit, dead, or facing away from the sun (their
+        # direct term is zero regardless — cos_sun clamps to 0). The
+        # spurious True for masked lanes is harmless because every use of
+        # in_shadow is multiplied by cos_sun * alive.
+        in_shadow = occluded_instances(
+            mesh.bvh, mesh.instances, shadow_origin, sun_dir,
+            already=in_shadow | ~alive | (cos_sun <= 0.0),
         )
     direct = (
         albedo
@@ -123,6 +149,72 @@ def _shade_bounce(scene: Scene, carry, key, mesh=None):
     origins = jnp.where(alive[:, None], new_origins, origins)
     directions = jnp.where(alive[:, None], new_directions, directions)
     return (origins, directions, throughput, radiance, alive)
+
+
+def _ray_sort_order(origins, directions, alive, mesh=None):
+    """Coherence key: candidate instance, then Morton cell + octant.
+
+    Deep-mesh scenes walk the instanced BVH kernels in [block] packets; a
+    packet's cost is the UNION of its lanes' traversals and its top-level
+    instance cull only fires when NO lane touches the instance. Diffuse
+    bounce rays scatter lanes all over the scene, so packets degrade to
+    worst-case. Sorting each bounce's rays by (candidate instance, origin
+    cell, direction octant) re-packs blocks into packets that (a) mostly
+    want the SAME instance first — its walk then seeds tight per-lane
+    best-t that culls the rest — and (b) are spatially/directionally
+    coherent. Lane order is semantically free (each lane is an
+    independent path; the caller unsorts at the end).
+    """
+    candidate = jnp.zeros((origins.shape[0],), jnp.uint32)
+    if mesh is not None:
+        from tpu_render_cluster.render import pallas_kernels as pk
+
+        # Shared broadphase (one fused [R, K] slab pass, ~1 ms at render
+        # ray counts): the ray's nearest-entry overlapped instance AABB,
+        # K (=instances) for rays overlapping nothing — the same helper
+        # the nearest wrapper derives its per-block candidates from.
+        table = pk._instance_table(
+            mesh.instances.rotation,
+            mesh.instances.translation,
+            mesh.instances.scale,
+            mesh.bvh.bounds_min,
+            mesh.bvh.bounds_max,
+        )
+        candidate = pk.instance_entry_candidates(
+            origins, directions, table[:, 13:16], table[:, 16:19]
+        ).astype(jnp.uint32)
+    # Quantize origin + one unit of travel: for scattered bounce origins
+    # this is origin clustering with a directional nudge; for the shared-
+    # origin primary bounce (where origin cells degenerate to one) it
+    # becomes a spatial clustering of directions on the view sphere, far
+    # finer than the 3-bit octant alone.
+    point = origins + directions
+    lo = jnp.min(point, axis=0)
+    span = jnp.maximum(jnp.max(point, axis=0) - lo, 1e-6)
+    cell = ((point - lo) / span * 31.999).astype(jnp.uint32)  # 5 bits/axis
+
+    def part1by2(v):
+        # Spread 5 bits to every 3rd position (classic Morton dilation).
+        v = (v | (v << 8)) & jnp.uint32(0x0300F)
+        v = (v | (v << 4)) & jnp.uint32(0x030C3)
+        v = (v | (v << 2)) & jnp.uint32(0x09249)
+        return v
+
+    morton = (
+        part1by2(cell[:, 0])
+        | (part1by2(cell[:, 1]) << 1)
+        | (part1by2(cell[:, 2]) << 2)
+    )
+    octant = (
+        (directions[:, 0] > 0).astype(jnp.uint32)
+        | ((directions[:, 1] > 0).astype(jnp.uint32) << 1)
+        | ((directions[:, 2] > 0).astype(jnp.uint32) << 2)
+    )
+    # Dead lanes compact to the tail: together with the dead-lane ray
+    # masking in _shade_bounce, blocks that are entirely dead cull every
+    # instance at the top level and cost almost nothing.
+    dead = (~alive).astype(jnp.uint32) << 31
+    return jnp.argsort((candidate << 25) | (morton << 3) | octant | dead)
 
 
 def trace_paths(
@@ -159,19 +251,49 @@ def trace_paths(
         # Deep scenes fall through to the XLA bounce scan below, whose
         # intersections still dispatch to the Pallas instanced kernels.
     n = origins.shape[0]
-    carry = (
-        origins,
-        directions,
-        jnp.ones((n, 3), jnp.float32),
-        jnp.zeros((n, 3), jnp.float32),
-        jnp.ones((n,), bool),
-    )
+    # Deep-mesh scenes on the Pallas path re-sort rays for packet
+    # coherence EVERY bounce (see _ray_sort_order; sorting the primary
+    # bounce too measured faster — Morton tiles beat the full-width
+    # raster strips the camera emits). Travelling state rides ONE packed
+    # [n, 12] gather incl. the accumulated radiance (six separate [n, 3]
+    # gathers measured ~3x slower: random-access cost is per-row, so
+    # packing amortizes it; a per-bounce scatter-add of contributions
+    # measured slower still), and the carried lane index unsorts the
+    # radiance once at the end. The non-Pallas scan path is
+    # order-invariant, so it skips the sort machinery entirely.
+    from tpu_render_cluster.render import pallas_kernels as _pk
+
+    resort = mesh is not None and _pk.pallas_enabled()
+    throughput = jnp.ones((n, 3), jnp.float32)
+    radiance = jnp.zeros((n, 3), jnp.float32)
+    alive = jnp.ones((n,), bool)
+    lane = jnp.arange(n, dtype=jnp.int32)
+    sorted_yet = False
     keys = jax.random.split(key, max_bounces)
 
-    def step(carry, bounce_key):
-        return _shade_bounce(scene, carry, bounce_key, mesh=mesh), None
-
-    (_, _, _, radiance, _), _ = jax.lax.scan(step, carry, keys)
+    for bounce in range(max_bounces):
+        if resort:
+            order = _ray_sort_order(origins, directions, alive, mesh=mesh)
+            packed = jnp.concatenate(
+                [origins, directions, throughput, radiance], axis=1
+            )
+            packed = packed[order]
+            origins = packed[:, 0:3]
+            directions = packed[:, 3:6]
+            throughput = packed[:, 6:9]
+            radiance = packed[:, 9:12]
+            alive = alive[order]
+            lane = lane[order]
+            sorted_yet = True
+        origins, directions, throughput, contribution, alive = _shade_bounce(
+            scene,
+            (origins, directions, throughput, alive),
+            keys[bounce],
+            mesh=mesh,
+        )
+        radiance = radiance + contribution
+    if sorted_yet:
+        radiance = jnp.zeros_like(radiance).at[lane].set(radiance)
     return radiance
 
 
@@ -228,14 +350,15 @@ def render_tile(
 
     from tpu_render_cluster.render import pallas_kernels
 
-    # Deep-walk mesh scenes keep the sequential per-sample scan: flattening
-    # interleaves independently-jittered sample streams into each ray
-    # block, which widens the packets the BVH walk culls on (measured
-    # 1.89 -> 1.64 f/s on 03_physics-2-mesh). Sphere scenes and
-    # megakernel-eligible meshes have no such coherence cliff.
-    flatten_samples = pallas_kernels.pallas_enabled() and (
-        mesh is None or pallas_kernels.mesh_megakernel_eligible(mesh)
-    )
+    # Samples always ride the ray axis under Pallas. Deep-walk mesh scenes
+    # used to keep a sequential per-sample scan (flattening interleaved
+    # jitter streams and widened the packets the BVH walk culls on —
+    # measured 1.89 -> 1.64 f/s on 03_physics-2-mesh before re-sorting);
+    # the per-bounce Morton re-sort in trace_paths now re-packs the
+    # flattened rays into coherent blocks regardless of sample
+    # interleaving, so flattening is a pure win (4x fewer kernel launches
+    # for the same total work).
+    flatten_samples = pallas_kernels.pallas_enabled()
     if flatten_samples:
         # Samples ride the ray axis instead of a sequential lax.scan: one
         # [samples * n]-ray trace keeps every bounce step 'samples'x larger

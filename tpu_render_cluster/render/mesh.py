@@ -50,7 +50,7 @@ EPS = 1e-3
 # node's count. A static aligned width keeps the traversal free of
 # shape-dependent Python AND makes the Pallas kernel's dynamic sublane
 # slices tile-aligned (8 = the f32 sublane tile).
-LEAF_SIZE = 8
+LEAF_SIZE = 16
 
 
 class MeshBVH(NamedTuple):
@@ -495,13 +495,17 @@ def _normals_to_world(rot, normal_obj):
 
 
 def intersect_instances(
-    bvh: MeshBVH, instances: MeshInstances, origins, directions
+    bvh: MeshBVH, instances: MeshInstances, origins, directions, init_t=None
 ):
     """Nearest hit over all instances.
 
     Returns (t [R], normal [R, 3] world-space, albedo [R, 3]). Rigid
     transforms preserve ray parameter t, so per-instance results compare
-    directly.
+    directly. ``init_t`` (optional, [R]) seeds the best-t with a hit the
+    caller already knows (the same bounce's sphere/plane t): lanes whose
+    seed beats an instance's AABB entry stop driving that instance's walk,
+    and a mesh miss returns t == init_t (never closer, so callers using a
+    strict ``<`` comparison see it as a miss).
 
     On TPU this is ONE instanced-kernel launch (grid = ray blocks x
     instances, world-AABB top-level cull per block) followed by XLA
@@ -512,7 +516,7 @@ def intersect_instances(
 
     if pallas_kernels.pallas_enabled():
         t, tri, inst = pallas_kernels.intersect_instances_pallas(
-            bvh, instances, origins, directions
+            bvh, instances, origins, directions, init_t
         )
         hit = (t < INF)[:, None]
         normal_obj = bvh.normal[tri]
@@ -546,7 +550,7 @@ def intersect_instances(
 
     r = origins.shape[0]
     init = (
-        jnp.full((r,), INF, jnp.float32),
+        jnp.full((r,), INF, jnp.float32) if init_t is None else init_t,
         jnp.zeros((r, 3), jnp.float32),
         jnp.zeros((r, 3), jnp.float32),
     )
@@ -560,19 +564,25 @@ def intersect_instances(
     return best_t, best_normal, best_albedo
 
 
-def occluded_instances(bvh: MeshBVH, instances: MeshInstances, origins, directions):
+def occluded_instances(
+    bvh: MeshBVH, instances: MeshInstances, origins, directions, already=None
+):
     """Any-hit over all instances (shadow rays).
 
     Cheaper than ``intersect_instances``: shadow rays only need a boolean,
     so the per-instance scan skips the normal/albedo gathers and transform.
+    ``already`` (optional, [R] bool) marks lanes the caller already knows
+    are occluded (e.g. by the sphere any-hit): they stop driving the walks
+    and come back True.
     """
 
     from tpu_render_cluster.render import pallas_kernels
 
+    if already is None:
+        already = jnp.zeros((origins.shape[0],), bool)
     if pallas_kernels.pallas_enabled():
         return pallas_kernels.occluded_instances_pallas(
-            bvh, instances, origins, directions,
-            jnp.zeros((origins.shape[0],), bool),
+            bvh, instances, origins, directions, already
         )
 
     def per_instance(occluded, k):
@@ -585,7 +595,7 @@ def occluded_instances(bvh: MeshBVH, instances: MeshInstances, origins, directio
     k_count = instances.translation.shape[0]
     occluded, _ = jax.lax.scan(
         per_instance,
-        jnp.zeros((origins.shape[0],), bool),
+        already,
         jnp.arange(k_count),
     )
     return occluded

@@ -49,7 +49,7 @@ BLOCK_R = 4096
 # (bench-mesh, instanced nearest-hit + any-hit wired): 1024 -> 16.1 f/s,
 # 2048 -> 16.9, 4096 -> 16.7, 8192 -> 15.0. (Pre-instanced-nearest-hit the
 # same sweep peaked at 9.25.)
-BVH_BLOCK_R = 2048
+BVH_BLOCK_R = 1024
 _SUBLANE = 8  # f32 sublane tile; sphere count is padded to a multiple
 
 
@@ -930,198 +930,268 @@ def occluded_bvh_pallas(bvh, origins, directions, already):
 # materialization in HBM, one launch per pass instead of K.
 
 
-def _bvh_instanced_kernel_factory(n_nodes: int, leaf_size: int, anyhit: bool):
-    def kernel(
-        o_ref, d_ref, inst_ref, v0_ref, e1_ref, e2_ref,
-        bmin_ref, bmax_ref, skip_ref, first_ref, count_ref,
-        *out_refs,
-    ):
-        k = pl.program_id(1)
-        # World -> object from SMEM scalars (x' = R^T (x - t) / s; the
-        # direction scales by 1/s too so t stays in world units).
-        r00, r01, r02 = inst_ref[k, 0], inst_ref[k, 1], inst_ref[k, 2]
-        r10, r11, r12 = inst_ref[k, 3], inst_ref[k, 4], inst_ref[k, 5]
-        r20, r21, r22 = inst_ref[k, 6], inst_ref[k, 7], inst_ref[k, 8]
-        tx, ty, tz = inst_ref[k, 9], inst_ref[k, 10], inst_ref[k, 11]
-        inv_s = inst_ref[k, 12]
+def _bvh_instanced_kernel_factory(
+    n_nodes: int, leaf_size: int, k_count: int, anyhit: bool
+):
+    def kernel(o_ref, d_ref, *rest):
+        if anyhit:
+            (inst_ref, v0_ref, e1_ref, e2_ref, bmin_ref, bmax_ref,
+             skip_ref, first_ref, count_ref, *out_refs) = rest
+        else:
+            # Nearest variant carries a seed-t input (the caller's already
+            # known closest hit — sphere/plane t from the same bounce, so
+            # walks that cannot beat it are culled before they start) and a
+            # per-block CANDIDATE instance (the broadphase's nearest-entry
+            # AABB for the block's first lane; the integrator sorts rays by
+            # candidate, so one id represents the block).
+            (tinit_ref, cand_ref, inst_ref, v0_ref, e1_ref, e2_ref,
+             bmin_ref, bmax_ref, skip_ref, first_ref, count_ref,
+             *out_refs) = rest
 
+        # One grid step per RAY BLOCK; instances run in an in-kernel fori
+        # loop. (An earlier revision put instances on a second grid axis —
+        # 48x more grid steps, each paying block-copy + bookkeeping
+        # overhead and round-tripping best-t through the output refs.)
         wo = o_ref[:, :]
         wd = d_ref[:, :]
+        block = wo.shape[1]
 
-        # Top-level cull: slab-test the ray block against this instance's
-        # WORLD AABB with the untransformed rays; skip the whole walk when
-        # nothing in the block can touch the instance.
         def winv(v):
             small = jnp.abs(v) < 1e-12
             return 1.0 / jnp.where(small, jnp.where(v < 0, -1e-12, 1e-12), v)
 
         wox, woy, woz = wo[0:1, :], wo[1:2, :], wo[2:3, :]
-        wix, wiy, wiz = winv(wd[0:1, :]), winv(wd[1:2, :]), winv(wd[2:3, :])
-        wlox = (inst_ref[k, 13] - wox) * wix
-        whix = (inst_ref[k, 16] - wox) * wix
-        wloy = (inst_ref[k, 14] - woy) * wiy
-        whiy = (inst_ref[k, 17] - woy) * wiy
-        wloz = (inst_ref[k, 15] - woz) * wiz
-        whiz = (inst_ref[k, 18] - woz) * wiz
-        wnear = jnp.maximum(
-            jnp.maximum(jnp.minimum(wlox, whix), jnp.minimum(wloy, whiy)),
-            jnp.minimum(wloz, whiz),
-        )
-        wfar = jnp.minimum(
-            jnp.minimum(jnp.maximum(wlox, whix), jnp.maximum(wloy, whiy)),
-            jnp.maximum(wloz, whiz),
-        )
-        block_touches_instance = jnp.any(wfar >= jnp.maximum(wnear, 0.0))
-
-        sx = wo[0:1, :] - tx
-        sy = wo[1:2, :] - ty
-        sz = wo[2:3, :] - tz
-        # Column j of R^T is row j of R: o'_i = sum_j s_j * R[j][i].
-        ox = (sx * r00 + sy * r10 + sz * r20) * inv_s
-        oy = (sx * r01 + sy * r11 + sz * r21) * inv_s
-        oz = (sx * r02 + sy * r12 + sz * r22) * inv_s
         wdx, wdy, wdz = wd[0:1, :], wd[1:2, :], wd[2:3, :]
-        dx = (wdx * r00 + wdy * r10 + wdz * r20) * inv_s
-        dy = (wdx * r01 + wdy * r11 + wdz * r21) * inv_s
-        dz = (wdx * r02 + wdy * r12 + wdz * r22) * inv_s
-
-        def inv_axis(v):
-            small = jnp.abs(v) < 1e-12
-            return 1.0 / jnp.where(small, jnp.where(v < 0, -1e-12, 1e-12), v)
-
-        invx, invy, invz = inv_axis(dx), inv_axis(dy), inv_axis(dz)
-        block = wo.shape[1]
+        wix, wiy, wiz = winv(wdx), winv(wdy), winv(wdz)
         lanes = jax.lax.broadcasted_iota(jnp.int32, (leaf_size, block), 0)
+
+        def per_instance(k, carry):
+            # World -> object from SMEM scalars (x' = R^T (x - t) / s; the
+            # direction scales by 1/s too so t stays in world units).
+            r00, r01, r02 = inst_ref[k, 0], inst_ref[k, 1], inst_ref[k, 2]
+            r10, r11, r12 = inst_ref[k, 3], inst_ref[k, 4], inst_ref[k, 5]
+            r20, r21, r22 = inst_ref[k, 6], inst_ref[k, 7], inst_ref[k, 8]
+            tx, ty, tz = inst_ref[k, 9], inst_ref[k, 10], inst_ref[k, 11]
+            inv_s = inst_ref[k, 12]
+
+            if anyhit:
+                # Lanes occluded by earlier instances stop driving the cull.
+                cull_limit = jnp.where(carry > 0.0, -INF, INF)
+            else:
+                # Per-lane best-so-far (seeded with the caller's
+                # sphere/plane t): an instance whose AABB entry lies beyond
+                # every lane's current best cannot improve anything.
+                cull_limit = carry[0]
+
+            # Top-level cull: slab-test the ray block against this
+            # instance's WORLD AABB with the untransformed rays; skip the
+            # whole walk when nothing in the block can touch the instance.
+            wlox = (inst_ref[k, 13] - wox) * wix
+            whix = (inst_ref[k, 16] - wox) * wix
+            wloy = (inst_ref[k, 14] - woy) * wiy
+            whiy = (inst_ref[k, 17] - woy) * wiy
+            wloz = (inst_ref[k, 15] - woz) * wiz
+            whiz = (inst_ref[k, 18] - woz) * wiz
+            wnear = jnp.maximum(
+                jnp.maximum(jnp.minimum(wlox, whix), jnp.minimum(wloy, whiy)),
+                jnp.minimum(wloz, whiz),
+            )
+            wfar = jnp.minimum(
+                jnp.minimum(jnp.maximum(wlox, whix), jnp.maximum(wloy, whiy)),
+                jnp.maximum(wloz, whiz),
+            )
+            touch = jnp.any(
+                (wfar >= jnp.maximum(wnear, 0.0)) & (wnear < cull_limit)
+            )
+
+            def run_walk():
+                sx, sy, sz = wox - tx, woy - ty, woz - tz
+                # Column j of R^T is row j of R: o'_i = sum_j s_j * R[j][i].
+                ox = (sx * r00 + sy * r10 + sz * r20) * inv_s
+                oy = (sx * r01 + sy * r11 + sz * r21) * inv_s
+                oz = (sx * r02 + sy * r12 + sz * r22) * inv_s
+                dx = (wdx * r00 + wdy * r10 + wdz * r20) * inv_s
+                dy = (wdx * r01 + wdy * r11 + wdz * r21) * inv_s
+                dz = (wdx * r02 + wdy * r12 + wdz * r22) * inv_s
+                invx, invy, invz = winv(dx), winv(dy), winv(dz)
+
+                def cond(walk):
+                    # (An all-lanes-occluded early exit for the anyhit walk
+                    # was measured slower: the per-iteration cross-lane
+                    # reduction costs more than the iterations it saves.)
+                    return walk[0] < n_nodes
+
+                def body(walk):
+                    if anyhit:
+                        node, occluded = walk
+                        best_t = jnp.where(occluded > 0.0, -INF, INF)
+                    else:
+                        node, best_t, best_tri, best_inst = walk
+                    lox = (bmin_ref[node, 0] - ox) * invx
+                    hix = (bmax_ref[node, 0] - ox) * invx
+                    loy = (bmin_ref[node, 1] - oy) * invy
+                    hiy = (bmax_ref[node, 1] - oy) * invy
+                    loz = (bmin_ref[node, 2] - oz) * invz
+                    hiz = (bmax_ref[node, 2] - oz) * invz
+                    tnear = jnp.maximum(
+                        jnp.maximum(
+                            jnp.minimum(lox, hix), jnp.minimum(loy, hiy)
+                        ),
+                        jnp.minimum(loz, hiz),
+                    )
+                    tfar = jnp.minimum(
+                        jnp.minimum(
+                            jnp.maximum(lox, hix), jnp.maximum(loy, hiy)
+                        ),
+                        jnp.maximum(loz, hiz),
+                    )
+                    packet_hit = (
+                        tfar >= jnp.maximum(tnear, 0.0)
+                    ) & (tnear < best_t)
+                    hit_any = jnp.any(packet_hit)
+
+                    count = count_ref[node]
+                    is_leaf = count > 0
+                    start = first_ref[node]
+
+                    def leaf_test():
+                        # The [leaf_size, block] Möller-Trumbore test — the
+                        # walk's dominant vector work. ``is_leaf & hit_any``
+                        # is a SCALAR (the whole block walks the same node),
+                        # so this runs under a real scalar-unit branch:
+                        # internal nodes and culled subtrees skip it
+                        # entirely instead of computing-and-masking (~2x on
+                        # deep walks, where half the visited nodes are
+                        # internal).
+                        v0b = v0_ref[pl.dslice(start, leaf_size), :]
+                        e1b = e1_ref[pl.dslice(start, leaf_size), :]
+                        e2b = e2_ref[pl.dslice(start, leaf_size), :]
+                        v0x, v0y, v0z = v0b[:, 0:1], v0b[:, 1:2], v0b[:, 2:3]
+                        e1x, e1y, e1z = e1b[:, 0:1], e1b[:, 1:2], e1b[:, 2:3]
+                        e2x, e2y, e2z = e2b[:, 0:1], e2b[:, 1:2], e2b[:, 2:3]
+                        pvx = dy * e2z - dz * e2y
+                        pvy = dz * e2x - dx * e2z
+                        pvz = dx * e2y - dy * e2x
+                        det = e1x * pvx + e1y * pvy + e1z * pvz
+                        inv_det = 1.0 / jnp.where(
+                            jnp.abs(det) < BVH_DONE_EPS, BVH_DONE_EPS, det
+                        )
+                        tvx = ox - v0x
+                        tvy = oy - v0y
+                        tvz = oz - v0z
+                        u = (tvx * pvx + tvy * pvy + tvz * pvz) * inv_det
+                        qvx = tvy * e1z - tvz * e1y
+                        qvy = tvz * e1x - tvx * e1z
+                        qvz = tvx * e1y - tvy * e1x
+                        v = (dx * qvx + dy * qvy + dz * qvz) * inv_det
+                        tt = (e2x * qvx + e2y * qvy + e2z * qvz) * inv_det
+                        tri_hit = (
+                            (jnp.abs(det) > BVH_DONE_EPS)
+                            & (u >= 0.0)
+                            & (v >= 0.0)
+                            & (u + v <= 1.0)
+                            & (tt > EPS)
+                            & (lanes < count)
+                        )
+                        if anyhit:
+                            return (
+                                jnp.max(
+                                    jnp.where(tri_hit, 1.0, 0.0),
+                                    axis=0,
+                                    keepdims=True,
+                                ),
+                                jnp.zeros((1, block), jnp.int32),
+                            )
+                        t_cand = jnp.where(tri_hit, tt, INF)
+                        t_leaf = jnp.min(t_cand, axis=0, keepdims=True)
+                        local = jnp.min(
+                            jnp.where(t_cand == t_leaf, lanes, leaf_size),
+                            axis=0,
+                            keepdims=True,
+                        )
+                        return t_leaf, local
+
+                    def leaf_skip():
+                        if anyhit:
+                            return (
+                                jnp.zeros((1, block), jnp.float32),
+                                jnp.zeros((1, block), jnp.int32),
+                            )
+                        return (
+                            jnp.full((1, block), INF, jnp.float32),
+                            jnp.zeros((1, block), jnp.int32),
+                        )
+
+                    leaf_a, leaf_b = jax.lax.cond(
+                        is_leaf & hit_any, leaf_test, leaf_skip
+                    )
+                    next_node = jnp.where(
+                        hit_any,
+                        jnp.where(is_leaf, skip_ref[node], node + 1),
+                        skip_ref[node],
+                    )
+                    if anyhit:
+                        occluded = jnp.maximum(occluded, leaf_a)
+                        return next_node, occluded
+                    t_leaf, local = leaf_a, leaf_b
+                    closer = t_leaf < best_t
+                    best_t = jnp.where(closer, t_leaf, best_t)
+                    best_tri = jnp.where(
+                        closer,
+                        start + jnp.minimum(local, leaf_size - 1),
+                        best_tri,
+                    )
+                    best_inst = jnp.where(closer, k, best_inst)
+                    return next_node, best_t, best_tri, best_inst
+
+                if anyhit:
+                    _, occluded = jax.lax.while_loop(
+                        cond, body, (jnp.int32(0), carry)
+                    )
+                    return occluded
+                _, best_t, best_tri, best_inst = jax.lax.while_loop(
+                    cond, body, (jnp.int32(0), *carry)
+                )
+                return (best_t, best_tri, best_inst)
+
+            return jax.lax.cond(touch, run_walk, lambda: carry)
 
         if anyhit:
             occ_ref, = out_refs
-
-            @pl.when(k == 0)
-            def _():
-                # Already-occluded rays are folded in by the wrapper
-                # (replaced with guaranteed-miss rays), so the buffer
-                # starts all-clear (_bvh_anyhit_instanced).
-                occ_ref[:, :] = jnp.zeros((1, block), jnp.float32)
+            # Already-occluded rays are folded in by the wrapper (replaced
+            # with guaranteed-miss rays), so the walk starts all-clear
+            # (_bvh_anyhit_instanced).
+            occluded = jax.lax.fori_loop(
+                0, k_count, per_instance, jnp.zeros((1, block), jnp.float32)
+            )
+            occ_ref[:, :] = occluded
         else:
             t_ref, tri_ref, inst_out_ref = out_refs
-
-            @pl.when(k == 0)
-            def _():
-                t_ref[:, :] = jnp.full((1, block), INF, jnp.float32)
-                tri_ref[:, :] = jnp.zeros((1, block), jnp.int32)
-                inst_out_ref[:, :] = jnp.zeros((1, block), jnp.int32)
-
-        def cond(carry):
-            return carry[0] < n_nodes
-
-        def body(carry):
-            if anyhit:
-                node, occluded = carry
-                best_t = jnp.where(occluded > 0.0, -INF, INF)
-            else:
-                node, best_t, best_tri, best_inst = carry
-            lox = (bmin_ref[node, 0] - ox) * invx
-            hix = (bmax_ref[node, 0] - ox) * invx
-            loy = (bmin_ref[node, 1] - oy) * invy
-            hiy = (bmax_ref[node, 1] - oy) * invy
-            loz = (bmin_ref[node, 2] - oz) * invz
-            hiz = (bmax_ref[node, 2] - oz) * invz
-            tnear = jnp.maximum(
-                jnp.maximum(jnp.minimum(lox, hix), jnp.minimum(loy, hiy)),
-                jnp.minimum(loz, hiz),
+            init = (
+                tinit_ref[:, :],
+                jnp.zeros((1, block), jnp.int32),
+                jnp.zeros((1, block), jnp.int32),
             )
-            tfar = jnp.minimum(
-                jnp.minimum(jnp.maximum(lox, hix), jnp.maximum(loy, hiy)),
-                jnp.maximum(loz, hiz),
+            # Walk the block's candidate instance FIRST: most lanes hit it,
+            # so the sweep below starts with tight per-lane best-t and the
+            # top-level cull rejects most of the remaining instances.
+            cand = cand_ref[0, pl.program_id(0)]
+            init = jax.lax.cond(
+                cand < k_count,
+                lambda: per_instance(cand, init),
+                lambda: init,
             )
-            packet_hit = (tfar >= jnp.maximum(tnear, 0.0)) & (tnear < best_t)
-            hit_any = jnp.any(packet_hit)
-
-            count = count_ref[node]
-            is_leaf = count > 0
-            start = first_ref[node]
-
-            v0b = v0_ref[pl.dslice(start, leaf_size), :]
-            e1b = e1_ref[pl.dslice(start, leaf_size), :]
-            e2b = e2_ref[pl.dslice(start, leaf_size), :]
-            v0x, v0y, v0z = v0b[:, 0:1], v0b[:, 1:2], v0b[:, 2:3]
-            e1x, e1y, e1z = e1b[:, 0:1], e1b[:, 1:2], e1b[:, 2:3]
-            e2x, e2y, e2z = e2b[:, 0:1], e2b[:, 1:2], e2b[:, 2:3]
-            pvx = dy * e2z - dz * e2y
-            pvy = dz * e2x - dx * e2z
-            pvz = dx * e2y - dy * e2x
-            det = e1x * pvx + e1y * pvy + e1z * pvz
-            inv_det = 1.0 / jnp.where(
-                jnp.abs(det) < BVH_DONE_EPS, BVH_DONE_EPS, det
+            best_t, best_tri, best_inst = jax.lax.fori_loop(
+                0,
+                k_count,
+                lambda k, c: jax.lax.cond(
+                    k == cand, lambda: c, lambda: per_instance(k, c)
+                ),
+                init,
             )
-            tvx = ox - v0x
-            tvy = oy - v0y
-            tvz = oz - v0z
-            u = (tvx * pvx + tvy * pvy + tvz * pvz) * inv_det
-            qvx = tvy * e1z - tvz * e1y
-            qvy = tvz * e1x - tvx * e1z
-            qvz = tvx * e1y - tvy * e1x
-            v = (dx * qvx + dy * qvy + dz * qvz) * inv_det
-            tt = (e2x * qvx + e2y * qvy + e2z * qvz) * inv_det
-            tri_hit = (
-                (jnp.abs(det) > BVH_DONE_EPS)
-                & (u >= 0.0)
-                & (v >= 0.0)
-                & (u + v <= 1.0)
-                & (tt > EPS)
-                & (lanes < count)
-                & is_leaf
-                & hit_any
-            )
-            next_node = jnp.where(
-                hit_any,
-                jnp.where(is_leaf, skip_ref[node], node + 1),
-                skip_ref[node],
-            )
-            if anyhit:
-                occluded = jnp.maximum(
-                    occluded,
-                    jnp.max(
-                        jnp.where(tri_hit, 1.0, 0.0), axis=0, keepdims=True
-                    ),
-                )
-                return next_node, occluded
-            t_cand = jnp.where(tri_hit, tt, INF)
-            t_leaf = jnp.min(t_cand, axis=0, keepdims=True)
-            local = jnp.min(
-                jnp.where(t_cand == t_leaf, lanes, leaf_size),
-                axis=0,
-                keepdims=True,
-            )
-            closer = t_leaf < best_t
-            best_t = jnp.where(closer, t_leaf, best_t)
-            best_tri = jnp.where(
-                closer, start + jnp.minimum(local, leaf_size - 1), best_tri
-            )
-            best_inst = jnp.where(closer, k, best_inst)
-            return next_node, best_t, best_tri, best_inst
-
-        @pl.when(block_touches_instance)
-        def _walk():
-            if anyhit:
-                _, occluded = jax.lax.while_loop(
-                    cond, body, (jnp.int32(0), occ_ref[:, :])
-                )
-                occ_ref[:, :] = occluded
-            else:
-                _, best_t, best_tri, best_inst = jax.lax.while_loop(
-                    cond,
-                    body,
-                    (
-                        jnp.int32(0),
-                        t_ref[:, :],
-                        tri_ref[:, :],
-                        inst_out_ref[:, :],
-                    ),
-                )
-                t_ref[:, :] = best_t
-                tri_ref[:, :] = best_tri
-                inst_out_ref[:, :] = best_inst
+            t_ref[:, :] = best_t
+            tri_ref[:, :] = best_tri
+            inst_out_ref[:, :] = best_inst
 
     return kernel
 
@@ -1164,11 +1234,11 @@ def _instance_table(rotation, translation, scale, bounds_min, bounds_max,
 
 
 def _instanced_specs(inst_table, v0, e1, e2, bounds_min, bounds_max, n_nodes):
-    whole = lambda i, k: (0, 0)  # noqa: E731
-    flat = lambda i, k: (0,)  # noqa: E731
+    whole = lambda i: (0, 0)  # noqa: E731
+    flat = lambda i: (0,)  # noqa: E731
     return [
-        pl.BlockSpec((3, BVH_BLOCK_R), lambda i, k: (0, i), memory_space=pltpu.VMEM),
-        pl.BlockSpec((3, BVH_BLOCK_R), lambda i, k: (0, i), memory_space=pltpu.VMEM),
+        pl.BlockSpec((3, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+        pl.BlockSpec((3, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
         pl.BlockSpec(inst_table.shape, whole, memory_space=pltpu.SMEM),
         pl.BlockSpec(v0.shape, whole, memory_space=pltpu.VMEM),
         pl.BlockSpec(e1.shape, whole, memory_space=pltpu.VMEM),
@@ -1181,30 +1251,91 @@ def _instanced_specs(inst_table, v0, e1, e2, bounds_min, bounds_max, n_nodes):
     ]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+def instance_entry_candidates(origins, directions, lo_w, hi_w):
+    """Per-ray broadphase: nearest-entry overlapped instance world AABB.
+
+    One fused [R, K] slab-test pass; returns [R] int32 with K (= the
+    instance count) for rays overlapping nothing. Shared by the
+    integrator's coherence sort key and the nearest wrapper's per-block
+    candidates — a single copy so an epsilon change can't desynchronize
+    the sort from the kernel's walk order.
+    """
+    small = jnp.abs(directions) < 1e-12
+    inv = 1.0 / jnp.where(
+        small, jnp.where(directions < 0, -1e-12, 1e-12), directions
+    )
+    t0 = (lo_w[None, :, :] - origins[:, None, :]) * inv[:, None, :]
+    t1 = (hi_w[None, :, :] - origins[:, None, :]) * inv[:, None, :]
+    near = jnp.max(jnp.minimum(t0, t1), axis=2)  # [R, K]
+    far = jnp.min(jnp.maximum(t0, t1), axis=2)
+    overlap = far >= jnp.maximum(near, 0.0)
+    entry = jnp.where(overlap, jnp.maximum(near, 0.0), jnp.float32(INF))
+    return jnp.where(
+        jnp.any(overlap, axis=1),
+        jnp.argmin(entry, axis=1),
+        lo_w.shape[0],
+    ).astype(jnp.int32)
+
+
+def _block_candidates(origins, directions, lo_w, hi_w):
+    """Nearest-entry overlapped instance AABB per ray block, from the
+    block's FIRST lane (the integrator sorts rays by candidate, so one
+    lane represents the block). K = no overlap. [1, n_blocks] int32.
+    """
+    rays = origins.shape[0]
+    n_blocks = -(-rays // BVH_BLOCK_R)
+    stride = jnp.arange(n_blocks) * BVH_BLOCK_R
+    first_lane = jnp.minimum(stride, rays - 1)
+    return instance_entry_candidates(
+        origins[first_lane], directions[first_lane], lo_w, hi_w
+    )[None, :]
+
+
 def _bvh_nearest_instanced(
-    origins, directions, rotation, translation, scale,
-    v0, e1, e2, bounds_min, bounds_max, skip, first, count,
+    origins, directions, t_init, block_candidate, rotation, translation,
+    scale, v0, e1, e2, bounds_min, bounds_max, skip, first, count,
     *, interpret: bool,
 ):
     from tpu_render_cluster.render.mesh import LEAF_SIZE
 
     o_t, d_t, rays, padded_rays = _pad_rays_to_miss(origins, directions)
+    t_init_t = jnp.full((1, padded_rays), INF, jnp.float32)
+    t_init_t = t_init_t.at[0, :rays].set(t_init)
     inst_table = _instance_table(
         rotation, translation, scale, bounds_min, bounds_max
     )
     n_nodes = skip.shape[0]
     k_count = rotation.shape[0]
-    grid = (padded_rays // BVH_BLOCK_R, k_count)
+    n_blocks = padded_rays // BVH_BLOCK_R
+    grid = (n_blocks,)
     out_block = pl.BlockSpec(
-        (1, BVH_BLOCK_R), lambda i, k: (0, i), memory_space=pltpu.VMEM
+        (1, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+    in_specs = _instanced_specs(
+        inst_table, v0, e1, e2, bounds_min, bounds_max, n_nodes
+    )
+    # Seed-t rides a third ray-indexed block after origins/directions; the
+    # per-block candidate follows as a one-scalar SMEM block.
+    in_specs.insert(
+        2,
+        pl.BlockSpec(
+            (1, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM
+        ),
+    )
+    # The whole per-block candidate vector rides in SMEM as a [1, n] row
+    # (rank-2 sidesteps Pallas TPU's rank-1 block tiling constraint AND
+    # vmap's batching of rank-1 SMEM blocks); the kernel indexes it by
+    # program_id.
+    in_specs.insert(
+        3,
+        pl.BlockSpec(
+            (1, n_blocks), lambda i: (0, 0), memory_space=pltpu.SMEM
+        ),
     )
     t, tri, inst = pl.pallas_call(
-        _bvh_instanced_kernel_factory(n_nodes, LEAF_SIZE, anyhit=False),
+        _bvh_instanced_kernel_factory(n_nodes, LEAF_SIZE, k_count, anyhit=False),
         grid=grid,
-        in_specs=_instanced_specs(
-            inst_table, v0, e1, e2, bounds_min, bounds_max, n_nodes
-        ),
+        in_specs=in_specs,
         out_specs=[out_block, out_block, out_block],
         out_shape=[
             jax.ShapeDtypeStruct((1, padded_rays), jnp.float32),
@@ -1212,12 +1343,11 @@ def _bvh_nearest_instanced(
             jax.ShapeDtypeStruct((1, padded_rays), jnp.int32),
         ],
         interpret=interpret,
-    )(o_t, d_t, inst_table, v0, e1, e2, bounds_min, bounds_max, skip, first,
-      count)
+    )(o_t, d_t, t_init_t, block_candidate, inst_table, v0, e1, e2,
+      bounds_min, bounds_max, skip, first, count)
     return t[0, :rays], tri[0, :rays], inst[0, :rays]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
 def _bvh_anyhit_instanced(
     origins, directions, already, rotation, translation, scale,
     v0, e1, e2, bounds_min, bounds_max, skip, first, count,
@@ -1243,15 +1373,15 @@ def _bvh_anyhit_instanced(
     )
     n_nodes = skip.shape[0]
     k_count = rotation.shape[0]
-    grid = (padded_rays // BVH_BLOCK_R, k_count)
+    grid = (padded_rays // BVH_BLOCK_R,)
     occ = pl.pallas_call(
-        _bvh_instanced_kernel_factory(n_nodes, LEAF_SIZE, anyhit=True),
+        _bvh_instanced_kernel_factory(n_nodes, LEAF_SIZE, k_count, anyhit=True),
         grid=grid,
         in_specs=_instanced_specs(
             inst_table, v0, e1, e2, bounds_min, bounds_max, n_nodes
         ),
         out_specs=pl.BlockSpec(
-            (1, BVH_BLOCK_R), lambda i, k: (0, i), memory_space=pltpu.VMEM
+            (1, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM
         ),
         out_shape=jax.ShapeDtypeStruct((1, padded_rays), jnp.float32),
         interpret=interpret,
@@ -1326,12 +1456,13 @@ def _mesh_trace_kernel_factory(
         def walk_step(node, ox, oy, oz, dx, dy, dz, invx, invy, invz, limit):
             """One threaded-BVH step shared by BOTH in-kernel walks.
 
-            Slab-tests the node, runs the aligned leaf slot through
-            Möller–Trumbore (branchless; masked out on inner nodes and
-            packet misses), and advances the skip-link cursor. Direction
-            components may be [1, BR] vectors (nearest) or scalars
-            (shadow rays toward the uniform sun). Returns
-            (next_node, leaf start, tri_hit [L, BR], t_cand [L, BR]).
+            Slab-tests the node and advances the skip-link cursor. The
+            [leaf_size, BR] Möller–Trumbore test lives in ``leaf_tcand``
+            and runs only under a scalar branch at the call sites
+            (``do_leaf`` = is_leaf & hit_any — the whole block walks the
+            same node, so the predicate is scalar): internal nodes and
+            culled subtrees skip the walk's dominant vector work entirely.
+            Returns (next_node, leaf start, leaf count, do_leaf).
             """
             lox = (bmin_ref[node, 0] - ox) * invx
             hix = (bmax_ref[node, 0] - ox) * invx
@@ -1352,7 +1483,20 @@ def _mesh_trace_kernel_factory(
             count = count_ref[node]
             is_leaf = count > 0
             start = first_ref[node]
+            next_node = jnp.where(
+                hit_any,
+                jnp.where(is_leaf, skip_ref[node], node + 1),
+                skip_ref[node],
+            )
+            return next_node, start, count, is_leaf & hit_any
 
+        def leaf_tcand(start, count, ox, oy, oz, dx, dy, dz):
+            """Möller–Trumbore over the aligned leaf slot at ``start``.
+
+            Direction components may be [1, BR] vectors (nearest) or
+            scalars (shadow rays toward the uniform sun). Returns
+            (tri_hit [L, BR], t_cand [L, BR]).
+            """
             v0b = v0_ref[pl.dslice(start, leaf_size), :]
             e1b = e1_ref[pl.dslice(start, leaf_size), :]
             e2b = e2_ref[pl.dslice(start, leaf_size), :]
@@ -1380,16 +1524,9 @@ def _mesh_trace_kernel_factory(
                 & (u + v <= 1.0)
                 & (tt > EPS)
                 & (lanes < count)
-                & is_leaf
-                & hit_any
             )
             t_cand = jnp.where(tri_hit, tt, INF)
-            next_node = jnp.where(
-                hit_any,
-                jnp.where(is_leaf, skip_ref[node], node + 1),
-                skip_ref[node],
-            )
-            return next_node, start, tri_hit, t_cand
+            return tri_hit, t_cand
 
         def world_cull(k, wox, woy, woz, wix, wiy, wiz, limit_t):
             """Block-wide test of the untransformed rays against instance
@@ -1446,24 +1583,47 @@ def _mesh_trace_kernel_factory(
 
                 def body(walk):
                     node, best_t, bnx, bny, bnz, bar_, bag_, bab_ = walk
-                    next_node, start, _tri_hit, t_cand = walk_step(
+                    next_node, start, count, do_leaf = walk_step(
                         node, ox, oy, oz, dx, dy, dz, invx, invy, invz,
                         best_t,
                     )
-                    t_leaf = jnp.min(t_cand, axis=0, keepdims=True)
-                    local = jnp.min(
-                        jnp.where(t_cand == t_leaf, lanes, leaf_size),
-                        axis=0,
-                        keepdims=True,
+
+                    def leaf_pass():
+                        _tri_hit, t_cand = leaf_tcand(
+                            start, count, ox, oy, oz, dx, dy, dz
+                        )
+                        t_leaf = jnp.min(t_cand, axis=0, keepdims=True)
+                        local = jnp.min(
+                            jnp.where(t_cand == t_leaf, lanes, leaf_size),
+                            axis=0,
+                            keepdims=True,
+                        )
+                        # Winning row's OBJECT normal via a one-hot reduce
+                        # (exactly one row: the first tying lane).
+                        nb = nrm_ref[pl.dslice(start, leaf_size), :]
+                        winner = (lanes == local).astype(jnp.float32)
+                        nox = jnp.sum(
+                            winner * nb[:, 0:1], axis=0, keepdims=True
+                        )
+                        noy = jnp.sum(
+                            winner * nb[:, 1:2], axis=0, keepdims=True
+                        )
+                        noz = jnp.sum(
+                            winner * nb[:, 2:3], axis=0, keepdims=True
+                        )
+                        return t_leaf, nox, noy, noz
+
+                    def leaf_skip():
+                        zero = jnp.zeros((1, block), jnp.float32)
+                        return (
+                            jnp.full((1, block), INF, jnp.float32),
+                            zero, zero, zero,
+                        )
+
+                    t_leaf, nox, noy, noz = jax.lax.cond(
+                        do_leaf, leaf_pass, leaf_skip
                     )
                     closer = t_leaf < best_t
-                    # Winning row's OBJECT normal via a one-hot reduce
-                    # (exactly one row: the first tying lane).
-                    nb = nrm_ref[pl.dslice(start, leaf_size), :]
-                    winner = (lanes == local).astype(jnp.float32)
-                    nox = jnp.sum(winner * nb[:, 0:1], axis=0, keepdims=True)
-                    noy = jnp.sum(winner * nb[:, 1:2], axis=0, keepdims=True)
-                    noz = jnp.sum(winner * nb[:, 2:3], axis=0, keepdims=True)
                     # Object -> world (rigid): w_i = sum_j R[i][j] n_j.
                     wnx = r00 * nox + r01 * noy + r02 * noz
                     wny = r10 * nox + r11 * noy + r12 * noz
@@ -1550,18 +1710,26 @@ def _mesh_trace_kernel_factory(
                     # Occluded lanes stop driving the walk: their packet
                     # limit is -INF so no node can pass their slab test.
                     limit = jnp.where(occluded > 0.0, -INF, INF)
-                    next_node, _start, tri_hit, _t_cand = walk_step(
+                    next_node, start, count, do_leaf = walk_step(
                         node, ox, oy, oz, dx, dy, dz, invx, invy, invz,
                         limit,
                     )
-                    occluded = jnp.maximum(
-                        occluded,
-                        jnp.max(
-                            jnp.where(tri_hit, 1.0, 0.0),
+                    occ_add = jax.lax.cond(
+                        do_leaf,
+                        lambda: jnp.max(
+                            jnp.where(
+                                leaf_tcand(
+                                    start, count, ox, oy, oz, dx, dy, dz
+                                )[0],
+                                1.0,
+                                0.0,
+                            ),
                             axis=0,
                             keepdims=True,
                         ),
+                        lambda: jnp.zeros((1, block), jnp.float32),
                     )
+                    occluded = jnp.maximum(occluded, occ_add)
                     return next_node, occluded
 
                 node0 = jnp.where(touch, jnp.int32(0), jnp.int32(n_nodes))
@@ -1858,18 +2026,50 @@ def trace_paths_fused_mesh(
     )
 
 
-def intersect_instances_pallas(bvh, instances, origins, directions):
+def intersect_instances_pallas(bvh, instances, origins, directions, init_t=None):
     """All-instance nearest hit in ONE kernel launch.
 
+    ``init_t`` seeds the per-lane best-t (e.g. the same bounce's
+    sphere/plane hit), culling instance walks that cannot beat it.
     Returns (t [R], triangle_index [R], instance_index [R]).
     """
-    return _bvh_nearest_instanced(
-        origins, directions,
-        instances.rotation, instances.translation, instances.scale,
+    if init_t is None:
+        init_t = jnp.full((origins.shape[0],), INF, jnp.float32)
+    # Front-to-back instance order (distance from the mean live ray
+    # origin): near instances set small best_t early, so the per-lane
+    # ``wnear < best_t`` top-level cull rejects most far instances before
+    # their walks start. Pure data reordering — results are order-
+    # invariant — computed per call in XLA (the transforms are traced
+    # values under jit, e.g. physics animation).
+    # Dead lanes arrive as guaranteed-miss rays parked at 1e7 (integrator)
+    # and must not drag the anchor off the scene.
+    valid = (jnp.abs(origins) < 1e6).all(axis=1)
+    anchor = jnp.sum(
+        jnp.where(valid[:, None], origins, 0.0), axis=0
+    ) / jnp.maximum(jnp.sum(valid), 1)
+    near_first = jnp.argsort(
+        jnp.sum((instances.translation - anchor[None, :]) ** 2, axis=1)
+    )
+    rotation = instances.rotation[near_first]
+    translation = instances.translation[near_first]
+    scale = instances.scale[near_first]
+    # Per-block candidate ids index the SAME permuted order the kernel
+    # sweeps (the table here is a [K, 22] recompute — trivial next to the
+    # walk).
+    table = _instance_table(
+        rotation, translation, scale, bvh.bounds_min, bvh.bounds_max
+    )
+    block_candidate = _block_candidates(
+        origins, directions, table[:, 13:16], table[:, 16:19]
+    )
+    t, tri, inst = _bvh_nearest_instanced(
+        origins, directions, init_t, block_candidate,
+        rotation, translation, scale,
         bvh.v0, bvh.e1, bvh.e2, bvh.bounds_min, bvh.bounds_max,
         bvh.skip, bvh.first, bvh.count,
         interpret=_interpret(),
     )
+    return t, tri, near_first[inst]
 
 
 def occluded_instances_pallas(bvh, instances, origins, directions, already):
