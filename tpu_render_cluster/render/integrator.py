@@ -248,43 +248,50 @@ def trace_paths(
                 scene, mesh, origins, directions, seed,
                 max_bounces=max_bounces,
             )
-        # Deep scenes fall through to the XLA bounce scan below, whose
-        # intersections still dispatch to the Pallas instanced kernels.
-    n = origins.shape[0]
-    # Deep-mesh scenes on the Pallas path re-sort rays for packet
-    # coherence EVERY bounce (see _ray_sort_order; sorting the primary
-    # bounce too measured faster — Morton tiles beat the full-width
-    # raster strips the camera emits). Travelling state rides ONE packed
-    # [n, 12] gather incl. the accumulated radiance (six separate [n, 3]
-    # gathers measured ~3x slower: random-access cost is per-row, so
-    # packing amortizes it; a per-bounce scatter-add of contributions
-    # measured slower still), and the carried lane index unsorts the
-    # radiance once at the end. The non-Pallas scan path is
-    # order-invariant, so it skips the sort machinery entirely.
-    from tpu_render_cluster.render import pallas_kernels as _pk
-
-    resort = mesh is not None and _pk.pallas_enabled()
-    throughput = jnp.ones((n, 3), jnp.float32)
-    radiance = jnp.zeros((n, 3), jnp.float32)
-    alive = jnp.ones((n,), bool)
-    lane = jnp.arange(n, dtype=jnp.int32)
-    sorted_yet = False
-    keys = jax.random.split(key, max_bounces)
-
-    for bounce in range(max_bounces):
-        if resort:
+        # Deep scenes: the megakernel's bounce_step as ONE fused launch
+        # per bounce (sphere/plane/mesh nearest, NEE with both any-hits,
+        # shading, in-kernel PCG resample — pallas_kernels
+        # mesh_bounce_pallas) with an XLA re-sort between bounces: rays
+        # re-pack by (candidate instance, Morton cell, octant) with dead
+        # lanes compacted to the tail, so the walks cull on tight
+        # coherent packets. Travelling state rides ONE packed [n, 12]
+        # gather incl. the accumulated radiance (separate [n, 3] gathers
+        # measured ~3x slower: random-access cost is per-row, so packing
+        # amortizes it); the carried lane index unsorts the radiance once
+        # at the end.
+        n = origins.shape[0]
+        throughput = jnp.ones((n, 3), jnp.float32)
+        radiance = jnp.zeros((n, 3), jnp.float32)
+        alive = jnp.ones((n,), bool)
+        lane = jnp.arange(n, dtype=jnp.int32)
+        for bounce in range(max_bounces):
             order = _ray_sort_order(origins, directions, alive, mesh=mesh)
             packed = jnp.concatenate(
                 [origins, directions, throughput, radiance], axis=1
-            )
-            packed = packed[order]
+            )[order]
             origins = packed[:, 0:3]
             directions = packed[:, 3:6]
             throughput = packed[:, 6:9]
             radiance = packed[:, 9:12]
             alive = alive[order]
             lane = lane[order]
-            sorted_yet = True
+            contribution, origins, directions, throughput, alive = (
+                pallas_kernels.mesh_bounce_pallas(
+                    scene, mesh, origins, directions, throughput, alive,
+                    seed, bounce, total_bounces=max_bounces,
+                )
+            )
+            radiance = radiance + contribution
+        return jnp.zeros_like(radiance).at[lane].set(radiance)
+    # Non-Pallas reference path: the plain XLA bounce loop. Order-invariant
+    # per lane, so no sort machinery.
+    n = origins.shape[0]
+    throughput = jnp.ones((n, 3), jnp.float32)
+    radiance = jnp.zeros((n, 3), jnp.float32)
+    alive = jnp.ones((n,), bool)
+    keys = jax.random.split(key, max_bounces)
+
+    for bounce in range(max_bounces):
         origins, directions, throughput, contribution, alive = _shade_bounce(
             scene,
             (origins, directions, throughput, alive),
@@ -292,8 +299,6 @@ def trace_paths(
             mesh=mesh,
         )
         radiance = radiance + contribution
-    if sorted_yet:
-        radiance = jnp.zeros_like(radiance).at[lane].set(radiance)
     return radiance
 
 

@@ -1415,14 +1415,35 @@ def _bvh_anyhit_instanced(
 
 def _mesh_trace_kernel_factory(
     max_bounces: int, n_padded: int, n_nodes: int, leaf_size: int,
-    k_count: int,
+    k_count: int, state_io: bool = False,
 ):
+    """Mesh path-trace kernel. Two shapes share one bounce_step:
+
+    - state_io=False: the whole-bounce-loop MEGAKERNEL (state VMEM-resident
+      across all bounces, radiance out) — shallow-walk scenes.
+    - state_io=True: ONE bounce per launch with path state streamed in/out
+      (o, d, throughput, alive + this bounce's radiance contribution), so
+      the integrator can re-sort rays for packet coherence between bounces
+      while everything else (sphere+plane+mesh nearest, NEE with both
+      any-hits, shading, in-kernel PCG resample) stays fused — deep-walk
+      scenes. ``max_bounces`` still names the TOTAL bounce count so the
+      per-(ray, bounce) RNG counters match the megakernel's stream layout.
+    """
     contract_first = (((0,), (0,)), ((), ()))
 
-    def kernel(seed_ref, o_ref, d_ref, c_ref, r2_ref, csq_ref, rad_ref,
-               albedo_ref, emission_ref, dcsun_ref, params_ref, sunsm_ref,
-               inst_ref, v0_ref, e1_ref, e2_ref, nrm_ref, bmin_ref,
-               bmax_ref, skip_ref, first_ref, count_ref, out_ref):
+    def kernel(*refs):
+        if state_io:
+            (seed_ref, bounce_ref, o_ref, d_ref, thr_ref, alive_ref,
+             c_ref, r2_ref, csq_ref, rad_ref, albedo_ref, emission_ref,
+             dcsun_ref, params_ref, sunsm_ref, inst_ref, v0_ref, e1_ref,
+             e2_ref, nrm_ref, bmin_ref, bmax_ref, skip_ref, first_ref,
+             count_ref, out_ref, o_out_ref, d_out_ref, thr_out_ref,
+             alive_out_ref) = refs
+        else:
+            (seed_ref, o_ref, d_ref, c_ref, r2_ref, csq_ref, rad_ref,
+             albedo_ref, emission_ref, dcsun_ref, params_ref, sunsm_ref,
+             inst_ref, v0_ref, e1_ref, e2_ref, nrm_ref, bmin_ref,
+             bmax_ref, skip_ref, first_ref, count_ref, out_ref) = refs
         o = o_ref[:, :]  # [3, BR]
         d = d_ref[:, :]
         c = c_ref[:, :]
@@ -1547,9 +1568,13 @@ def _mesh_trace_kernel_factory(
             )
             return jnp.any((far >= jnp.maximum(near, 0.0)) & (near < limit_t))
 
-        def mesh_nearest(o, d):
+        def mesh_nearest(o, d, seed_t):
             """Nearest mesh hit over all instances.
 
+            ``seed_t`` [1, BR] seeds the per-lane best-t (the same bounce's
+            sphere/plane hit, -INF for dead lanes): walks the seed already
+            beats are culled, dead lanes never drive a packet, and a mesh
+            miss returns t == seed_t (callers compare with a strict <).
             Returns (t [1,BR], world normal [3 x (1,BR)], albedo
             [3 x (1,BR)]). Same walk as _bvh_instanced_kernel_factory with
             the winning triangle's normal and the instance albedo tracked
@@ -1647,7 +1672,7 @@ def _mesh_trace_kernel_factory(
                 return walked[1:]
 
             init = (
-                jnp.full((1, block), INF, jnp.float32),
+                seed_t,
                 jnp.zeros((1, block), jnp.float32),
                 jnp.zeros((1, block), jnp.float32),
                 jnp.zeros((1, block), jnp.float32),
@@ -1665,12 +1690,13 @@ def _mesh_trace_kernel_factory(
             sign = jnp.where(facing, 1.0, -1.0)
             return best_t, (bnx * sign, bny * sign, bnz * sign), (bar, bag, bab)
 
-        def mesh_occluded(o):
+        def mesh_occluded(o, occluded0):
             """Any-hit toward the (uniform) sun for shadow origins ``o``.
 
-            The sun direction transforms per instance as scalars; occluded
-            lanes stop driving the walk via the best_t=-INF trick (same as
-            _bvh_anyhit_kernel_factory).
+            ``occluded0`` [1, BR] pre-marks lanes whose result cannot
+            matter (sphere-shadowed, dead, backfacing): they stop driving
+            the walks via the best_t=-INF trick (same as
+            _bvh_anyhit_kernel_factory) and come back as 1.
             """
             wox, woy, woz = o[0:1, :], o[1:2, :], o[2:3, :]
             # TRUE rank-0 scalars from SMEM: a [1,1] vector operand here
@@ -1738,9 +1764,7 @@ def _mesh_trace_kernel_factory(
                 )
                 return occluded
 
-            return jax.lax.fori_loop(
-                0, k_count, per_instance, jnp.zeros((1, block), jnp.float32)
-            )
+            return jax.lax.fori_loop(0, k_count, per_instance, occluded0)
 
         throughput = jnp.ones((3, block), jnp.float32)
         radiance = jnp.zeros((3, block), jnp.float32)
@@ -1784,9 +1808,16 @@ def _mesh_trace_kernel_factory(
             )
 
             # -- mesh instances -------------------------------------------
-            t_mesh, (mnx, mny, mnz), (mar, mag, mab) = mesh_nearest(o, d)
-
+            # Seed the walk with the sphere/plane hit (walks it beats are
+            # culled per lane) and -INF for dead lanes (they never drive a
+            # packet; INF is 1e30, so the downstream arithmetic on their
+            # lanes stays finite and alive-masked).
             t_sp = jnp.minimum(t_sphere, t_plane)
+            seed_t = jnp.where(alive > 0.5, t_sp, -INF)
+            t_mesh, (mnx, mny, mnz), (mar, mag, mab) = mesh_nearest(
+                o, d, seed_t
+            )
+
             is_plane = ((t_plane < t_sphere) & (t_mesh >= t_sp)).astype(
                 jnp.float32
             )
@@ -1867,10 +1898,19 @@ def _mesh_trace_kernel_factory(
                 axis=0,
                 keepdims=True,
             )
-            shadowed = jnp.maximum(shadowed, mesh_occluded(shadow_o))
             cos_sun = jnp.maximum(
                 jnp.sum(normal * sun, axis=0, keepdims=True), 0.0
             )
+            # Lanes whose shadow result cannot matter (sphere-shadowed,
+            # dead, backfacing — their direct term is zero regardless)
+            # stop driving the mesh any-hit walks.
+            occluded0 = jnp.maximum(
+                shadowed,
+                jnp.maximum(
+                    1.0 - alive, (cos_sun <= 0.0).astype(jnp.float32)
+                ),
+            )
+            shadowed = mesh_occluded(shadow_o, occluded0)
             direct = (
                 albedo * sun_color * (cos_sun * (1.0 - shadowed) * alive)
                 / jnp.float32(jnp.pi)
@@ -1908,11 +1948,27 @@ def _mesh_trace_kernel_factory(
             d = jnp.where(live, new_d, d)
             return (o, d, throughput, radiance, alive)
 
-        _, _, _, radiance, _ = jax.lax.fori_loop(
-            0, max_bounces, bounce_step,
-            (o, d, throughput, radiance, alive),
-        )
-        out_ref[:, :] = radiance
+        if state_io:
+            # ONE bounce with streamed state: overwrite the in-kernel
+            # initial state with the caller's, run bounce_step once at the
+            # caller's bounce index, stream everything back out.
+            throughput = thr_ref[:, :]
+            alive = alive_ref[:, :]
+            bounce_index = bounce_ref[0, 0]
+            o, d, throughput, radiance, alive = bounce_step(
+                bounce_index, (o, d, throughput, radiance, alive)
+            )
+            out_ref[:, :] = radiance
+            o_out_ref[:, :] = o
+            d_out_ref[:, :] = d
+            thr_out_ref[:, :] = throughput
+            alive_out_ref[:, :] = alive
+        else:
+            _, _, _, radiance, _ = jax.lax.fori_loop(
+                0, max_bounces, bounce_step,
+                (o, d, throughput, radiance, alive),
+            )
+            out_ref[:, :] = radiance
 
     return kernel
 
@@ -2001,6 +2057,156 @@ def _trace_fused_mesh(
       params, sun_direction, inst_table, v0, e1, e2, normal, bounds_min,
       bounds_max, skip, first, count)[0]
     return out.T[:rays]
+
+
+def _mesh_bounce_io(
+    origins, directions, throughput, alive, seed, bounce,
+    centers, radii, albedo, emission,
+    sun_direction, sun_color, sky_horizon, sky_zenith,
+    plane_albedo_a, plane_albedo_b,
+    rotation, translation, scale, inst_albedo,
+    v0, e1, e2, normal, bounds_min, bounds_max, skip, first, count,
+    *, total_bounces: int, interpret: bool,
+):
+    from tpu_render_cluster.render.mesh import LEAF_SIZE
+
+    o_t, d_t, rays, padded_rays = _pad_rays_to_miss(origins, directions)
+    ray_pad = padded_rays - rays
+    thr_t = jnp.pad(throughput, ((0, ray_pad), (0, 0))).T  # [3, Rp]
+    # Pad lanes are DEAD: with their guaranteed-miss rays they never drive
+    # a walk and their contribution stays zero.
+    alive_t = jnp.pad(alive.astype(jnp.float32), (0, ray_pad))[None, :]
+
+    n = centers.shape[0]
+    padded_n = -(-n // _SUBLANE) * _SUBLANE
+    sphere_pad = padded_n - n
+    c_t = jnp.pad(centers, ((0, sphere_pad), (0, 0))).T
+    radii_p = jnp.pad(radii, (0, sphere_pad))
+    r2 = (radii_p * radii_p)[:, None]
+    csq = jnp.sum(c_t * c_t, axis=0)[:, None]
+    rad = radii_p[:, None]
+    albedo_t = jnp.pad(albedo, ((0, sphere_pad), (0, 0))).T
+    emission_t = jnp.pad(emission, ((0, sphere_pad), (0, 0))).T
+    dc_sun = (c_t.T @ sun_direction)[:, None]
+
+    params = jnp.zeros((8, 3), jnp.float32)
+    params = params.at[0].set(sun_direction)
+    params = params.at[1].set(sun_color)
+    params = params.at[2].set(sky_horizon)
+    params = params.at[3].set(sky_zenith)
+    params = params.at[4].set(plane_albedo_a)
+    params = params.at[5].set(plane_albedo_b)
+    seed_arr = jnp.asarray(seed, jnp.int32).reshape(1, 1)
+    bounce_arr = jnp.asarray(bounce, jnp.int32).reshape(1, 1)
+
+    # Front-to-back instance order (pure data reordering — normals/albedo
+    # are tracked in-kernel, so results are order-invariant): near
+    # instances set small best-t early and the per-lane walk culls most of
+    # the rest. Dead lanes are parked at 1e7 by the integrator and must
+    # not drag the anchor.
+    valid = (jnp.abs(origins) < 1e6).all(axis=1) & alive
+    anchor_point = jnp.sum(
+        jnp.where(valid[:, None], origins, 0.0), axis=0
+    ) / jnp.maximum(jnp.sum(valid), 1)
+    near_first = jnp.argsort(
+        jnp.sum((translation - anchor_point[None, :]) ** 2, axis=1)
+    )
+    inst_table = _instance_table(
+        rotation[near_first], translation[near_first], scale[near_first],
+        bounds_min, bounds_max, inst_albedo[near_first],
+    )
+    n_nodes = skip.shape[0]
+    k_count = rotation.shape[0]
+
+    grid = (padded_rays // BVH_BLOCK_R,)
+    whole = lambda i: (0, 0)  # noqa: E731
+    flat = lambda i: (0,)  # noqa: E731
+    ray_block = pl.BlockSpec(
+        (3, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+    row_block = pl.BlockSpec(
+        (1, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+    contrib, o2, d2, thr2, alive2 = pl.pallas_call(
+        _mesh_trace_kernel_factory(
+            total_bounces, padded_n, n_nodes, LEAF_SIZE, k_count,
+            state_io=True,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), whole, memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), whole, memory_space=pltpu.SMEM),
+            ray_block,
+            ray_block,
+            ray_block,
+            row_block,
+            pl.BlockSpec((3, padded_n), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, padded_n), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, padded_n), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, 3), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((3,), flat, memory_space=pltpu.SMEM),
+            pl.BlockSpec(inst_table.shape, whole, memory_space=pltpu.SMEM),
+            pl.BlockSpec(v0.shape, whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec(e1.shape, whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec(e2.shape, whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec(normal.shape, whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec(bounds_min.shape, whole, memory_space=pltpu.SMEM),
+            pl.BlockSpec(bounds_max.shape, whole, memory_space=pltpu.SMEM),
+            pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
+            pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
+            pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
+        ],
+        out_specs=[ray_block, ray_block, ray_block, ray_block, row_block],
+        out_shape=[
+            jax.ShapeDtypeStruct((3, padded_rays), jnp.float32),
+            jax.ShapeDtypeStruct((3, padded_rays), jnp.float32),
+            jax.ShapeDtypeStruct((3, padded_rays), jnp.float32),
+            jax.ShapeDtypeStruct((3, padded_rays), jnp.float32),
+            jax.ShapeDtypeStruct((1, padded_rays), jnp.float32),
+        ],
+        interpret=interpret,
+    )(seed_arr, bounce_arr, o_t, d_t, thr_t, alive_t, c_t, r2, csq, rad,
+      albedo_t, emission_t, dc_sun, params, sun_direction, inst_table,
+      v0, e1, e2, normal, bounds_min, bounds_max, skip, first, count)
+    return (
+        contrib.T[:rays],
+        o2.T[:rays],
+        d2.T[:rays],
+        thr2.T[:rays],
+        alive2[0, :rays] > 0.5,
+    )
+
+
+def mesh_bounce_pallas(
+    scene, mesh, origins, directions, throughput, alive, seed, bounce,
+    *, total_bounces: int,
+):
+    """One fused path-trace bounce for deep-walk mesh scenes.
+
+    The megakernel's bounce_step as a single launch with path state
+    streamed in/out, so integrator.trace_paths can re-sort rays between
+    bounces (packet coherence) without paying per-bounce XLA glue —
+    separate sphere/shadow kernels, threefry RNG, and a dozen elementwise
+    HBM round trips. Returns (radiance contribution [R, 3], new origins,
+    new directions, new throughput, new alive).
+    """
+    bvh = mesh.bvh
+    instances = mesh.instances
+    return _mesh_bounce_io(
+        origins, directions, throughput, alive, seed, bounce,
+        scene.centers, scene.radii, scene.albedo, scene.emission,
+        scene.sun_direction, scene.sun_color, scene.sky_horizon,
+        scene.sky_zenith, scene.plane_albedo_a, scene.plane_albedo_b,
+        instances.rotation, instances.translation, instances.scale,
+        instances.albedo,
+        bvh.v0, bvh.e1, bvh.e2, bvh.normal,
+        bvh.bounds_min, bvh.bounds_max, bvh.skip, bvh.first, bvh.count,
+        total_bounces=total_bounces, interpret=_interpret(),
+    )
 
 
 def trace_paths_fused_mesh(
