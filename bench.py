@@ -128,7 +128,13 @@ def measure_fps(
             if elapsed >= min_window_s:
                 break
         fps.append(frames_done / elapsed)
-    return statistics.median(fps)
+    median_fps = statistics.median(fps)
+    # Feed the live obs gauge with the same accounting the headline number
+    # reports, so a snapshot taken during/after a bench run shows it.
+    from tpu_render_cluster.obs import render_fps_gauge
+
+    render_fps_gauge().set(median_fps)
+    return median_fps
 
 
 # Per-chip peaks for the roofline position, from published TPU specs

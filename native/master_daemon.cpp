@@ -865,8 +865,11 @@ class MasterDaemon {
             LOG_ERROR("Bad --host: %s", options_.host.c_str());
             return false;
         }
-        if (bind(listen_fd_, reinterpret_cast<struct sockaddr*>(&addr),
-                 sizeof(addr)) != 0) {
+        // ::bind, explicitly: listen_fd_ is std::atomic<int>, and ADL on it
+        // drags std::bind into the overload set, where the perfect-forwarding
+        // template beats the socket call's atomic->int conversion.
+        if (::bind(listen_fd_, reinterpret_cast<struct sockaddr*>(&addr),
+                   sizeof(addr)) != 0) {
             LOG_ERROR("bind(%s:%d) failed: %s", options_.host.c_str(),
                       options_.port, strerror(errno));
             return false;
@@ -1273,7 +1276,7 @@ class MasterDaemon {
             } else {
                 disconnected_since = -1;
             }
-            responses_cv_.wait_for(lock, std::chrono::milliseconds(500));
+            cv_wait_for(responses_cv_, lock, std::chrono::milliseconds(500));
         }
     }
 

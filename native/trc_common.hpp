@@ -55,6 +55,31 @@ int trc_parse_header(const uint8_t* buf, size_t len, uint8_t* opcode, int* fin,
 // ---------------------------------------------------------------------------
 // Small utilities
 
+// Timed condition-variable waits, routed through a system_clock deadline.
+// libstdc++ (GCC 10+) lowers wait_for / steady_clock wait_until to
+// pthread_cond_clockwait, which older TSAN runtimes do not intercept — the
+// wait's internal mutex release becomes invisible and every subsequent
+// access under that mutex is falsely reported as a double-lock / data
+// race. A system_clock deadline takes the intercepted
+// pthread_cond_timedwait path instead. The only semantic difference is
+// sensitivity to wall-clock steps, harmless here: every caller re-checks
+// its predicate / deadline in a loop.
+template <typename Rep, typename Period>
+inline std::cv_status cv_wait_for(std::condition_variable& cv,
+                                  std::unique_lock<std::mutex>& lock,
+                                  std::chrono::duration<Rep, Period> rel) {
+    return cv.wait_until(lock, std::chrono::system_clock::now() + rel);
+}
+
+template <typename Rep, typename Period, typename Predicate>
+inline bool cv_wait_for(std::condition_variable& cv,
+                        std::unique_lock<std::mutex>& lock,
+                        std::chrono::duration<Rep, Period> rel,
+                        Predicate predicate) {
+    return cv.wait_until(lock, std::chrono::system_clock::now() + rel,
+                         std::move(predicate));
+}
+
 inline double now_ts() {
     struct timeval tv;
     gettimeofday(&tv, nullptr);

@@ -559,7 +559,8 @@ class WorkerDaemon {
             }
             std::unique_lock<std::mutex> lock(ws_mutex_);
             ws_.shutdown_socket();  // wake the IO thread's recv
-            if (reconnected_cv_.wait_until(lock, deadline) ==
+            if (cv_wait_for(reconnected_cv_, lock,
+                            deadline - std::chrono::steady_clock::now()) ==
                 std::cv_status::timeout)
                 return false;
         }
@@ -742,7 +743,7 @@ class WorkerDaemon {
             bool have_frame = false;
             {
                 std::unique_lock<std::mutex> lock(queue_mutex_);
-                queue_cv_.wait_for(lock, std::chrono::milliseconds(100), [&] {
+                cv_wait_for(queue_cv_, lock, std::chrono::milliseconds(100), [&] {
                     return cancelled_.load() || !queue_.empty();
                 });
                 if (cancelled_.load()) return;

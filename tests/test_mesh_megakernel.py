@@ -99,12 +99,23 @@ def test_megakernel_deep_tree_matches_xla(monkeypatch):
     # implementations' borderline FP decisions (different reduction orders,
     # different det epsilons) can pick different-but-valid winners; which
     # lanes land on edges shifts with leaf grouping (LEAF_SIZE). The
-    # constraint: at most 1% of lanes may diverge beyond the 2e-3 radiance
-    # tolerance (a traversal bug — skipped leaf, wrong skip link — flips
-    # whole regions, not isolated edge pixels).
+    # budget is deliberately tight — 0.1% of lanes beyond the 2e-3
+    # radiance tolerance, floored at one absolute lane (0.1% of these 256
+    # lanes rounds to zero, and a single legitimate edge tie shifting with
+    # platform/FP details must not fail the suite) — because the per-lane
+    # culling machinery (seed-t, candidate-first sweep, scalar-branch leaf
+    # skip) fails precisely as ISOLATED wrong lanes, not flipped regions;
+    # a loose fraction would let a scattered-lane culling bug ship. The
+    # mean absolute error bound catches the complementary failure: many
+    # lanes each off by slightly more than noise.
     lane_diff = np.abs(out - ref).max(axis=1)
-    edge_fraction = float((lane_diff > 2e-3).mean())
-    assert edge_fraction < 0.01, f"{edge_fraction:.3%} lanes diverge"
+    n_diverged = int((lane_diff > 2e-3).sum())
+    budget = max(1, round(0.001 * lane_diff.size))
+    assert n_diverged <= budget, (
+        f"{n_diverged}/{lane_diff.size} lanes diverge (budget {budget})"
+    )
+    mean_abs_error = float(np.abs(out - ref).mean())
+    assert mean_abs_error < 1e-4, f"mean |out - ref| = {mean_abs_error:.2e}"
 
 
 def test_stochastic_mesh_render_agrees_statistically(monkeypatch):

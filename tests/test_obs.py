@@ -1,0 +1,350 @@
+"""Obs subsystem tests: registry semantics, tracer export, wire merging,
+heartbeat payload serde, and the end-to-end local-harness artifact check
+(ISSUE 1 acceptance: mock-backend run emits a loadable Perfetto trace with
+master/worker/transport spans plus nonzero frame-phase histograms, and
+``analysis/`` loads both files without errors).
+"""
+
+import json
+import math
+import threading
+
+import pytest
+
+from tpu_render_cluster.analysis.obs_events import (
+    load_metrics_snapshot,
+    load_obs_artifacts,
+    load_trace_events,
+    summarize_obs,
+)
+from tpu_render_cluster.jobs.models import BlenderJob, DistributionStrategy
+from tpu_render_cluster.obs import (
+    DEFAULT_BUCKETS,
+    MetricsRegistry,
+    Tracer,
+    export_chrome_trace,
+    log_buckets,
+    merge_wire,
+    write_metrics_snapshot,
+)
+from tpu_render_cluster.protocol import messages as pm
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics
+
+
+def test_counter_labels_and_monotonicity():
+    registry = MetricsRegistry()
+    counter = registry.counter("frames_total", "frames", labels=("worker",))
+    counter.inc(worker="w1")
+    counter.inc(2.5, worker="w1")
+    counter.inc(worker="w2")
+    assert counter.value(worker="w1") == 3.5
+    assert counter.value(worker="w2") == 1.0
+    assert counter.value(worker="nope") == 0.0
+    with pytest.raises(ValueError):
+        counter.inc(-1.0, worker="w1")
+    # Label sets must match the declared dimensions exactly.
+    with pytest.raises(ValueError):
+        counter.inc(host="w1")
+    with pytest.raises(ValueError):
+        counter.inc()  # missing the 'worker' label
+
+
+def test_gauge_set_and_add():
+    registry = MetricsRegistry()
+    gauge = registry.gauge("queue_depth")
+    gauge.set(7)
+    assert gauge.value() == 7.0
+    gauge.add(-2)
+    assert gauge.value() == 5.0
+
+
+def test_get_or_create_is_idempotent_and_type_checked():
+    registry = MetricsRegistry()
+    a = registry.counter("x", labels=("k",))
+    b = registry.counter("x", labels=("k",))
+    assert a is b
+    # Same name, different kind or label shape: refused, not silently aliased.
+    with pytest.raises(ValueError):
+        registry.gauge("x")
+    with pytest.raises(ValueError):
+        registry.counter("x", labels=("other",))
+    # Bucket shape is part of a histogram's identity.
+    h = registry.histogram("hist", buckets=(1.0, 2.0))
+    assert registry.histogram("hist", buckets=(1.0, 2.0)) is h
+    with pytest.raises(ValueError):
+        registry.histogram("hist", buckets=(1.0, 4.0))
+
+
+def test_log_buckets_shape():
+    bounds = log_buckets(1e-4, 1e3, 3)
+    assert bounds == DEFAULT_BUCKETS
+    assert len(bounds) == 22  # 7 decades * 3/decade + 1, inclusive
+    assert bounds[0] == pytest.approx(1e-4)
+    assert bounds[-1] == pytest.approx(1e3)
+    assert list(bounds) == sorted(bounds)
+
+
+def test_histogram_bucketing_and_stats():
+    registry = MetricsRegistry()
+    hist = registry.histogram("lat", buckets=(0.01, 0.1, 1.0))
+    for value in (0.005, 0.05, 0.5, 0.5, 5.0):
+        hist.observe(value)
+    series = hist.series()
+    assert series.counts == [1, 1, 2]
+    assert series.overflow == 1
+    assert series.count == 5
+    assert series.sum == pytest.approx(6.055)
+    assert series.min == pytest.approx(0.005)
+    assert series.max == pytest.approx(5.0)
+    # Boundary value lands in its bucket (le semantics: value <= bound).
+    hist.observe(0.1)
+    assert hist.series().counts == [1, 2, 2]
+    with pytest.raises(ValueError):
+        registry.histogram("unsorted", buckets=(1.0, 0.1))
+
+
+def test_registry_snapshot_shape():
+    registry = MetricsRegistry()
+    registry.counter("c", "help text", labels=("k",)).inc(k="v")
+    registry.histogram("h", buckets=(1.0, 2.0)).observe(1.5)
+    snap = registry.snapshot()
+    assert snap["c"]["type"] == "counter"
+    assert snap["c"]["series"]["k=v"] == 1.0
+    entry = snap["h"]
+    assert entry["bucket_bounds"] == [1.0, 2.0]
+    # bucket_counts carries the +inf overflow bucket as its last element.
+    assert entry["series"][""]["bucket_counts"] == [0, 1, 0]
+    json.dumps(snap)  # must be JSON-able as-is
+
+
+def test_registry_thread_safety():
+    registry = MetricsRegistry()
+    counter = registry.counter("n", labels=("t",))
+    hist = registry.histogram("h")
+    n_threads, n_iter = 8, 1000
+
+    def work(tag: str) -> None:
+        for i in range(n_iter):
+            counter.inc(t=tag)
+            counter.inc(t="shared")
+            hist.observe(1e-4 * (i + 1))
+
+    threads = [
+        threading.Thread(target=work, args=(f"t{i}",)) for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert counter.value(t="shared") == n_threads * n_iter
+    for i in range(n_threads):
+        assert counter.value(t=f"t{i}") == n_iter
+    series = hist.series()
+    assert series.count == n_threads * n_iter
+    assert sum(series.counts) + series.overflow == series.count
+
+
+# ---------------------------------------------------------------------------
+# Wire form + merging
+
+
+def test_to_wire_and_merge():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    for registry, count in ((a, 2), (b, 3)):
+        registry.counter("frames", labels=("w",)).inc(count, w="x")
+        registry.gauge("depth").set(count)
+        hist = registry.histogram("lat")
+        for _ in range(count):
+            hist.observe(0.05)
+    merged = merge_wire([a.to_wire(), b.to_wire()])
+    assert merged["c"]["frames|w=x"] == 5.0
+    assert merged["g"]["depth"] == 5.0
+    hist_entry = merged["h"]["lat"]
+    assert hist_entry["n"] == 5
+    assert hist_entry["s"] == pytest.approx(0.25)
+    assert hist_entry["min"] == pytest.approx(0.05)
+    assert hist_entry["max"] == pytest.approx(0.05)
+    assert sum(hist_entry["b"]) == 5
+    assert hist_entry["le"] == list(DEFAULT_BUCKETS)
+
+
+def test_merge_wire_rejects_mismatched_bounds():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.histogram("lat", buckets=(1.0, 2.0)).observe(1.5)
+    b.histogram("lat", buckets=(1.0, 4.0)).observe(1.5)
+    with pytest.raises(ValueError, match="bounds mismatch"):
+        merge_wire([a.to_wire(), b.to_wire()])
+
+
+# ---------------------------------------------------------------------------
+# Tracer: span nesting + export round-trip
+
+
+def test_span_nesting_and_export_round_trip(tmp_path):
+    tracer = Tracer("test-proc", pid=42)
+    with tracer.span("outer", cat="master", track="job"):
+        with tracer.span("inner", cat="master", track="job", args={"k": 1}):
+            pass
+    tracer.instant("marker", track="job")
+    path = tracer.export(tmp_path / "trace.json")
+
+    loaded = load_trace_events(path)
+    spans = {e["name"]: e for e in loaded.spans()}
+    assert set(spans) == {"outer", "inner"}
+    outer, inner = spans["outer"], spans["inner"]
+    # Same named track -> same tid; viewer nests by ts/dur containment.
+    assert outer["tid"] == inner["tid"]
+    assert outer["ts"] <= inner["ts"]
+    assert outer["ts"] + outer["dur"] >= inner["ts"] + inner["dur"]
+    assert inner["args"] == {"k": 1}
+    # Metadata rows name the process and the track for the viewer.
+    meta = {e["name"]: e for e in loaded.events if e["ph"] == "M"}
+    assert meta["process_name"]["args"]["name"] == "test-proc"
+    assert meta["thread_name"]["args"]["name"] == "job"
+    assert any(e["ph"] == "i" for e in loaded.events)
+
+
+def test_tracer_event_cap_drops_not_grows():
+    tracer = Tracer("tiny", max_events=2)
+    for i in range(5):
+        tracer.complete(f"s{i}", start_wall=0.0, duration=0.001, track="t")
+    assert len(tracer.events()) == 2
+    assert tracer.dropped == 3
+
+
+def test_export_chrome_trace_merges_tracers(tmp_path):
+    master = Tracer("master")
+    worker = Tracer("worker-1")
+    with master.span("run job", cat="master", track="job"):
+        pass
+    with worker.span("render", cat="worker", track="frames"):
+        pass
+    path = export_chrome_trace(tmp_path / "merged.json", [master, worker])
+    loaded = load_trace_events(path)
+    pids = {e["pid"] for e in loaded.spans()}
+    assert len(pids) == 2  # one Perfetto process row per tracer
+    names = {e["args"]["name"] for e in loaded.events if e["name"] == "process_name"}
+    assert names == {"master", "worker-1"}
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat metrics payload serde
+
+
+def test_heartbeat_pong_round_trips_metrics_payload():
+    registry = MetricsRegistry()
+    registry.counter("worker_frames_rendered_total").inc(4)
+    registry.histogram("worker_frame_phase_seconds", labels=("phase",)).observe(
+        0.02, phase="render"
+    )
+    pong = pm.WorkerHeartbeatResponse(metrics=registry.to_wire())
+    decoded = pm.decode_message(pm.encode_message(pong))
+    assert isinstance(decoded, pm.WorkerHeartbeatResponse)
+    assert decoded.metrics == pong.metrics
+    merged = merge_wire([decoded.metrics])
+    assert merged["c"]["worker_frames_rendered_total"] == 4.0
+
+
+def test_heartbeat_pong_without_metrics_is_reference_compatible():
+    pong = pm.WorkerHeartbeatResponse()
+    encoded = pm.encode_message(pong)
+    # Wire bytes identical to the reference's empty payload.
+    assert json.loads(encoded)["payload"] == {}
+    decoded = pm.decode_message(encoded)
+    assert decoded.metrics is None
+
+
+def test_heartbeat_pong_rejects_non_object_metrics():
+    with pytest.raises(ValueError):
+        pm.WorkerHeartbeatResponse.from_payload({"metrics": [1, 2, 3]})
+
+
+# ---------------------------------------------------------------------------
+# Snapshot writer
+
+
+def test_write_metrics_snapshot(tmp_path):
+    registry = MetricsRegistry()
+    registry.gauge("depth").set(3)
+    path = write_metrics_snapshot(
+        tmp_path / "metrics.json", registry, extra={"cluster": {"workers": {}}}
+    )
+    data = load_metrics_snapshot(path)
+    assert data["metrics"]["depth"]["series"][""] == 3.0
+    assert data["cluster"] == {"workers": {}}
+    assert data["written_at"] > 0
+    assert not list(tmp_path.glob("*.tmp"))  # atomic replace left no temp file
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: local harness (mock backend) -> loadable artifacts
+
+
+def _make_job(frames: int, workers: int) -> BlenderJob:
+    return BlenderJob(
+        job_name="obs-test",
+        job_description="obs integration test",
+        project_file_path="%BASE%/p.blend",
+        render_script_path="%BASE%/s.py",
+        frame_range_from=1,
+        frame_range_to=frames,
+        wait_for_number_of_workers=workers,
+        frame_distribution_strategy=DistributionStrategy.naive_fine(),
+        output_directory_path="%BASE%/out",
+        output_file_name_format="rendered-#####",
+        output_file_format="PNG",
+    )
+
+
+def test_local_harness_emits_loadable_obs_artifacts(tmp_path):
+    from tpu_render_cluster.harness import run_and_persist
+    from tpu_render_cluster.worker.backends.mock import MockBackend
+
+    backends = [MockBackend(render_seconds=0.01) for _ in range(2)]
+    run_and_persist(_make_job(6, 2), backends, tmp_path)
+
+    traces, metrics = load_obs_artifacts(tmp_path)
+    assert len(traces) == 1 and len(metrics) == 1
+
+    # Master, worker, AND transport spans present in one merged timeline.
+    cats = traces[0].span_count_by_category()
+    assert cats.get("master", 0) > 0
+    assert cats.get("worker", 0) > 0
+    assert cats.get("transport", 0) > 0
+    # Every frame contributes its four phase spans on some worker row.
+    by_name = traces[0].span_seconds_by_name()
+    for phase in ("queue_wait", "read", "render", "write"):
+        assert len(by_name[phase]) == 6, phase
+    assert all(d >= 0.01 for d in by_name["render"])
+
+    # Metrics snapshot: nonzero frame-phase histograms, both in each
+    # worker's full snapshot and in the wire-merged cluster aggregate.
+    snapshot = metrics[0]
+    merged = snapshot["workers_wire_merged"]
+    for phase in ("queue_wait", "read", "render", "write"):
+        entry = merged["h"][f"worker_frame_phase_seconds|phase={phase}"]
+        assert entry["n"] == 6, phase
+        assert entry["s"] > 0 or phase == "queue_wait"
+    assert merged["c"]["worker_frames_rendered_total"] == 6.0
+    per_worker = snapshot["workers"]
+    assert len(per_worker) == 2
+    total = sum(
+        series["count"]
+        for worker_snap in per_worker.values()
+        for series in worker_snap["worker_frame_phase_seconds"]["series"].values()
+    )
+    assert total == 6 * 4
+    # Master-side series: assignment latency observed per strategy.
+    master_metrics = snapshot["metrics"]
+    lat = master_metrics["master_assignment_latency_seconds"]["series"]
+    assert sum(s["count"] for s in lat.values()) == 6
+    assert snapshot["cluster"]["frames_finished"] == 6
+
+    # The analysis roll-up consumes both without errors.
+    summary = summarize_obs(traces, metrics)
+    assert summary["spans_by_category"]["worker"] >= 24
+    assert summary["span_duration_stats"]["render"]["count"] == 6
+    assert math.isfinite(summary["span_duration_stats"]["render"]["p95_s"])

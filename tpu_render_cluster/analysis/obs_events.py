@@ -1,0 +1,157 @@
+"""Loaders for the obs subsystem's artifacts (reference has no analog).
+
+Two new file families land next to the legacy ``*_raw-trace.json``:
+
+- ``*_trace-events.json`` — Chrome trace-event JSON (Perfetto-loadable)
+  with master / worker / transport spans;
+- ``*_metrics.json`` — metrics registry snapshots (+ the cluster view and
+  per-worker heartbeat payload aggregation).
+
+This module validates and loads both so ``run_all`` can fold live-signal
+summaries (per-phase span statistics, span counts by category) into
+``statistics.json`` alongside the legacy post-hoc metrics.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Callable
+
+TRACE_EVENTS_GLOB = "*_trace-events.json"
+METRICS_SNAPSHOT_GLOB = "*_metrics.json"
+
+
+def find_trace_event_files(results_directory: str | Path) -> list[Path]:
+    return sorted(Path(results_directory).rglob(TRACE_EVENTS_GLOB))
+
+
+def find_metrics_files(results_directory: str | Path) -> list[Path]:
+    return sorted(Path(results_directory).rglob(METRICS_SNAPSHOT_GLOB))
+
+
+@dataclass(frozen=True)
+class ObsTrace:
+    """One loaded trace-event file."""
+
+    path: Path
+    events: list[dict[str, Any]]
+
+    def spans(self) -> list[dict[str, Any]]:
+        """Complete ('X') events only — the duration-carrying spans."""
+        return [e for e in self.events if e.get("ph") == "X"]
+
+    def span_seconds_by_name(self) -> dict[str, list[float]]:
+        out: dict[str, list[float]] = {}
+        for event in self.spans():
+            out.setdefault(str(event.get("name")), []).append(
+                float(event.get("dur", 0.0)) / 1e6
+            )
+        return out
+
+    def span_count_by_category(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for event in self.spans():
+            cat = str(event.get("cat", "default"))
+            out[cat] = out.get(cat, 0) + 1
+        return out
+
+
+def load_trace_events(path: str | Path) -> ObsTrace:
+    """Load + validate one Chrome trace-event file.
+
+    Accepts both container formats the viewers accept: the JSON Object
+    Format (``{"traceEvents": [...]}`` — what this repo writes) and the
+    bare JSON Array Format.
+    """
+    path = Path(path)
+    data = json.loads(path.read_text(encoding="utf-8"))
+    if isinstance(data, dict):
+        events = data.get("traceEvents")
+    elif isinstance(data, list):
+        events = data
+    else:
+        raise ValueError(f"{path}: not a Chrome trace-event document")
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: traceEvents must be a list")
+    for event in events:
+        if not isinstance(event, dict) or "ph" not in event:
+            raise ValueError(f"{path}: malformed trace event: {event!r}")
+        if event["ph"] == "X" and ("ts" not in event or "dur" not in event):
+            raise ValueError(f"{path}: complete event missing ts/dur: {event!r}")
+    return ObsTrace(path=path, events=events)
+
+
+def load_metrics_snapshot(path: str | Path) -> dict[str, Any]:
+    """Load + validate one metrics snapshot file."""
+    path = Path(path)
+    data = json.loads(path.read_text(encoding="utf-8"))
+    if not isinstance(data, dict) or "metrics" not in data:
+        raise ValueError(f"{path}: not a metrics snapshot (missing 'metrics')")
+    if not isinstance(data["metrics"], dict):
+        raise ValueError(f"{path}: 'metrics' must be an object")
+    return data
+
+
+def load_obs_artifacts(
+    results_directory: str | Path,
+    *,
+    on_error: "Callable[[Path, Exception], None] | None" = None,
+) -> tuple[list[ObsTrace], list[dict[str, Any]]]:
+    """Load every obs artifact under a results directory (both families).
+
+    With ``on_error`` set, a malformed file is reported to it and skipped
+    so one bad artifact doesn't discard the rest of the population;
+    without it, the first malformed file raises.
+    """
+    traces: list[ObsTrace] = []
+    metrics: list[dict[str, Any]] = []
+    for loader, sink, paths in (
+        (load_trace_events, traces, find_trace_event_files(results_directory)),
+        (load_metrics_snapshot, metrics, find_metrics_files(results_directory)),
+    ):
+        for path in paths:
+            try:
+                sink.append(loader(path))
+            except (ValueError, OSError, json.JSONDecodeError) as e:
+                if on_error is None:
+                    raise
+                on_error(path, e)
+    return traces, metrics
+
+
+def _percentile(sorted_values: list[float], q: float) -> float:
+    if not sorted_values:
+        return 0.0
+    index = min(len(sorted_values) - 1, int(round(q * (len(sorted_values) - 1))))
+    return sorted_values[index]
+
+
+def summarize_obs(
+    traces: list[ObsTrace], metrics: list[dict[str, Any]]
+) -> dict[str, Any]:
+    """Roll obs artifacts into a ``statistics.json``-shaped summary."""
+    span_counts: dict[str, int] = {}
+    durations: dict[str, list[float]] = {}
+    for trace in traces:
+        for cat, count in trace.span_count_by_category().items():
+            span_counts[cat] = span_counts.get(cat, 0) + count
+        for name, values in trace.span_seconds_by_name().items():
+            durations.setdefault(name, []).extend(values)
+    span_stats = {}
+    for name, values in sorted(durations.items()):
+        values = sorted(values)
+        span_stats[name] = {
+            "count": len(values),
+            "total_s": sum(values),
+            "p50_s": _percentile(values, 0.50),
+            "p95_s": _percentile(values, 0.95),
+            "max_s": values[-1],
+        }
+    return {
+        "trace_event_files": len(traces),
+        "metrics_snapshot_files": len(metrics),
+        "spans_by_category": span_counts,
+        "span_duration_stats": span_stats,
+    }

@@ -17,6 +17,7 @@ import sys
 from pathlib import Path
 
 from tpu_render_cluster.analysis import metrics as M
+from tpu_render_cluster.analysis.obs_events import load_obs_artifacts, summarize_obs
 from tpu_render_cluster.analysis.parser import load_traces
 from tpu_render_cluster.analysis.paths import DEFAULT_ANALYSIS_DIR, DEFAULT_RESULTS_DIR
 from tpu_render_cluster.analysis.timed_context import timed_section
@@ -44,6 +45,22 @@ def main(argv: list[str] | None = None) -> int:
         return 1
     print(f"Loaded {len(traces)} run(s).")
 
+    # Obs artifacts (trace-event spans + metrics snapshots) ride alongside
+    # the legacy raw traces when the run was instrumented; absent files
+    # just mean an uninstrumented (or reference-produced) population.
+    with timed_section("load obs artifacts"):
+        obs_traces, obs_metrics = load_obs_artifacts(
+            args.results,
+            on_error=lambda path, e: print(
+                f"Skipping malformed obs artifact {path}: {e}", file=sys.stderr
+            ),
+        )
+    if obs_traces or obs_metrics:
+        print(
+            f"Loaded {len(obs_traces)} trace-event file(s), "
+            f"{len(obs_metrics)} metrics snapshot(s)."
+        )
+
     out = Path(args.out)
     out.mkdir(parents=True, exist_ok=True)
 
@@ -56,6 +73,8 @@ def main(argv: list[str] | None = None) -> int:
         "phase_split": {str(k): v for k, v in M.phase_split_stats(traces).items()},
         "run_statistics": {str(k): v for k, v in M.run_statistics(traces).items()},
     }
+    if obs_traces or obs_metrics:
+        stats["obs"] = summarize_obs(obs_traces, obs_metrics)
     stats_path = out / "statistics.json"
     stats_path.write_text(json.dumps(stats, indent=2))
     print(f"Statistics written to {stats_path}")

@@ -7,6 +7,10 @@ and persists reference-schema raw traces, so the measurement product
 (analysis A5-A12) can be produced and regenerated anywhere.
 """
 
-from tpu_render_cluster.harness.local import run_local_job, run_and_persist
+from tpu_render_cluster.harness.local import (
+    run_and_persist,
+    run_local_job,
+    save_obs_artifacts,
+)
 
-__all__ = ["run_local_job", "run_and_persist"]
+__all__ = ["run_local_job", "run_and_persist", "save_obs_artifacts"]

@@ -21,6 +21,13 @@ from tpu_render_cluster.master.persist import (
     save_processed_results,
     save_raw_traces,
 )
+from tpu_render_cluster.obs import (
+    MetricsRegistry,
+    export_chrome_trace,
+    merge_wire,
+    write_metrics_snapshot,
+)
+from tpu_render_cluster.protocol.messages import worker_id_to_string
 from tpu_render_cluster.traces.master_trace import MasterTrace
 from tpu_render_cluster.traces.worker_trace import WorkerTrace
 from tpu_render_cluster.worker.backends.base import RenderBackend
@@ -28,7 +35,11 @@ from tpu_render_cluster.worker.runtime import Worker
 
 
 async def _run(job: BlenderJob, backends: list[RenderBackend]):
-    manager = ClusterManager("127.0.0.1", 0, job)
+    # A fresh registry per run: harness callers (tests, sweep scripts)
+    # run many jobs in one process, and per-run artifacts must not
+    # accumulate counts across runs the way the CLI's process-global
+    # default (one job per process) is allowed to.
+    manager = ClusterManager("127.0.0.1", 0, job, metrics=MetricsRegistry())
     server_task = asyncio.create_task(manager.initialize_server_and_run_job())
     while manager._server is None:
         if server_task.done():
@@ -37,13 +48,25 @@ async def _run(job: BlenderJob, backends: list[RenderBackend]):
             await server_task
             raise RuntimeError("master server task exited before startup")
         await asyncio.sleep(0.01)
-    workers = [Worker("127.0.0.1", manager.port, backend) for backend in backends]
+    # Fresh per-worker registries too: colocated workers must not share
+    # the process-global registry or their heartbeat payloads (and the
+    # per-worker snapshots in the metrics artifact) would double-count.
+    workers = [
+        Worker("127.0.0.1", manager.port, backend, metrics=MetricsRegistry())
+        for backend in backends
+    ]
     worker_tasks = [
         asyncio.create_task(w.connect_and_run_to_job_completion()) for w in workers
     ]
     master_trace, worker_traces = await server_task
     await asyncio.gather(*worker_tasks)
-    return master_trace, worker_traces
+    return master_trace, worker_traces, manager, workers
+
+
+def _run_local_job_full(
+    job: BlenderJob, backends: list[RenderBackend], timeout: float
+) -> tuple[MasterTrace, list[tuple[str, WorkerTrace]], ClusterManager, list[Worker]]:
+    return asyncio.run(asyncio.wait_for(_run(job, backends), timeout))
 
 
 def run_local_job(
@@ -53,7 +76,42 @@ def run_local_job(
     timeout: float = 600.0,
 ) -> tuple[MasterTrace, list[tuple[str, WorkerTrace]]]:
     """Run one job on an in-process cluster; returns (master trace, worker traces)."""
-    return asyncio.run(asyncio.wait_for(_run(job, backends), timeout))
+    master_trace, worker_traces, _, _ = _run_local_job_full(job, backends, timeout)
+    return master_trace, worker_traces
+
+
+def save_obs_artifacts(
+    prefix_path: Path, manager: ClusterManager, workers: list[Worker]
+) -> tuple[Path, Path]:
+    """Write ``<prefix>_trace-events.json`` + ``<prefix>_metrics.json``.
+
+    The trace-event file merges the master's span tracer with every
+    worker's (one Perfetto process row each) and loads directly in
+    https://ui.perfetto.dev or chrome://tracing. The metrics file carries
+    the master registry snapshot, the live cluster view, each worker's
+    full registry snapshot, and their ``merge_wire`` aggregation —
+    exactly what a multi-host master assembles from heartbeat payloads,
+    but collected in-process after the run.
+    """
+    trace_path = export_chrome_trace(
+        prefix_path.with_name(prefix_path.name + "_trace-events.json"),
+        [manager.span_tracer] + [w.span_tracer for w in workers],
+    )
+    worker_snapshots = {
+        worker_id_to_string(w.worker_id): w.metrics.snapshot() for w in workers
+    }
+    metrics_path = write_metrics_snapshot(
+        prefix_path.with_name(prefix_path.name + "_metrics.json"),
+        manager.metrics,
+        extra={
+            **manager.cluster_view(),
+            "workers": worker_snapshots,
+            "workers_wire_merged": merge_wire(
+                [w.metrics.to_wire() for w in workers]
+            ),
+        },
+    )
+    return trace_path, metrics_path
 
 
 def run_and_persist(
@@ -63,14 +121,26 @@ def run_and_persist(
     *,
     timeout: float = 600.0,
 ) -> Path:
-    """Run and write ``*_raw-trace.json`` + processed results; returns the raw path."""
+    """Run and write ``*_raw-trace.json`` + processed results; returns the raw path.
+
+    Also emits the obs artifacts next to them: ``*_trace-events.json``
+    (Chrome trace-event spans for master, workers, and transport) and
+    ``*_metrics.json`` (metrics snapshot incl. frame-phase histograms).
+    """
     from tpu_render_cluster.ops import assignment as assignment_ops
 
     start = datetime.now()
     assignment_ops.reset_greedy_fallback_count()
-    master_trace, worker_traces = run_local_job(job, backends, timeout=timeout)
+    master_trace, worker_traces, manager, workers = _run_local_job_full(
+        job, backends, timeout
+    )
     results_directory = Path(results_directory)
     raw_path = save_raw_traces(start, job, results_directory, master_trace, worker_traces)
+    save_obs_artifacts(
+        raw_path.with_name(raw_path.name.replace("_raw-trace.json", "")),
+        manager,
+        workers,
+    )
     performance = parse_worker_traces(worker_traces)
     save_processed_results(
         start,

@@ -21,15 +21,27 @@ import asyncio
 import logging
 import time
 
+from pathlib import Path
+
 from tpu_render_cluster import PROTOCOL_VERSION
 from tpu_render_cluster.jobs.models import BlenderJob
 from tpu_render_cluster.master.state import ClusterManagerState, FrameStatus
 from tpu_render_cluster.master.strategies import run_strategy
 from tpu_render_cluster.master.worker_handle import WorkerHandle
+from tpu_render_cluster.obs import (
+    MetricsRegistry,
+    SnapshotWriter,
+    Tracer,
+    get_registry,
+    merge_wire,
+)
 from tpu_render_cluster.protocol import messages as pm
 from tpu_render_cluster.traces.master_trace import MasterTrace
 from tpu_render_cluster.traces.worker_trace import WorkerTrace
-from tpu_render_cluster.transport.reconnect import ReconnectableServerConnection
+from tpu_render_cluster.transport.reconnect import (
+    ReconnectableServerConnection,
+    TransportMetrics,
+)
 from tpu_render_cluster.transport.ws import (
     WebSocketClosed,
     WebSocketConnection,
@@ -46,13 +58,39 @@ BARRIER_POLL_SECONDS = 1.0  # reference: master/src/cluster/mod.rs:568-585
 class ClusterManager:
     """Runs one job across a cluster of connected workers."""
 
-    def __init__(self, host: str, port: int, job: BlenderJob) -> None:
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        job: BlenderJob,
+        *,
+        metrics: MetricsRegistry | None = None,
+        span_tracer: Tracer | None = None,
+        metrics_snapshot_path: str | Path | None = None,
+    ) -> None:
         self.host = host
         self.port = port
         self.job = job
         self.state = ClusterManagerState(job)
         self.workers: dict[int, WorkerHandle] = {}
         self.cancellation = CancellationToken()
+        # Defaults to the process-global registry so process-scoped sources
+        # (ops/assignment's greedy-fallback counter, the render path) land
+        # in the same snapshot as the master's own series.
+        self.metrics = metrics if metrics is not None else get_registry()
+        self.span_tracer = span_tracer or Tracer("master")
+        self._transport_metrics = TransportMetrics(self.metrics)
+        # When set, a 1 Hz SnapshotWriter keeps this file fresh while the
+        # job runs (live inspection), with a final write at shutdown.
+        self._snapshot_writer = (
+            SnapshotWriter(
+                metrics_snapshot_path,
+                self.metrics,
+                extra_fn=self.cluster_view,
+            )
+            if metrics_snapshot_path is not None
+            else None
+        )
         self._job_started = False
         self._server: asyncio.Server | None = None
 
@@ -68,11 +106,16 @@ class ClusterManager:
         actual_port = self._server.sockets[0].getsockname()[1]
         self.port = actual_port
         logger.info("Master listening on %s:%d", self.host, actual_port)
+        if self._snapshot_writer is not None:
+            self._snapshot_writer.start()
         try:
             master_trace = await self._wait_for_workers_and_run_job()
-            worker_traces = await self._collect_worker_traces()
+            with self.span_tracer.span("collect traces", cat="master", track="job"):
+                worker_traces = await self._collect_worker_traces()
             return master_trace, worker_traces
         finally:
+            if self._snapshot_writer is not None:
+                await self._snapshot_writer.stop()
             self.cancellation.cancel()
             # Close worker sockets BEFORE wait_closed(): since 3.12,
             # Server.wait_closed() waits for every live connection handler.
@@ -86,6 +129,44 @@ class ClusterManager:
 
     def live_workers(self) -> list[WorkerHandle]:
         return [w for w in self.workers.values() if not w.is_dead]
+
+    def cluster_view(self) -> dict:
+        """Live cluster-wide extras for the metrics snapshot.
+
+        Combines the master's own frame-table view with the most recent
+        compact metrics payload each worker piggybacked on its heartbeat
+        pong, plus their ``merge_wire`` aggregation.
+        """
+        worker_payloads = {
+            pm.worker_id_to_string(w.worker_id): w.latest_worker_metrics
+            for w in self.workers.values()
+            if w.latest_worker_metrics is not None
+        }
+        view: dict = {
+            "cluster": {
+                "frames_total": len(self.state.frames),
+                "frames_finished": self.state.finished_count(),
+                "frames_pending": self.state.pending_count(),
+                "workers": {
+                    pm.worker_id_to_string(w.worker_id): {
+                        "queue_depth": len(w.queue),
+                        "is_dead": w.is_dead,
+                        "frames_stolen": w.frames_stolen_count,
+                    }
+                    for w in self.workers.values()
+                },
+            }
+        }
+        if worker_payloads:
+            view["worker_metrics"] = worker_payloads
+            # Payloads crossed the wire from workers we don't control;
+            # decode only shape-checks the top level, so a version-skewed
+            # worker must degrade the aggregate view, not kill persistence.
+            try:
+                view["cluster_metrics"] = merge_wire(worker_payloads.values())
+            except Exception as e:  # noqa: BLE001
+                logger.warning("Worker metrics payloads failed to merge: %s", e)
+        return view
 
     # -- accept loop --------------------------------------------------------
 
@@ -105,7 +186,11 @@ class ClusterManager:
             writer.close()
             return
         try:
-            await asyncio.wait_for(self._perform_handshake(ws), HANDSHAKE_TIMEOUT)
+            with self.span_tracer.span(
+                "handshake", cat="transport", track="accept",
+                args={"peer": ws.peer_address()},
+            ):
+                await asyncio.wait_for(self._perform_handshake(ws), HANDSHAKE_TIMEOUT)
         except Exception as e:  # noqa: BLE001
             logger.warning("Handshake with %s failed: %s", ws.peer_address(), e)
             ws.abort()
@@ -138,6 +223,11 @@ class ClusterManager:
                 return
             worker = self.workers[response.worker_id]
             worker.connection.replace_inner_connection(ws)
+            self.metrics.counter(
+                "master_worker_reconnects_total",
+                "Reconnect handshakes accepted from known workers",
+                labels=("worker",),
+            ).inc(worker=pm.worker_id_to_string(response.worker_id))
             worker.logger.info("Worker reconnected from %s", ws.peer_address())
         else:
             raise WebSocketClosed(
@@ -151,9 +241,16 @@ class ClusterManager:
             )
             ws.abort()
             return
-        connection = ReconnectableServerConnection(ws)
+        connection = ReconnectableServerConnection(
+            ws, metrics=self._transport_metrics
+        )
         worker = WorkerHandle(
-            worker_id, connection, self.state, on_dead=self._evict_worker
+            worker_id,
+            connection,
+            self.state,
+            on_dead=self._evict_worker,
+            metrics=self.metrics,
+            span_tracer=self.span_tracer,
         )
         self.workers[worker_id] = worker
         worker.start()
@@ -203,34 +300,46 @@ class ClusterManager:
             ) * max(1, target)
             max_slots = min(scaled_slot_cap(target), demand_bound)
             warmup_task = asyncio.create_task(asyncio.to_thread(warmup, max_slots))
-        try:
-            while len(self.workers) < target:
-                if self.cancellation.is_cancelled():
-                    raise RuntimeError("Cancelled while waiting for workers.")
-                await asyncio.sleep(BARRIER_POLL_SECONDS)
-            if warmup_task is not None:
-                try:
-                    await warmup_task
-                except Exception as e:  # noqa: BLE001 - latency opt, not fatal
-                    logger.warning(
-                        "Auction warmup failed (%s); first ticks will pay "
-                        "compilation lazily.",
-                        e,
-                    )
-        except BaseException:
-            if warmup_task is not None and not warmup_task.done():
-                warmup_task.cancel()
-            raise
+        with self.span_tracer.span(
+            "barrier wait", cat="master", track="job", args={"target": target}
+        ):
+            try:
+                while len(self.workers) < target:
+                    if self.cancellation.is_cancelled():
+                        raise RuntimeError("Cancelled while waiting for workers.")
+                    await asyncio.sleep(BARRIER_POLL_SECONDS)
+                if warmup_task is not None:
+                    try:
+                        await warmup_task
+                    except Exception as e:  # noqa: BLE001 - latency opt, not fatal
+                        logger.warning(
+                            "Auction warmup failed (%s); first ticks will pay "
+                            "compilation lazily.",
+                            e,
+                        )
+            except BaseException:
+                if warmup_task is not None and not warmup_task.done():
+                    warmup_task.cancel()
+                raise
         logger.info("All %d workers connected; starting job.", target)
 
         self._job_started = True
         for worker in self.live_workers():
             await worker.send_job_started()
 
+        self.metrics.gauge(
+            "master_frames_total", "Frames in the job's frame table"
+        ).set(len(self.state.frames))
         start = time.time()
-        await run_strategy(
-            self.job, self.state, self.live_workers, self.cancellation
-        )
+        with self.span_tracer.span(
+            "run job",
+            cat="master",
+            track="job",
+            args={"strategy": strategy.strategy_type, "frames": len(self.state.frames)},
+        ):
+            await run_strategy(
+                self.job, self.state, self.live_workers, self.cancellation
+            )
         finish = time.time()
         if not self.state.all_frames_finished():
             raise RuntimeError("Strategy exited before all frames finished.")

@@ -23,6 +23,7 @@ from tpu_render_cluster.master.persist import (
     save_processed_results,
     save_raw_traces,
 )
+from tpu_render_cluster.obs import write_metrics_snapshot
 from tpu_render_cluster.utils.logging import initialize_console_and_file_logging
 
 
@@ -65,7 +66,12 @@ async def run_job_command(args: argparse.Namespace) -> int:
         args.results_directory = str(DEFAULT_RESULTS_DIR)
     job = BlenderJob.load_from_file(args.job_file_path)
     start_time = datetime.now()
-    manager = ClusterManager(args.host, args.port, job)
+    manager = ClusterManager(
+        args.host,
+        args.port,
+        job,
+        metrics_snapshot_path=Path(args.results_directory) / "metrics-live.json",
+    )
     if args.resume:
         from tpu_render_cluster.master.resume import apply_resume
 
@@ -93,7 +99,20 @@ async def run_job_command(args: argparse.Namespace) -> int:
     master_trace, worker_traces = await manager.initialize_server_and_run_job()
 
     results_directory = Path(args.results_directory)
-    save_raw_traces(start_time, job, results_directory, master_trace, worker_traces)
+    raw_path = save_raw_traces(
+        start_time, job, results_directory, master_trace, worker_traces
+    )
+    # Master-side obs artifacts next to the raw trace: live span timeline
+    # (Perfetto-loadable) + final metrics snapshot with the aggregated
+    # per-worker heartbeat payloads. The live 1 Hz snapshot the manager
+    # kept during the run is replaced by this final write.
+    prefix = raw_path.name.replace("_raw-trace.json", "")
+    manager.span_tracer.export(results_directory / f"{prefix}_trace-events.json")
+    write_metrics_snapshot(
+        results_directory / f"{prefix}_metrics.json",
+        manager.metrics,
+        extra=manager.cluster_view(),
+    )
     performance = parse_worker_traces(worker_traces)
     save_processed_results(
         start_time,

@@ -63,6 +63,9 @@ class ClusterManagerState:
     def all_frames_finished(self) -> bool:
         return self._finished_count >= len(self.frames)
 
+    def finished_count(self) -> int:
+        return self._finished_count
+
     def pending_count(self) -> int:
         return sum(
             1 for i in self._pending if self.frames[i].status is FrameStatus.PENDING

@@ -25,6 +25,7 @@ from typing import Awaitable, Callable
 from tpu_render_cluster.jobs.models import BlenderJob
 from tpu_render_cluster.master.queue_mirror import FrameOnWorker, WorkerQueueMirror
 from tpu_render_cluster.master.state import ClusterManagerState
+from tpu_render_cluster.obs import MetricsRegistry, Tracer
 from tpu_render_cluster.protocol import messages as pm
 from tpu_render_cluster.transport.actors import MessageRouter, SenderHandle, request_response
 from tpu_render_cluster.transport.reconnect import ReconnectableServerConnection
@@ -45,6 +46,8 @@ class WorkerHandle:
         state: ClusterManagerState,
         *,
         on_dead: Callable[["WorkerHandle", str], Awaitable[None]] | None = None,
+        metrics: MetricsRegistry | None = None,
+        span_tracer: Tracer | None = None,
     ) -> None:
         self.worker_id = worker_id
         self.connection = connection
@@ -52,6 +55,11 @@ class WorkerHandle:
         self.queue = WorkerQueueMirror()
         self.frames_stolen_count = 0
         self.is_dead = False
+        self.metrics = metrics
+        self.span_tracer = span_tracer
+        # Most recent compact metrics payload this worker piggybacked on a
+        # heartbeat pong (None until the first instrumented pong arrives).
+        self.latest_worker_metrics: dict | None = None
         # Observed per-frame render durations (for scheduler cost models).
         self._rendering_started_at: dict[int, float] = {}
         self._completion_observations: list[tuple[int, float]] = []
@@ -108,8 +116,33 @@ class WorkerHandle:
             return
         self.is_dead = True
         self.logger.warning("Worker marked dead: %s", reason)
+        if self.metrics is not None:
+            self.metrics.counter(
+                "master_worker_evictions_total", "Workers marked dead and evicted"
+            ).inc()
+            # Zero (don't leave stale) this worker's depth: its frames are
+            # returned to pending and re-queue elsewhere, and a frozen
+            # nonzero series would double-count them in the live view.
+            self.metrics.gauge(
+                "master_worker_queue_depth",
+                "Frames currently mirrored on each worker's queue",
+                labels=("worker",),
+            ).set(0, worker=self._worker_label())
         if self._on_dead is not None:
             await self._on_dead(self, reason)
+
+    # -- observability helpers ----------------------------------------------
+
+    def _worker_label(self) -> str:
+        return pm.worker_id_to_string(self.worker_id)
+
+    def _update_queue_depth_gauge(self) -> None:
+        if self.metrics is not None:
+            self.metrics.gauge(
+                "master_worker_queue_depth",
+                "Frames currently mirrored on each worker's queue",
+                labels=("worker",),
+            ).set(len(self.queue), worker=self._worker_label())
 
     # -- scheduling RPCs ----------------------------------------------------
 
@@ -125,6 +158,8 @@ class WorkerHandle:
         Reference: master/src/connection/mod.rs:139-168.
         """
         request = pm.MasterFrameQueueAddRequest.new(job, frame_index)
+        rpc_started = time.perf_counter()
+        rpc_started_wall = time.time()
         response = await request_response(
             self.sender, self.router, request, pm.WorkerFrameQueueAddResponse
         )
@@ -132,10 +167,33 @@ class WorkerHandle:
             raise RuntimeError(
                 f"Worker rejected frame {frame_index}: {response.error_reason}"
             )
+        rpc_seconds = time.perf_counter() - rpc_started
+        if self.metrics is not None:
+            strategy = self.state.job.frame_distribution_strategy.strategy_type
+            self.metrics.histogram(
+                "master_assignment_latency_seconds",
+                "queue-add RPC round-trip (request sent to ack received)",
+                labels=("strategy",),
+            ).observe(rpc_seconds, strategy=strategy)
+        if self.span_tracer is not None:
+            # Constant span name (frame index in args) so viewers and the
+            # analysis roll-up aggregate all assignments into one stat.
+            args = {"frame": frame_index}
+            if stolen_from is not None:
+                args["stolen_from"] = stolen_from
+            self.span_tracer.complete(
+                "assign frame",
+                cat="master",
+                start_wall=rpc_started_wall,
+                duration=rpc_seconds,
+                track=f"worker-{self._worker_label()}",
+                args=args,
+            )
         now = time.time()
         self.queue.add(
             FrameOnWorker(frame_index, queued_at=now, stolen_from=stolen_from)
         )
+        self._update_queue_depth_gauge()
         self.state.mark_frame_as_queued(
             frame_index,
             self.worker_id,
@@ -157,6 +215,7 @@ class WorkerHandle:
         )
         if response.result == pm.FRAME_QUEUE_REMOVE_RESULT_REMOVED:
             self.queue.remove(frame_index)
+            self._update_queue_depth_gauge()
         return response.result
 
     def has_empty_queue(self) -> bool:
@@ -206,6 +265,7 @@ class WorkerHandle:
             while True:
                 event = await finished_queue.get()
                 frame_on_worker = self.queue.remove(event.frame_index)
+                self._update_queue_depth_gauge()
                 if event.result == pm.FRAME_QUEUE_ITEM_FINISHED_OK:
                     self.logger.debug("Frame %d finished.", event.frame_index)
                     started = self._rendering_started_at.pop(event.frame_index, None)
@@ -227,13 +287,23 @@ class WorkerHandle:
                     )
                     self.state.return_frame_to_pending(event.frame_index)
 
+        # gather instead of asyncio.TaskGroup so the master still runs on
+        # Python 3.10; first failure cancels the sibling loop the same way.
+        tasks = [
+            asyncio.ensure_future(handle_rendering()),
+            asyncio.ensure_future(handle_finished()),
+        ]
         try:
-            async with asyncio.TaskGroup() as group:
-                group.create_task(handle_rendering())
-                group.create_task(handle_finished())
+            await asyncio.gather(*tasks)
         except asyncio.CancelledError:
+            for task in tasks:
+                task.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
             raise
         except Exception as e:  # noqa: BLE001 - loop death is a worker failure
+            for task in tasks:
+                task.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
             await self._mark_dead(f"event loop failed: {e}")
 
     async def _maintain_heartbeat(self) -> None:
@@ -248,12 +318,24 @@ class WorkerHandle:
                 await asyncio.sleep(HEARTBEAT_INTERVAL_SECONDS)
                 request = pm.MasterHeartbeatRequest.new_now()
                 try:
+                    sent_at = time.perf_counter()
                     await self.sender.send_message(request)
-                    await self.router.wait_for_message(
+                    pong = await self.router.wait_for_message(
                         pm.WorkerHeartbeatResponse,
                         timeout=HEARTBEAT_RESPONSE_TIMEOUT,
                         queue=pong_queue,
                     )
+                    if self.metrics is not None:
+                        self.metrics.histogram(
+                            "transport_heartbeat_rtt_seconds",
+                            "Heartbeat ping->pong round-trip per worker",
+                            labels=("worker",),
+                        ).observe(
+                            time.perf_counter() - sent_at,
+                            worker=self._worker_label(),
+                        )
+                    if pong.metrics is not None:
+                        self.latest_worker_metrics = pong.metrics
                 except (asyncio.TimeoutError, ConnectionError, Exception) as e:
                     if isinstance(e, asyncio.CancelledError):
                         raise

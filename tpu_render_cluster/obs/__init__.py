@@ -1,0 +1,71 @@
+"""Unified cluster observability: live metrics + span tracing.
+
+- ``registry`` — thread-safe counters / gauges / log-bucket histograms
+  with labels; compact wire form for the heartbeat metrics payload.
+- ``tracer`` — spans (wall-clock anchor + monotonic duration) exported as
+  Chrome trace-event JSON, loadable in Perfetto / chrome://tracing.
+- ``snapshot`` — periodic atomic JSON snapshots for live inspection.
+
+``get_registry()`` / ``get_tracer()`` return the process-global instances
+used by process-scoped subsystems (the render path, ``ops/assignment``,
+bench.py). Cluster components that can be colocated in one process (the
+harness runs a master and N workers on one loop) create their OWN
+instances so per-component views stay separable.
+"""
+
+from __future__ import annotations
+
+from tpu_render_cluster.obs.registry import (
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    log_buckets,
+    merge_wire,
+)
+from tpu_render_cluster.obs.snapshot import SnapshotWriter, write_metrics_snapshot
+from tpu_render_cluster.obs.tracer import Tracer, export_chrome_trace
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "SnapshotWriter",
+    "Tracer",
+    "export_chrome_trace",
+    "get_registry",
+    "get_tracer",
+    "log_buckets",
+    "merge_wire",
+    "render_fps_gauge",
+    "write_metrics_snapshot",
+]
+
+_global_registry = MetricsRegistry()
+_global_tracer = Tracer("process", pid=0)
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-global registry (render path, ops, bench)."""
+    return _global_registry
+
+
+def get_tracer() -> Tracer:
+    """The process-global tracer."""
+    return _global_tracer
+
+
+def render_fps_gauge(registry: MetricsRegistry | None = None) -> Gauge:
+    """The frames/s gauge both bench.py and the TPU backend feed.
+
+    One definition site so the two writers can't drift apart in name,
+    help, or label shape (get-or-create raises on mismatch at runtime).
+    """
+    registry = registry if registry is not None else get_registry()
+    return registry.gauge(
+        "render_frames_per_second",
+        "Instantaneous device throughput (1 / execute_seconds)",
+    )

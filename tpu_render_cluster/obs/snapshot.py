@@ -1,0 +1,100 @@
+"""Periodic JSON metrics snapshots for live inspection.
+
+While a job runs, the master (or any process holding a registry) can keep
+an on-disk snapshot fresh: ``SnapshotWriter`` serialises the registry —
+plus any caller-supplied live extras (queue depths, aggregated worker
+heartbeat payloads) — every ``interval`` seconds, writing atomically
+(tmp + replace) so a tail -f / file-watcher reader never sees a torn
+JSON document. ``write_once`` is the same path without the loop, used for
+the final end-of-job snapshot.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import time
+from pathlib import Path
+from typing import Any, Callable
+
+from tpu_render_cluster.obs.registry import MetricsRegistry
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["SnapshotWriter", "write_metrics_snapshot"]
+
+
+def write_metrics_snapshot(
+    path: str | Path,
+    registry: MetricsRegistry,
+    *,
+    extra: dict[str, Any] | None = None,
+) -> Path:
+    """Write one atomic snapshot: ``{written_at, metrics, **extra}``."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    payload: dict[str, Any] = {
+        "written_at": time.time(),
+        "metrics": registry.snapshot(),
+    }
+    if extra:
+        payload.update(extra)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    tmp.write_text(json.dumps(payload, indent=2), encoding="utf-8")
+    os.replace(tmp, path)
+    return path
+
+
+class SnapshotWriter:
+    """Asyncio-periodic snapshot task (master's live metrics file)."""
+
+    def __init__(
+        self,
+        path: str | Path,
+        registry: MetricsRegistry,
+        *,
+        interval: float = 1.0,
+        extra_fn: Callable[[], dict[str, Any]] | None = None,
+    ) -> None:
+        self.path = Path(path)
+        self.registry = registry
+        self.interval = interval
+        self.extra_fn = extra_fn
+        self._task: asyncio.Task | None = None
+
+    def write_once(self) -> Path:
+        extra = self.extra_fn() if self.extra_fn is not None else None
+        return write_metrics_snapshot(self.path, self.registry, extra=extra)
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                # extra_fn reads live loop-owned state, so it runs here;
+                # the registry snapshot + serialize + write go to a thread
+                # so a large cluster view never stalls heartbeat service.
+                extra = self.extra_fn() if self.extra_fn is not None else None
+                await asyncio.to_thread(
+                    write_metrics_snapshot, self.path, self.registry, extra=extra
+                )
+            except Exception as e:  # noqa: BLE001 - observability must not kill jobs
+                logger.warning("Metrics snapshot write failed: %s", e)
+            await asyncio.sleep(self.interval)
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._run(), name="metrics-snapshot")
+
+    async def stop(self, *, final_write: bool = True) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        if final_write:
+            try:
+                self.write_once()
+            except Exception as e:  # noqa: BLE001
+                logger.warning("Final metrics snapshot failed: %s", e)

@@ -147,6 +147,11 @@ def solve_assignment(cost_matrix: np.ndarray) -> np.ndarray:
     n_items, n_slots = cost_matrix.shape
     if n_items == 0:
         return np.zeros((0,), dtype=np.int32)
+    from tpu_render_cluster.obs import get_registry
+
+    get_registry().counter(
+        "scheduler_auction_solves_total", "Assignment solves attempted"
+    ).inc()
     if n_items > n_slots:
         raise ValueError(f"More items ({n_items}) than slots ({n_slots}).")
     size = _next_bucket(max(n_items, n_slots))
@@ -163,6 +168,11 @@ def solve_assignment(cost_matrix: np.ndarray) -> np.ndarray:
         # matrices aside) — finish greedily on host.
         global _greedy_fallback_count
         _greedy_fallback_count += 1
+        get_registry().counter(
+            "scheduler_greedy_fallbacks_total",
+            "Ticks whose auction failed to converge and fell back to the "
+            "host greedy solve",
+        ).inc()
         assignment = _greedy_fallback(cost_matrix)
     return assignment.astype(np.int32)
 

@@ -32,8 +32,16 @@ from tpu_render_cluster.render.scene import build_scene
 def _shard_map(fn, mesh, in_specs, out_specs):
     # check_vma=False: the integrator's scan carries start replicated and
     # become device-varying when axis_index feeds the RNG — intended here.
-    return jax.shard_map(
-        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+    # jax < 0.5: shard_map lives in jax.experimental and the replication
+    # check is spelled check_rep.
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    return _experimental_shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
     )
 
 

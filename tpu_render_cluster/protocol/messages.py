@@ -335,16 +335,31 @@ class MasterHeartbeatRequest(Message):
 
 @dataclass(frozen=True)
 class WorkerHeartbeatResponse(Message):
-    """W→M empty pong (shared/src/messages/heartbeat.rs:52-66)."""
+    """W→M pong (shared/src/messages/heartbeat.rs:52-66).
+
+    Extension over the reference's empty payload: an OPTIONAL compact
+    metrics payload (``obs.registry.to_wire()`` shape) piggybacks on the
+    pong so the master can aggregate a live cluster-wide view with zero
+    extra round-trips. Backward/forward compatible in both directions:
+    a missing ``metrics`` key decodes to ``None`` (the C++ worker sends
+    the reference's empty payload), and peers that don't know the key
+    ignore it (the C++ master reads only ``message_type``).
+    """
 
     type_name: ClassVar[str] = "response_heartbeat"
+    metrics: dict[str, Any] | None = None
 
     def to_payload(self) -> dict[str, Any]:
-        return {}
+        if self.metrics is None:
+            return {}
+        return {"metrics": self.metrics}
 
     @classmethod
     def from_payload(cls, payload: dict[str, Any]) -> "WorkerHeartbeatResponse":
-        return cls()
+        metrics = payload.get("metrics")
+        if metrics is not None and not isinstance(metrics, dict):
+            raise ValueError("heartbeat metrics payload must be an object")
+        return cls(metrics=metrics)
 
 
 @dataclass(frozen=True)

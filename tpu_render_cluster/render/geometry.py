@@ -20,6 +20,22 @@ INF = 1e30
 EPS = 1e-3
 
 
+def _ray_barrier(origins, directions):
+    """TPU-only fusion barrier around the ray inputs.
+
+    Keeps XLA from fusing ray-producing broadcasts/iotas into the matmuls
+    below: the v5e TpuPriorityFusionQueue cost model SIGILLs on that
+    producer pattern (libtpu crash observed 2026-07; also materializes the
+    rays once instead of recomputing them in all three contractions). On
+    non-TPU backends the barrier buys nothing and older JAX releases have
+    no batching rule for it (it breaks under the pre-0.5 shard_map), so
+    it is skipped.
+    """
+    if jax.default_backend() != "tpu":
+        return origins, directions
+    return jax.lax.optimization_barrier((origins, directions))
+
+
 def intersect_spheres(scene: Scene, origins, directions):
     """Nearest sphere hit per ray.
 
@@ -32,11 +48,7 @@ def intersect_spheres(scene: Scene, origins, directions):
 
     if pallas_kernels.pallas_enabled():
         return pallas_kernels.intersect_spheres_pallas(scene, origins, directions)
-    # The barrier keeps XLA from fusing ray-producing broadcasts/iotas into
-    # the matmuls below: the v5e TpuPriorityFusionQueue cost model SIGILLs on
-    # that producer pattern (libtpu crash observed 2026-07; also materializes
-    # the rays once instead of recomputing them in all three contractions).
-    origins, directions = jax.lax.optimization_barrier((origins, directions))
+    origins, directions = _ray_barrier(origins, directions)
     oc_dot_d = directions @ scene.centers.T - jnp.sum(
         directions * origins, axis=-1, keepdims=True
     )  # [R, N] = d . (c - o)
@@ -93,7 +105,7 @@ def occluded_sun(scene: Scene, origins, directions) -> jnp.ndarray:
 
     if pallas_kernels.pallas_enabled():
         return pallas_kernels.occluded_pallas(scene, origins, directions)
-    origins, directions = jax.lax.optimization_barrier((origins, directions))
+    origins, directions = _ray_barrier(origins, directions)
     oc_dot_d = directions @ scene.centers.T - jnp.sum(
         directions * origins, axis=-1, keepdims=True
     )

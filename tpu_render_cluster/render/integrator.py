@@ -214,7 +214,12 @@ def _ray_sort_order(origins, directions, alive, mesh=None):
     # masking in _shade_bounce, blocks that are entirely dead cull every
     # instance at the top level and cost almost nothing.
     dead = (~alive).astype(jnp.uint32) << 31
-    return jnp.argsort((candidate << 25) | (morton << 3) | octant | dead)
+    # Key layout: octant bits 0-2, Morton bits 3-17, candidate bits 18-30,
+    # dead flag bit 31. Candidate is clamped to 13 bits so a scene with
+    # 64+ instances can't spill into the dead flag (or wrap the uint32)
+    # and silently destroy the compaction this sort exists for.
+    candidate = jnp.minimum(candidate, jnp.uint32(0x1FFF))
+    return jnp.argsort((candidate << 18) | (morton << 3) | octant | dead)
 
 
 def trace_paths(

@@ -518,7 +518,12 @@ def intersect_instances(
         t, tri, inst = pallas_kernels.intersect_instances_pallas(
             bvh, instances, origins, directions, init_t
         )
-        hit = (t < INF)[:, None]
+        # A seeded miss comes back with t == init_t (< INF), so the hit
+        # test must compare against the seed, not INF — otherwise the
+        # tri=0/inst=0 gathers below leak garbage normals/albedo where the
+        # scan branch returns zeros.
+        seed = INF if init_t is None else init_t
+        hit = (t < seed)[:, None]
         normal_obj = bvh.normal[tri]
         rot = instances.rotation[inst]  # [R, 3, 3]
         normal_world = _normals_to_world(rot, normal_obj)

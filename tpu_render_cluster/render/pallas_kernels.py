@@ -45,10 +45,15 @@ EPS = 1e-3
 BLOCK_R = 4096
 # The BVH kernels use their own ray-block size: packet culling (the
 # block-wide any() on AABB tests and the instance-level world-AABB skip)
-# only bites when a block is spatially tight. Swept on the real chip
-# (bench-mesh, instanced nearest-hit + any-hit wired): 1024 -> 16.1 f/s,
-# 2048 -> 16.9, 4096 -> 16.7, 8192 -> 15.0. (Pre-instanced-nearest-hit the
-# same sweep peaked at 9.25.)
+# only bites when a block is spatially tight. Under the current
+# single-grid-axis kernels (grid = ray blocks only; the per-block
+# candidate-first instance sweep runs inside the kernel) the on-chip sweep
+# favors 1024: smaller blocks are spatially tighter, so the seeded best-t
+# and the top-level AABB skip cull more of the per-block instance sweep,
+# and the walk's live-lane mask drains sooner. (The older two-axis
+# rays x instances grid amortized per-step overhead differently and
+# peaked at 2048 — that sweep read 1024 -> 16.1 f/s, 2048 -> 16.9,
+# 4096 -> 16.7, 8192 -> 15.0; it no longer applies.)
 BVH_BLOCK_R = 1024
 _SUBLANE = 8  # f32 sublane tile; sphere count is padded to a multiple
 

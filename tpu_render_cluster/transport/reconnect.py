@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Awaitable, Callable
+from typing import TYPE_CHECKING, Awaitable, Callable
 
 from tpu_render_cluster.transport.ws import (
     WebSocketClosed,
@@ -21,7 +21,54 @@ from tpu_render_cluster.transport.ws import (
     websocket_connect,
 )
 
+if TYPE_CHECKING:
+    from tpu_render_cluster.obs import MetricsRegistry
+
 logger = logging.getLogger(__name__)
+
+
+class TransportMetrics:
+    """Message/byte/reconnect accounting for one logical connection.
+
+    Thin adapter both logical-connection classes share: the WS layer below
+    doesn't know which component owns the socket, and the components above
+    shouldn't repeat counter bookkeeping — so the counting lives exactly at
+    the logical-connection boundary, labeled by direction.
+    """
+
+    def __init__(self, registry: "MetricsRegistry") -> None:
+        self._messages = registry.counter(
+            "transport_messages_total",
+            "WS text messages through the logical connection",
+            labels=("direction",),
+        )
+        self._bytes = registry.counter(
+            "transport_bytes_total",
+            "Payload characters through the logical connection (~bytes; "
+            "the protocol JSON is ASCII)",
+            labels=("direction",),
+        )
+        self._reconnects = registry.counter(
+            "transport_reconnects_total", "Socket replacements survived"
+        )
+        self._connect_attempts = registry.counter(
+            "transport_connect_attempts_total",
+            "TCP connect + WS upgrade attempts (incl. backoff retries)",
+        )
+
+    def sent(self, text: str) -> None:
+        self._messages.inc(direction="sent")
+        self._bytes.inc(len(text), direction="sent")
+
+    def received(self, text: str) -> None:
+        self._messages.inc(direction="received")
+        self._bytes.inc(len(text), direction="received")
+
+    def reconnected(self) -> None:
+        self._reconnects.inc()
+
+    def connect_attempt(self) -> None:
+        self._connect_attempts.inc()
 
 # Reference: worker/src/connection/mod.rs:360-398,475-487.
 BACKOFF_BASE = 2.0
@@ -39,11 +86,14 @@ async def connect_with_exponential_backoff(
     max_retries: int = MAX_CONNECT_RETRIES,
     base: float = BACKOFF_BASE,
     cap_seconds: float = BACKOFF_CAP_SECONDS,
+    metrics: TransportMetrics | None = None,
 ) -> WebSocketConnection:
     """TCP connect + WS upgrade with exponential backoff."""
     last_error: Exception | None = None
     for attempt in range(max_retries + 1):
         try:
+            if metrics is not None:
+                metrics.connect_attempt()
             return await websocket_connect(host, port)
         except (WebSocketClosed, OSError) as e:
             last_error = e
@@ -76,10 +126,12 @@ class ReconnectingClient:
         reconnect_fn: Callable[[], Awaitable[WebSocketConnection]],
         *,
         on_reconnect: Callable[[float, float], None] | None = None,
+        metrics: TransportMetrics | None = None,
     ) -> None:
         self._connection = connection
         self._reconnect_fn = reconnect_fn
         self._on_reconnect = on_reconnect
+        self._metrics = metrics
         self._reconnect_lock = asyncio.Lock()
         self._generation = 0
         self._closed = False
@@ -105,6 +157,8 @@ class ReconnectingClient:
             self._connection.abort()
             self._connection = await self._reconnect_fn()
             self._generation += 1
+            if self._metrics is not None:
+                self._metrics.reconnected()
             if self._on_reconnect is not None:
                 self._on_reconnect(lost_at, time.time())
             logger.info("Reconnected to master (generation %d).", self._generation)
@@ -128,9 +182,14 @@ class ReconnectingClient:
 
     async def send_text(self, text: str) -> None:
         await self._with_retries(lambda c: c.send_text(text))
+        if self._metrics is not None:
+            self._metrics.sent(text)
 
     async def receive_text(self) -> str:
-        return await self._with_retries(lambda c: c.receive_text())
+        text = await self._with_retries(lambda c: c.receive_text())
+        if self._metrics is not None:
+            self._metrics.received(text)
+        return text
 
 
 class ReconnectableServerConnection:
@@ -143,11 +202,17 @@ class ReconnectableServerConnection:
 
     MAX_WAIT_FOR_RECONNECT = 30.0
 
-    def __init__(self, connection: WebSocketConnection) -> None:
+    def __init__(
+        self,
+        connection: WebSocketConnection,
+        *,
+        metrics: TransportMetrics | None = None,
+    ) -> None:
         self._connection = connection
         self._connected = asyncio.Event()
         self._connected.set()
         self._closed = False
+        self._metrics = metrics
         self.last_known_address = connection.peer_address()
 
     @property
@@ -164,6 +229,8 @@ class ReconnectableServerConnection:
         self._connection.abort()
         self._connection = connection
         self.last_known_address = connection.peer_address()
+        if self._metrics is not None:
+            self._metrics.reconnected()
         self._connected.set()
 
     def _mark_disconnected(self) -> None:
@@ -191,6 +258,8 @@ class ReconnectableServerConnection:
             connection = await self._await_connection()
             try:
                 await connection.send_text(text)
+                if self._metrics is not None:
+                    self._metrics.sent(text)
                 return
             except WebSocketClosed:
                 if self._connection is connection:
@@ -202,7 +271,10 @@ class ReconnectableServerConnection:
         while True:
             connection = await self._await_connection()
             try:
-                return await connection.receive_text()
+                text = await connection.receive_text()
+                if self._metrics is not None:
+                    self._metrics.received(text)
+                return text
             except WebSocketClosed:
                 if self._connection is connection:
                     self._mark_disconnected()

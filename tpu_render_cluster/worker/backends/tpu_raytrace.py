@@ -94,6 +94,31 @@ class TpuRaytraceBackend(RenderBackend):
     async def render_frame(self, job: BlenderJob, frame_index: int) -> FrameRenderTime:
         return await asyncio.to_thread(self._render_sync, job, frame_index)
 
+    @staticmethod
+    def _observe_render_obs(*, compile_seconds: float, execute_seconds: float) -> None:
+        """Feed the process-global obs registry (one TPU per process).
+
+        ``render_compile_seconds`` is the loading phase (fetching — or
+        first building — the compiled renderer); ``render_execute_seconds``
+        is fenced device compute + readback. The frames/s gauge uses the
+        same device-time accounting bench.py reports (frames per second of
+        synced device execution), so the live gauge and the headline bench
+        number are directly comparable.
+        """
+        from tpu_render_cluster.obs import get_registry, render_fps_gauge
+
+        registry = get_registry()
+        registry.histogram(
+            "render_compile_seconds",
+            "Per-frame compiled-renderer fetch/build (the 'loading' phase)",
+        ).observe(max(0.0, compile_seconds))
+        registry.histogram(
+            "render_execute_seconds",
+            "Per-frame device render + readback (block-until-ready fenced)",
+        ).observe(max(0.0, execute_seconds))
+        if execute_seconds > 0:
+            render_fps_gauge(registry).set(1.0 / execute_seconds)
+
     def _render_sync(self, job: BlenderJob, frame_index: int) -> FrameRenderTime:
         import numpy as np
 
@@ -156,6 +181,10 @@ class TpuRaytraceBackend(RenderBackend):
         write_image(path, pixels, job.output_file_format)
         file_saving_finished_at = time.time()
 
+        self._observe_render_obs(
+            compile_seconds=finished_loading_at - started_process_at,
+            execute_seconds=finished_rendering_at - started_rendering_at,
+        )
         return FrameRenderTime(
             started_process_at=started_process_at,
             finished_loading_at=finished_loading_at,

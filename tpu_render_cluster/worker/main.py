@@ -13,7 +13,10 @@ import argparse
 import asyncio
 import os
 import sys
+from pathlib import Path
 
+from tpu_render_cluster.obs import write_metrics_snapshot
+from tpu_render_cluster.protocol import messages as pm
 from tpu_render_cluster.utils.logging import initialize_console_and_file_logging
 from tpu_render_cluster.worker.backends import create_backend
 from tpu_render_cluster.worker.runtime import Worker
@@ -133,7 +136,27 @@ def main(argv: list[str] | None = None) -> int:
     if args.warm_scene and args.backend == "tpu-raytrace":
         backend.warm(args.warm_scene)
     worker = Worker(args.master_host, args.master_port, backend)
-    asyncio.run(worker.connect_and_run_to_job_completion())
+    try:
+        asyncio.run(worker.connect_and_run_to_job_completion())
+    finally:
+        # Export this daemon's obs artifacts even when the run died (the
+        # partial timeline matters most in exactly those runs): in
+        # distributed mode the master only holds the compact heartbeat
+        # payloads, so the worker's full span timeline (connect + per-frame
+        # queue_wait/read/render/write) and registry live here. Filenames
+        # match the master's artifact globs so analysis/run_all pointed at
+        # (or above) this directory loads them.
+        obs_directory = Path(args.base_directory) / "obs"
+        worker_name = f"worker-{pm.worker_id_to_string(worker.worker_id)}"
+        try:
+            worker.span_tracer.export(
+                obs_directory / f"{worker_name}_trace-events.json"
+            )
+            write_metrics_snapshot(
+                obs_directory / f"{worker_name}_metrics.json", worker.metrics
+            )
+        except Exception as e:  # noqa: BLE001 - obs must not mask the run error
+            print(f"warning: obs artifact export failed: {e}", file=sys.stderr)
     return 0
 
 

@@ -17,9 +17,11 @@ from __future__ import annotations
 import asyncio
 import enum
 import logging
-from dataclasses import dataclass
+import time
+from dataclasses import dataclass, field
 
 from tpu_render_cluster.jobs.models import BlenderJob
+from tpu_render_cluster.obs import MetricsRegistry, Tracer
 from tpu_render_cluster.protocol import messages as pm
 from tpu_render_cluster.transport.actors import SenderHandle
 from tpu_render_cluster.traces.worker_trace import WorkerTraceBuilder
@@ -29,6 +31,11 @@ from tpu_render_cluster.worker.backends.base import RenderBackend
 logger = logging.getLogger(__name__)
 
 QUEUE_POLL_SECONDS = 0.1  # reference: worker/src/rendering/queue.rs:74-96
+
+# The per-frame phase breakdown the paper's analysis is built around
+# (reading/rendering/writing), plus the queue-wait the paper only derives
+# post-hoc from trace gaps — here measured directly.
+FRAME_PHASES = ("queue_wait", "read", "render", "write")
 
 
 class FrameState(enum.Enum):
@@ -42,6 +49,7 @@ class QueuedFrame:
     job: BlenderJob
     frame_index: int
     state: FrameState = FrameState.QUEUED
+    queued_at: float = field(default_factory=time.time)
 
 
 class WorkerAutomaticQueue:
@@ -53,11 +61,25 @@ class WorkerAutomaticQueue:
         sender: SenderHandle,
         tracer: WorkerTraceBuilder,
         cancellation: CancellationToken,
+        *,
+        metrics: MetricsRegistry | None = None,
+        span_tracer: Tracer | None = None,
     ) -> None:
         self._backend = backend
         self._sender = sender
         self._tracer = tracer
         self._cancellation = cancellation
+        self._metrics = metrics
+        self._span_tracer = span_tracer
+        self._phase_histogram = (
+            metrics.histogram(
+                "worker_frame_phase_seconds",
+                "Per-frame phase durations (queue_wait/read/render/write)",
+                labels=("phase",),
+            )
+            if metrics is not None
+            else None
+        )
         self._frames: list[QueuedFrame] = []
         self._finished_indices: set[tuple[str, int]] = set()
         self._task: asyncio.Task | None = None
@@ -135,6 +157,10 @@ class WorkerAutomaticQueue:
             timing = await self._backend.render_frame(frame.job, frame.frame_index)
         except Exception as e:  # noqa: BLE001 - report, don't hang the master
             logger.error("Frame %d render failed: %s", frame.frame_index, e)
+            if self._metrics is not None:
+                self._metrics.counter(
+                    "worker_frames_errored_total", "Frames that failed to render"
+                ).inc()
             # NOT added to _finished_indices: the master returns errored
             # frames to the pending pool and may re-queue them here; a later
             # remove request must not answer "already-finished".
@@ -146,11 +172,46 @@ class WorkerAutomaticQueue:
             )
             return
         self._tracer.trace_new_rendered_frame(frame.frame_index, timing)
+        self._observe_frame_phases(frame, timing)
         self._remove(frame)
         self._finished_indices.add((job_name, frame.frame_index))
         await self._sender.send_message(
             pm.WorkerFrameQueueItemFinishedEvent.new_ok(job_name, frame.frame_index)
         )
+
+    def _observe_frame_phases(self, frame: QueuedFrame, timing) -> None:
+        """Feed the live per-phase histograms + emit retroactive spans.
+
+        The spans reuse the 7-point wall-clock timestamps the backend
+        already measured (the trace of record), so the Perfetto view and
+        the legacy ``FrameRenderTime`` analysis agree exactly.
+        """
+        if self._metrics is None and self._span_tracer is None:
+            return
+        bounds = {
+            "queue_wait": (frame.queued_at, timing.started_process_at),
+            "read": (timing.started_process_at, timing.finished_loading_at),
+            "render": (timing.started_rendering_at, timing.finished_rendering_at),
+            "write": (timing.file_saving_started_at, timing.file_saving_finished_at),
+        }
+        for phase in FRAME_PHASES:
+            start, end = bounds[phase]
+            duration = max(0.0, end - start)
+            if self._phase_histogram is not None:
+                self._phase_histogram.observe(duration, phase=phase)
+            if self._span_tracer is not None:
+                self._span_tracer.complete(
+                    phase,
+                    cat="worker",
+                    start_wall=start,
+                    duration=duration,
+                    track="frames",
+                    args={"frame": frame.frame_index},
+                )
+        if self._metrics is not None:
+            self._metrics.counter(
+                "worker_frames_rendered_total", "Frames rendered successfully"
+            ).inc()
 
     def _remove(self, frame: QueuedFrame) -> None:
         if frame in self._frames:

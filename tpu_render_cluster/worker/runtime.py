@@ -16,11 +16,13 @@ import logging
 import time
 
 from tpu_render_cluster import PROTOCOL_VERSION
+from tpu_render_cluster.obs import MetricsRegistry, Tracer, get_registry
 from tpu_render_cluster.protocol import messages as pm
 from tpu_render_cluster.traces.worker_trace import WorkerTrace, WorkerTraceBuilder
 from tpu_render_cluster.transport.actors import MessageRouter, SenderHandle
 from tpu_render_cluster.transport.reconnect import (
     ReconnectingClient,
+    TransportMetrics,
     connect_with_exponential_backoff,
 )
 from tpu_render_cluster.transport.ws import WebSocketClosed, WebSocketConnection
@@ -67,27 +69,46 @@ class Worker:
         backend: RenderBackend,
         *,
         tracer: WorkerTraceBuilder | None = None,
+        metrics: MetricsRegistry | None = None,
+        span_tracer: Tracer | None = None,
     ) -> None:
         self.master_host = master_host
         self.master_port = master_port
         self.backend = backend
         self.worker_id = pm.generate_worker_id()
         self.tracer = tracer or WorkerTraceBuilder()
+        # Live observability: the worker's registry ships to the master as
+        # the heartbeat's compact payload; the span tracer is one Perfetto
+        # process row per worker. The registry defaults to the
+        # PROCESS-GLOBAL one so process-scoped sources (the tpu-raytrace
+        # backend's render_* series feed get_registry()) ride the same
+        # heartbeat in daemon mode (one worker per process); colocated
+        # harness workers pass their own fresh registries instead.
+        self.metrics = metrics if metrics is not None else get_registry()
+        self.span_tracer = span_tracer or Tracer(
+            f"worker-{pm.worker_id_to_string(self.worker_id)}"
+        )
         self.cancellation = CancellationToken()
         self._client: ReconnectingClient | None = None
         self._final_trace: WorkerTrace | None = None
 
     async def connect_and_run_to_job_completion(self) -> WorkerTrace:
         """Connect, serve the job protocol until job-finished, return the trace."""
+        transport_metrics = TransportMetrics(self.metrics)
 
         async def fresh_connection(is_reconnect: bool) -> WebSocketConnection:
-            ws = await connect_with_exponential_backoff(
-                self.master_host, self.master_port
-            )
-            await asyncio.wait_for(
-                _perform_handshake(ws, self.worker_id, is_reconnect=is_reconnect),
-                HANDSHAKE_TIMEOUT,
-            )
+            with self.span_tracer.span(
+                "reconnect" if is_reconnect else "connect",
+                cat="transport",
+                track="connection",
+            ):
+                ws = await connect_with_exponential_backoff(
+                    self.master_host, self.master_port, metrics=transport_metrics
+                )
+                await asyncio.wait_for(
+                    _perform_handshake(ws, self.worker_id, is_reconnect=is_reconnect),
+                    HANDSHAKE_TIMEOUT,
+                )
             return ws
 
         first = await fresh_connection(False)
@@ -95,6 +116,7 @@ class Worker:
             first,
             lambda: fresh_connection(True),
             on_reconnect=self.tracer.trace_new_reconnect,
+            metrics=transport_metrics,
         )
         self._client = client
         logger.info(
@@ -114,7 +136,12 @@ class Worker:
         router.start()
 
         frame_queue = WorkerAutomaticQueue(
-            self.backend, sender, self.tracer, self.cancellation
+            self.backend,
+            sender,
+            self.tracer,
+            self.cancellation,
+            metrics=self.metrics,
+            span_tracer=self.span_tracer,
         )
         frame_queue.start()
 
@@ -145,7 +172,11 @@ class Worker:
         while True:
             request = await queue.get()
             received_at = time.time()
-            await sender.send_message(pm.WorkerHeartbeatResponse())
+            # Every pong carries the compact metrics payload: the master
+            # aggregates a live cluster-wide view with zero extra RPCs.
+            await sender.send_message(
+                pm.WorkerHeartbeatResponse(metrics=self.metrics.to_wire())
+            )
             ping_counter += 1
             if ping_counter % TRACE_EVERY_NTH_PING == 0:
                 self.tracer.trace_new_ping(request.request_time, received_at)
