@@ -230,6 +230,120 @@ def chip_efficiency(fps: float, chunks: int, scene_name: str) -> dict:
     return result
 
 
+def occupancy_probe(scene_name: str) -> float | None:
+    """Record the scene's per-bounce survival curve; returns the wasted
+    lane fraction (1 - mean alive fraction over bounces).
+
+    One frame through the wavefront driver (render/compaction.py) — the
+    survival curve is scene physics, independent of which execution mode
+    the timed windows used, and the probe feeds the same
+    ``render_alive_fraction`` histogram the analysis suite folds into
+    statistics.json. Probe size matches the bench workload on a real
+    chip; on interpret-mode backends it shrinks so the probe stays a
+    footnote next to the timed windows.
+    """
+    import jax
+
+    from tpu_render_cluster.render import compaction
+
+    on_tpu = jax.default_backend() == "tpu"
+    compaction.render_frame_wavefront(
+        scene_name,
+        1,
+        width=WIDTH if on_tpu else 64,
+        height=HEIGHT if on_tpu else 64,
+        samples=SAMPLES if on_tpu else 1,
+        max_bounces=BOUNCES,
+    )
+    return compaction.wasted_lane_fraction()
+
+
+def wavefront_compare(
+    scene_name: str, frames: int = 8, reps: int = 5, bounces: int = BOUNCES
+) -> dict:
+    """Masked per-frame dispatch vs the wavefront driver, same workload.
+
+    ``reps`` interleaved repetitions of (``frames`` masked frames,
+    ``frames`` wavefront frames) after a warm frame apiece — per-frame
+    host sync both sides, the production dispatch shape of the worker
+    backend — reporting the MEDIAN frames/s per mode (interleaving
+    cancels machine-load drift; a single back-to-back pair measured
+    ±30% run-to-run on a shared host). The committed record lives at
+    results/WAVEFRONT_BENCH.json; run with
+    ``python bench.py --wavefront-compare [scene]`` on the target device
+    class.
+    """
+    import statistics
+
+    import jax
+    import numpy as np
+
+    from tpu_render_cluster.render import compaction
+    from tpu_render_cluster.render.integrator import fused_frame_renderer
+
+    on_tpu = jax.default_backend() == "tpu"
+    # The CPU (interpret) config must still span MANY kernel blocks —
+    # compaction only shrinks launches in units of the block size (1024
+    # rays), so a frame of a few blocks measures mostly driver overhead
+    # instead of the mode (idle-machine sweep, this scene: 32x32 -> 0.75x,
+    # 64x64 -> 1.01x, 128x128 -> 1.13x wavefront speedup).
+    width = height = WIDTH if on_tpu else 128
+    samples = SAMPLES if on_tpu else 1
+    renderer = fused_frame_renderer(scene_name, width, height, samples, bounces)
+
+    def masked_frame(frame: int):
+        np.asarray(renderer(frame))
+
+    def wavefront_frame(frame: int):
+        from tpu_render_cluster.render.integrator import tonemap
+
+        # tonemap on BOTH sides: the fused renderer's program ends in
+        # tonemap, and the worker backend's wavefront branch tonemaps
+        # too — an asymmetric comparison would hand wavefront the
+        # display-transform cost for free.
+        np.asarray(
+            tonemap(
+                compaction.render_frame_wavefront(
+                    scene_name, frame, width=width, height=height,
+                    samples=samples, max_bounces=bounces,
+                )
+            )
+        )
+
+    record: dict = {
+        "metric": f"{scene_name} masked vs wavefront "
+        f"({width}x{height}, {samples}spp, {bounces}b, "
+        f"{jax.devices()[0].platform})",
+        "unit": "frames/s/chip",
+        "frames": frames,
+        "reps": reps,
+    }
+    modes = (("masked", masked_frame), ("wavefront", wavefront_frame))
+    for _name, render_one in modes:
+        render_one(1)  # compile + warm
+    fps: dict[str, list[float]] = {"masked": [], "wavefront": []}
+    for rep in range(reps):
+        # Both modes render the SAME frame window per rep: the scenes are
+        # physics-animated, so disjoint frame ranges would compare
+        # different geometry/survival curves (and hand one mode the
+        # bucket recompiles a first-seen live count triggers).
+        rep_frames = range(2 + rep * frames, 2 + (rep + 1) * frames)
+        for name, render_one in modes:
+            t0 = time.perf_counter()
+            for frame in rep_frames:
+                render_one(frame)
+            fps[name].append(frames / (time.perf_counter() - t0))
+    for name, values in fps.items():
+        record[f"{name}_fps"] = round(statistics.median(values), 3)
+    record["wavefront_speedup"] = round(
+        record["wavefront_fps"] / record["masked_fps"], 3
+    )
+    wasted = compaction.wasted_lane_fraction()
+    if wasted is not None:
+        record["wasted_lane_fraction"] = round(wasted, 4)
+    return record
+
+
 def cpu_baseline_fps() -> float:
     pinned = os.environ.get("BENCH_CPU_FPS")
     if pinned:
@@ -266,6 +380,41 @@ def main() -> int:
         print(f"CPU_FPS={measure_fps(reps=1, min_window_s=0.0, chunks=1)}")
         return 0
 
+    if "--wavefront-compare" in sys.argv:
+        index = sys.argv.index("--wavefront-compare")
+        scene = (
+            sys.argv[index + 1]
+            if index + 1 < len(sys.argv) and not sys.argv[index + 1].startswith("-")
+            else "03_physics-2-mesh"
+        )
+
+        def int_flag(name: str, default: int) -> int:
+            if name in sys.argv:
+                return int(sys.argv[sys.argv.index(name) + 1])
+            return default
+
+        frames = int_flag("--frames", 8)
+        reps = int_flag("--reps", 5)
+        bounces = int_flag("--bounces", BOUNCES)
+        record = wavefront_compare(scene, frames=frames, reps=reps, bounces=bounces)
+        # Self-documenting: the exact invocation that reproduces this
+        # record (the committed artifact must not be silently replaced by
+        # a different workload's measurement).
+        record["command"] = (
+            f"python bench.py --wavefront-compare {scene} "
+            f"--frames {frames} --reps {reps} --bounces {bounces}"
+        )
+        print(json.dumps(record))
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "results",
+            "WAVEFRONT_BENCH.json",
+        )
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
+        return 0
+
     import jax
 
     fps = measure_fps()
@@ -286,6 +435,12 @@ def main() -> int:
         record.update(chip_efficiency(fps, CHUNKS, "04_very-simple"))
     except Exception as e:  # noqa: BLE001 - accounting must not kill the bench
         print(f"warning: chip efficiency accounting failed: {e}", file=sys.stderr)
+    try:
+        wasted = occupancy_probe("04_very-simple")
+        if wasted is not None:
+            record["wasted_lane_fraction"] = round(wasted, 4)
+    except Exception as e:  # noqa: BLE001 - the probe must not kill the bench
+        print(f"warning: lane occupancy probe failed: {e}", file=sys.stderr)
     print(json.dumps(record))
     return 0
 

@@ -128,6 +128,119 @@ def _percentile(sorted_values: list[float], q: float) -> float:
     return sorted_values[index]
 
 
+def summarize_wavefront(metrics: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """Roll the wavefront occupancy series (render/compaction.py) up.
+
+    Extracts ``render_alive_fraction`` (per-bounce survival histogram),
+    ``render_lane_occupancy`` (live/launch-width gauge) and
+    ``render_compiles_total`` (bucket-ladder compile counter) from
+    metrics snapshots — both shapes the snapshot families carry:
+    registry-snapshot form (the snapshot's own ``metrics`` and the
+    harness's per-worker ``workers``) and the compact heartbeat wire
+    form (the master CLI's merged ``cluster_metrics``, consumed only
+    when no per-worker registry snapshots are present, so nothing is
+    double-counted). None when no snapshot carries the series (job
+    never rendered wavefront-style).
+    """
+    found = False
+    alive_count = 0
+    alive_sum = 0.0
+    by_bounce: dict[str, dict[str, float]] = {}
+    occupancy: float | None = None
+    compiles = 0.0
+
+    def take_alive(label: str, count: int, total: float) -> None:
+        nonlocal found, alive_count, alive_sum
+        found = True
+        alive_count += count
+        alive_sum += total
+        entry = by_bounce.setdefault(label, {"count": 0, "sum": 0.0})
+        entry["count"] += count
+        entry["sum"] += total
+
+    def take_registry(names: dict[str, Any]) -> bool:
+        nonlocal found, occupancy, compiles
+        took = False
+        histogram = names.get("render_alive_fraction")
+        if histogram:
+            took = True
+            for label, series in histogram.get("series", {}).items():
+                take_alive(
+                    label,
+                    int(series.get("count", 0)),
+                    float(series.get("sum", 0.0)),
+                )
+        gauge = names.get("render_lane_occupancy")
+        if gauge and gauge.get("series"):
+            found = took = True
+            occupancy = float(list(gauge["series"].values())[-1])
+        counter = names.get("render_compiles_total")
+        if counter:
+            found = took = True
+            compiles += sum(float(v) for v in counter.get("series", {}).values())
+        return took
+
+    def take_wire(wire: dict[str, Any]) -> None:
+        nonlocal found, occupancy, compiles
+        for key, entry in (wire.get("h") or {}).items():
+            name, _, label = key.partition("|")
+            if name == "render_alive_fraction":
+                take_alive(label, int(entry.get("n", 0)), float(entry.get("s", 0.0)))
+        for key, value in (wire.get("g") or {}).items():
+            if key.partition("|")[0] == "render_lane_occupancy":
+                found = True
+                occupancy = float(value)
+        for key, value in (wire.get("c") or {}).items():
+            if key.partition("|")[0] == "render_compiles_total":
+                found = True
+                compiles += float(value)
+
+    # The harness's process-global snapshots are CUMULATIVE per process
+    # (every job a harness process runs re-exports the same counters):
+    # keep only the NEWEST snapshot per pid, then consume those once —
+    # summing every file's copy would multiply compiles_total by the job
+    # count and re-weight the survival means toward earlier jobs.
+    newest_per_pid: dict[Any, tuple[float, dict[str, Any]]] = {}
+    snapshots_with_process_metrics: set[int] = set()
+    for snapshot_index, snapshot in enumerate(metrics):
+        process_entry = snapshot.get("process_metrics")
+        if isinstance(process_entry, dict) and isinstance(
+            process_entry.get("metrics"), dict
+        ):
+            snapshots_with_process_metrics.add(snapshot_index)
+            pid = process_entry.get("pid")
+            written_at = float(snapshot.get("written_at", 0.0))
+            best = newest_per_pid.get(pid)
+            if best is None or written_at >= best[0]:
+                newest_per_pid[pid] = (written_at, process_entry["metrics"])
+
+    for snapshot_index, snapshot in enumerate(metrics):
+        took_registries = snapshot_index in snapshots_with_process_metrics
+        take_registry(snapshot.get("metrics", {}))
+        for worker_registry in (snapshot.get("workers") or {}).values():
+            if isinstance(worker_registry, dict) and take_registry(worker_registry):
+                took_registries = True
+        if not took_registries:
+            wire = snapshot.get("cluster_metrics")
+            if isinstance(wire, dict):
+                take_wire(wire)
+    for _written_at, registry in newest_per_pid.values():
+        take_registry(registry)
+    if not found:
+        return None
+    out: dict[str, Any] = {"compiles_total": compiles}
+    if occupancy is not None:
+        out["lane_occupancy_last"] = occupancy
+    if alive_count:
+        out["wasted_lane_fraction"] = 1.0 - alive_sum / alive_count
+        out["alive_fraction_mean_by_bounce"] = {
+            label: entry["sum"] / entry["count"]
+            for label, entry in sorted(by_bounce.items())
+            if entry["count"]
+        }
+    return out
+
+
 def summarize_obs(
     traces: list[ObsTrace], metrics: list[dict[str, Any]]
 ) -> dict[str, Any]:
@@ -149,9 +262,13 @@ def summarize_obs(
             "p95_s": _percentile(values, 0.95),
             "max_s": values[-1],
         }
-    return {
+    out: dict[str, Any] = {
         "trace_event_files": len(traces),
         "metrics_snapshot_files": len(metrics),
         "spans_by_category": span_counts,
         "span_duration_stats": span_stats,
     }
+    wavefront = summarize_wavefront(metrics)
+    if wavefront is not None:
+        out["wavefront"] = wavefront
+    return out

@@ -11,6 +11,7 @@ CLI uses, so the output is indistinguishable from a multi-host run
 from __future__ import annotations
 
 import asyncio
+import os
 from datetime import datetime
 from pathlib import Path
 
@@ -93,10 +94,19 @@ def save_obs_artifacts(
     exactly what a multi-host master assembles from heartbeat payloads,
     but collected in-process after the run.
     """
+    from tpu_render_cluster.obs import get_registry, get_tracer
+
+    # The process-global tracer rides along: render-path spans (e.g. the
+    # wavefront driver's per-bounce wavefront_bounce spans with live
+    # count / bucket / alive-fraction args) land in the same Perfetto
+    # file as the master/worker rows. It is process-scoped and the
+    # harness runs many jobs per process, so drain it after the export —
+    # otherwise job N's file would re-export jobs 1..N-1's render spans.
     trace_path = export_chrome_trace(
         prefix_path.with_name(prefix_path.name + "_trace-events.json"),
-        [manager.span_tracer] + [w.span_tracer for w in workers],
+        [manager.span_tracer] + [w.span_tracer for w in workers] + [get_tracer()],
     )
+    get_tracer().clear()
     worker_snapshots = {
         worker_id_to_string(w.worker_id): w.metrics.snapshot() for w in workers
     }
@@ -109,6 +119,19 @@ def save_obs_artifacts(
             "workers_wire_merged": merge_wire(
                 [w.metrics.to_wire() for w in workers]
             ),
+            # Harness workers run with fresh per-run registries, but the
+            # RENDER path (backend phase histograms, the wavefront
+            # driver's occupancy series) reports into the process-global
+            # registry — snapshot it too or those series never reach the
+            # artifact. Process-scoped and CUMULATIVE across runs in one
+            # harness process, so it is tagged with the pid: consumers
+            # (analysis/obs_events.summarize_wavefront) keep only the
+            # newest snapshot per pid instead of summing every file's
+            # copy of the same counters.
+            "process_metrics": {
+                "pid": os.getpid(),
+                "metrics": get_registry().snapshot(),
+            },
         },
     )
     return trace_path, metrics_path

@@ -145,6 +145,16 @@ class Tracer:
         with self._lock:
             return list(self._events)
 
+    def clear(self) -> None:
+        """Drop buffered events (and the dropped counter); track-name
+        assignments persist so tids stay stable across exports. Exporters
+        of long-lived shared tracers (the process-global one) call this
+        after a write so the next artifact holds only its own run's
+        spans."""
+        with self._lock:
+            self._events.clear()
+            self._dropped = 0
+
     @property
     def dropped(self) -> int:
         return self._dropped

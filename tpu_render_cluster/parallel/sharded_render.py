@@ -15,6 +15,17 @@ work (the SP/DP analogs called for by SURVEY.md §2.7 / §5.7):
   (the task-farm axis collapsed into the device mesh — highest
   throughput for animation). This is a separate function, not a
   ``render_frame_sharded`` mode, because its unit of work is a batch.
+
+Wavefront composition: every mode here traces ``render_tile`` under
+``shard_map``, so the HOST-DRIVEN wavefront driver (per-bounce device
+sync + dynamically shrinking launch widths; render/compaction.py) cannot
+run inside it. What composes instead is the IN-JIT half of compaction:
+per shard, the integrator's deep-scene bounce loop sorts its OWN rays
+dead-to-tail and hands the bounce kernel a live-count scalar, so each
+device skips its all-dead tail blocks with static shapes — no
+cross-device coordination, no recompiles, works under tile bands, spp
+subsets, and frame batches alike. (Tile sharding even helps it: a band's
+rays are spatially coherent, so their live sets collapse together.)
 """
 
 from __future__ import annotations

@@ -1,0 +1,399 @@
+"""Wavefront path tracing: active-ray compaction + bucketed relaunch.
+
+The masked bounce loop (integrator.trace_paths) marches EVERY lane
+through every bounce; after bounce 1 most lanes carry dead paths that
+still occupy kernel lanes (and, before the live-count prefetch, still
+drove BVH packet walks). Wavefront execution fixes the occupancy: after
+each bounce the live rays are stream-compacted to the front, the live
+count is read back, rounded UP to a small ladder of power-of-two bucket
+sizes (the same bucketed-jit idiom as ops/assignment.py — XLA compiles
+once per bucket, not per live count), and the next bounce is relaunched
+over the compacted bucket only. Radiance scatters back through the
+carried ORIGINAL lane ids, which also key the kernels' counter-based
+RNG — so a ray's stream is identical whether it rides the masked loop,
+the megakernel, or any compacted position here (the RNG-stability
+contract that makes masked-vs-wavefront images comparable).
+
+Two cooperating mechanisms, one per execution mode:
+
+- IN-JIT compaction (integrator.trace_paths): the per-bounce Morton
+  re-sort already parks dead lanes at the tail; the bounce kernels now
+  take a live-count scalar and skip all-dead tail blocks. Shapes stay
+  static, so this composes with jit/vmap/shard_map (tile/spp sharding)
+  — but the launch width never shrinks.
+- HOST-DRIVEN bucketed relaunch (this module): one device sync per
+  bounce buys dynamically shrinking launch widths. Runs outside jit, so
+  it is a per-frame driver (the worker backend's wavefront mode), not a
+  drop-in for the fused renderer.
+
+Instrumented via obs/: ``render_lane_occupancy`` gauge (live / launched
+width of the last relaunch), ``render_alive_fraction`` per-bounce
+histogram (live / original wavefront — the survival curve bench.py
+folds into ``wasted_lane_fraction``), ``render_compiles_total`` counter
+(new bucket shapes — the recompile bound the bucketing exists for), and
+per-bounce spans on the process tracer (Perfetto-visible).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+from tpu_render_cluster.render import pallas_kernels as pk
+
+# Linear bucket bounds for the alive-fraction histogram: fractions live
+# in [0, 1], where the default log ladder (1e-4..1e3) has almost no
+# resolution. One definition site (like obs.render_fps_gauge) so every
+# process files observations into merge-compatible buckets.
+ALIVE_FRACTION_BUCKETS = tuple((i + 1) / 16 for i in range(16))
+
+
+def lane_occupancy_gauge(registry=None):
+    """live / launched-width of the most recent wavefront relaunch."""
+    from tpu_render_cluster.obs import get_registry
+
+    registry = registry if registry is not None else get_registry()
+    return registry.gauge(
+        "render_lane_occupancy",
+        "Live-lane fraction of the last wavefront bounce launch "
+        "(live rays / bucketed launch width)",
+    )
+
+
+def alive_fraction_histogram(registry=None):
+    """Per-bounce survival: live rays / original wavefront size."""
+    from tpu_render_cluster.obs import get_registry
+
+    registry = registry if registry is not None else get_registry()
+    return registry.histogram(
+        "render_alive_fraction",
+        "Per-bounce live fraction of the original wavefront "
+        "(1 - this, averaged, is bench.py's wasted_lane_fraction)",
+        labels=("bounce",),
+        buckets=ALIVE_FRACTION_BUCKETS,
+    )
+
+
+def compile_counter(registry=None):
+    from tpu_render_cluster.obs import get_registry
+
+    registry = registry if registry is not None else get_registry()
+    return registry.counter(
+        "render_compiles_total",
+        "Wavefront programs compiled (first sighting of a (kind, bucket) "
+        "shape this process) — grows with the bucket ladder, not frames",
+    )
+
+
+# First-sighting tracker behind render_compiles_total. Python-level on
+# purpose: it counts the shapes THIS driver has launched (the quantity
+# the bucket ladder bounds), independent of jax cache internals.
+_seen_shapes: set[tuple] = set()
+
+
+def _count_compile(*key) -> None:
+    if key not in _seen_shapes:
+        _seen_shapes.add(key)
+        compile_counter().inc()
+
+
+def bucket_for(live: int, cap: int, block: int) -> int:
+    """Smallest power-of-two multiple of ``block`` >= ``live``, <= ``cap``.
+
+    The relaunch ladder: block, 2*block, 4*block, ... — at most
+    log2(cap / block) + 1 distinct jit shapes per (scene, config), the
+    same compile-once-per-bucket idiom as ops/assignment._next_bucket.
+    """
+    size = block
+    while size < live:
+        size *= 2
+    return min(size, cap)
+
+
+@jax.jit
+def compaction_order(alive):
+    """Stable partition permutation via prefix sums: alive lanes first.
+
+    Returns (perm, live) with ``x[perm]`` compacted — live lanes in
+    their original relative order, then the dead tail. A cumsum scatter,
+    not an argsort: O(n) work and no comparison sort on the hot path.
+    """
+    alive_i32 = alive.astype(jnp.int32)
+    live = jnp.sum(alive_i32)
+    front = jnp.cumsum(alive_i32) - 1
+    back = live + jnp.cumsum(1 - alive_i32) - 1
+    dest = jnp.where(alive, front, back)
+    n = alive.shape[0]
+    perm = jnp.zeros((n,), jnp.int32).at[dest].set(
+        jnp.arange(n, dtype=jnp.int32)
+    )
+    return perm, live
+
+
+@jax.jit
+def _compact_sphere(origins, directions, throughput, alive, lane):
+    """Compact sphere-scene state (no coherence sort needed — the sphere
+    pass has no packet culling, so only the dead/alive partition
+    matters). One packed gather so the random-access cost is paid once
+    per row, not per field."""
+    perm, live = compaction_order(alive)
+    packed = jnp.concatenate([origins, directions, throughput], axis=1)[perm]
+    return (
+        packed[:, 0:3],
+        packed[:, 3:6],
+        packed[:, 6:9],
+        alive[perm],
+        lane[perm],
+        live,
+    )
+
+
+@jax.jit
+def _compact_mesh(origins, directions, throughput, alive, lane, mesh):
+    """Compact mesh-scene state with the integrator's coherence sort.
+
+    _ray_sort_order's dead flag (bit 31) already parks dead lanes at the
+    tail, so the Morton/candidate re-sort IS the compaction permutation
+    — one gather buys both packet coherence and the partition.
+    """
+    from tpu_render_cluster.render.integrator import _ray_sort_order
+
+    order = _ray_sort_order(origins, directions, alive, mesh=mesh)
+    packed = jnp.concatenate([origins, directions, throughput], axis=1)[order]
+    return (
+        packed[:, 0:3],
+        packed[:, 3:6],
+        packed[:, 6:9],
+        alive[order],
+        lane[order],
+        jnp.sum(alive.astype(jnp.int32)),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("total_bounces",))
+def _sphere_step(
+    scene, origins, directions, throughput, alive, lane, live, seed,
+    bounce, radiance_total, *, total_bounces: int,
+):
+    contribution, o2, d2, thr2, alive2 = pk.sphere_bounce_pallas(
+        scene, origins, directions, throughput, alive, seed, bounce,
+        total_bounces=total_bounces, lane=lane, live_count=live,
+    )
+    return o2, d2, thr2, alive2, radiance_total.at[lane].add(contribution)
+
+
+@functools.partial(jax.jit, static_argnames=("total_bounces",))
+def _mesh_step(
+    scene, mesh, origins, directions, throughput, alive, lane, live, seed,
+    bounce, radiance_total, *, total_bounces: int,
+):
+    contribution, o2, d2, thr2, alive2 = pk.mesh_bounce_pallas(
+        scene, mesh, origins, directions, throughput, alive, seed, bounce,
+        total_bounces=total_bounces, lane=lane, live_count=live,
+    )
+    return o2, d2, thr2, alive2, radiance_total.at[lane].add(contribution)
+
+
+def trace_paths_wavefront(
+    scene, origins, directions, seed, *, max_bounces: int = 4, mesh=None
+):
+    """Trace one sample per ray, wavefront-style; returns radiance [R, 3].
+
+    The host-driven loop: compact -> read live count (ONE device sync
+    per bounce — the price of dynamic launch widths) -> round up to a
+    bucket -> relaunch the fused bounce kernel over the bucket only ->
+    scatter the contribution back through the carried lane ids. An
+    all-dead wavefront ends the loop early (remaining bounces cannot
+    contribute).
+
+    Physics and per-original-lane RNG streams are identical to the
+    masked Pallas paths (integrator.trace_paths with TRC_PALLAS on), so
+    images agree up to FP tie-breaking — tests/test_wavefront.py pins
+    the equivalence.
+    """
+    from tpu_render_cluster.obs import get_tracer
+
+    n0 = origins.shape[0]
+    block = pk.BVH_BLOCK_R if mesh is not None else pk.SPHERE_BOUNCE_BLOCK_R
+    kind = "mesh" if mesh is not None else "sphere"
+    tracer = get_tracer()
+    occupancy = lane_occupancy_gauge()
+    survival = alive_fraction_histogram()
+
+    radiance_total = jnp.zeros((n0, 3), jnp.float32)
+    throughput = jnp.ones((n0, 3), jnp.float32)
+    alive = jnp.ones((n0,), bool)
+    lane = jnp.arange(n0, dtype=jnp.int32)
+    seed = jnp.asarray(seed, jnp.int32)
+
+    for bounce in range(max_bounces):
+        start_wall = time.time()
+        start_mono = time.perf_counter()
+        width = origins.shape[0]
+        _count_compile(kind, "compact", width)
+        if mesh is not None:
+            origins, directions, throughput, alive, lane, live_dev = (
+                _compact_mesh(origins, directions, throughput, alive, lane, mesh)
+            )
+        else:
+            origins, directions, throughput, alive, lane, live_dev = (
+                _compact_sphere(origins, directions, throughput, alive, lane)
+            )
+        live = int(live_dev)
+        survival.observe(live / n0, bounce=bounce)
+        if live == 0:
+            occupancy.set(0.0)
+            tracer.complete(
+                "wavefront_bounce", cat="render", start_wall=start_wall,
+                duration=time.perf_counter() - start_mono,
+                args={"bounce": bounce, "live": 0, "bucket": 0,
+                      "alive_fraction": 0.0},
+            )
+            break
+        bucket = bucket_for(live, cap=width, block=block)
+        if bucket < width:
+            origins = origins[:bucket]
+            directions = directions[:bucket]
+            throughput = throughput[:bucket]
+            alive = alive[:bucket]
+            lane = lane[:bucket]
+        occupancy.set(live / bucket)
+        _count_compile(kind, "bounce", bucket, max_bounces)
+        if mesh is not None:
+            origins, directions, throughput, alive, radiance_total = (
+                _mesh_step(
+                    scene, mesh, origins, directions, throughput, alive,
+                    lane, live_dev, seed, bounce, radiance_total,
+                    total_bounces=max_bounces,
+                )
+            )
+        else:
+            origins, directions, throughput, alive, radiance_total = (
+                _sphere_step(
+                    scene, origins, directions, throughput, alive, lane,
+                    live_dev, seed, bounce, radiance_total,
+                    total_bounces=max_bounces,
+                )
+            )
+        tracer.complete(
+            "wavefront_bounce", cat="render", start_wall=start_wall,
+            duration=time.perf_counter() - start_mono,
+            args={"bounce": bounce, "live": live, "bucket": bucket,
+                  "alive_fraction": round(live / n0, 4)},
+        )
+    return radiance_total
+
+
+@functools.partial(
+    jax.jit, static_argnames=("width", "height", "samples")
+)
+def _frame_rays(camera, frame, *, width: int, height: int, samples: int):
+    """Primary rays for a full frame, samples flattened onto the ray axis.
+
+    Built from render_tile's OWN helpers (integrator.tile_base_key /
+    flat_sample_rays / tile_trace_key / trace_seed), so a wavefront
+    frame and a masked frame provably trace the same physical rays with
+    the same per-lane RNG streams — the derivation cannot drift.
+    """
+    from tpu_render_cluster.render.integrator import (
+        flat_sample_rays,
+        tile_base_key,
+        tile_trace_key,
+        trace_seed,
+    )
+
+    base_key = tile_base_key(frame, 0, 0)
+    origins, directions = flat_sample_rays(
+        camera, base_key, width=width, height=height, y0=0, x0=0,
+        tile_height=height, tile_width=width, samples=samples,
+    )
+    return origins, directions, trace_seed(tile_trace_key(base_key))
+
+
+@functools.partial(jax.jit, static_argnames=("samples", "height", "width"))
+def _finish_frame(radiance, *, samples: int, height: int, width: int):
+    n = height * width
+    return radiance.reshape(samples, n, 3).mean(axis=0).reshape(
+        height, width, 3
+    )
+
+
+def render_frame_wavefront(
+    scene_name: str,
+    frame_index,
+    *,
+    width: int = 512,
+    height: int = 512,
+    samples: int = 8,
+    max_bounces: int = 4,
+):
+    """Render one frame through the wavefront driver; [H, W, 3] linear.
+
+    The wavefront counterpart of integrator.render_frame /
+    fused_frame_renderer. Not a single fused dispatch — the driver's
+    per-bounce host sync is the mechanism — so scene/camera build runs
+    eagerly; that cost is noise on the deep-walk scenes this mode is
+    for.
+    """
+    from tpu_render_cluster.render.camera import scene_camera
+    from tpu_render_cluster.render.mesh import scene_mesh_set
+    from tpu_render_cluster.render.scene import build_scene
+
+    scene = build_scene(scene_name, frame_index)
+    camera = scene_camera(scene_name, frame_index)
+    mesh = scene_mesh_set(scene_name, frame_index)
+    origins, directions, seed = _frame_rays(
+        camera, jnp.asarray(frame_index, jnp.float32),
+        width=width, height=height, samples=samples,
+    )
+    radiance = trace_paths_wavefront(
+        scene, origins, directions, seed, max_bounces=max_bounces, mesh=mesh
+    )
+    return _finish_frame(
+        radiance, samples=samples, height=height, width=width
+    )
+
+
+def wavefront_active(
+    scene_name: str, *, backend_flag: str | None = None, frame=1
+) -> bool:
+    """Whether the wavefront driver should render this scene.
+
+    ``backend_flag`` (the worker's ``--wavefront`` / constructor knob)
+    overrides the ``TRC_WAVEFRONT`` env tier; ``auto`` defers to the
+    per-scene heuristic (deep-walk mesh scenes — exactly the scenes the
+    per-bounce dispatch already routes away from the megakernel).
+    """
+    if not pk.pallas_enabled():
+        return False
+    mode = backend_flag if backend_flag is not None else pk.wavefront_mode()
+    mode = str(mode).lower()
+    if mode in ("0", "false", "off", "no"):
+        return False
+    if mode not in ("auto", ""):
+        return True
+    from tpu_render_cluster.render.mesh import scene_mesh_set
+
+    return pk.wavefront_eligible(scene_mesh_set(scene_name, frame))
+
+
+def wasted_lane_fraction(registry=None) -> float | None:
+    """1 - mean(alive fraction) over every recorded wavefront bounce.
+
+    The average fraction of the ORIGINAL wavefront that is dead at each
+    bounce launch — what a masked full-width bounce loop wastes, and
+    what compaction reclaims. None before any wavefront render ran.
+    """
+    histogram = alive_fraction_histogram(registry)
+    count = 0
+    total = 0.0
+    for _key, series in histogram._series_items():
+        count += series.count
+        total += series.sum
+    if count == 0:
+        return None
+    return 1.0 - total / count

@@ -222,6 +222,67 @@ def _ray_sort_order(origins, directions, alive, mesh=None):
     return jnp.argsort((candidate << 18) | (morton << 3) | octant | dead)
 
 
+def tile_base_key(frame, y0, x0):
+    """The (frame, y0, x0)-derived RNG root every tile render uses."""
+    return jax.random.fold_in(
+        jax.random.fold_in(
+            jax.random.fold_in(
+                jax.random.PRNGKey(917), jnp.asarray(frame).astype(jnp.int32)
+            ),
+            jnp.asarray(y0, jnp.int32),
+        ),
+        jnp.asarray(x0, jnp.int32),
+    )
+
+
+def tile_trace_key(base_key):
+    """The path-trace key for a tile (sample index -1 = the trace
+    stream, disjoint from every per-sample jitter stream)."""
+    return jax.random.fold_in(base_key, jnp.int32(-1))
+
+
+def trace_seed(key):
+    """int32 scalar driving the Pallas kernels' in-kernel counter PCG."""
+    return jax.random.key_data(key).ravel()[-1].astype(jnp.int32)
+
+
+def sample_jitter_rays(
+    camera: Camera, key, *, width, height, y0, x0, tile_height, tile_width
+):
+    """One sample's jittered primary rays for a tile."""
+    jitter_key, _ = jax.random.split(key)
+    jitter = jax.random.uniform(jitter_key, (tile_height * tile_width, 2))
+    return camera_rays(
+        camera, width, height, y0=y0, x0=x0,
+        tile_height=tile_height, tile_width=tile_width, jitter=jitter,
+    )
+
+
+def flat_sample_rays(
+    camera: Camera, base_key, *, width, height, y0, x0, tile_height,
+    tile_width, samples,
+):
+    """All samples' rays flattened onto the ray axis ([S * n, 3] x 2).
+
+    ONE definition shared by render_tile's flattened branch and the
+    wavefront driver (render/compaction._frame_rays): the masked-vs-
+    wavefront equivalence rests on both tracing byte-identical rays with
+    byte-identical RNG derivation, so the key schedule must not be able
+    to drift between them.
+    """
+    n = tile_height * tile_width
+    sample_keys = jax.vmap(lambda s: jax.random.fold_in(base_key, s))(
+        jnp.arange(samples)
+    )
+    origins, directions = jax.vmap(
+        lambda key: sample_jitter_rays(
+            camera, key, width=width, height=height, y0=y0, x0=x0,
+            tile_height=tile_height, tile_width=tile_width,
+        )
+    )(sample_keys)
+    return origins.reshape(samples * n, 3), directions.reshape(samples * n, 3)
+
+
 def trace_paths(
     scene: Scene, origins, directions, key, *, max_bounces: int = 4, mesh=None
 ) -> jnp.ndarray:
@@ -236,7 +297,7 @@ def trace_paths(
     from tpu_render_cluster.render import pallas_kernels
 
     if pallas_kernels.pallas_enabled():
-        seed = jax.random.key_data(key).ravel()[-1].astype(jnp.int32)
+        seed = trace_seed(key)
         if mesh is None:
             return pallas_kernels.trace_paths_fused(
                 scene, origins, directions, seed, max_bounces=max_bounces
@@ -280,10 +341,20 @@ def trace_paths(
             radiance = packed[:, 9:12]
             alive = alive[order]
             lane = lane[order]
+            # The sort key's dead flag (bit 31) puts every dead lane
+            # after every live one, so lanes >= live are exactly the dead
+            # tail: the kernel's live-count prefetch skips those blocks
+            # outright (behavior-preserving — dead lanes pass through a
+            # masked bounce unchanged anyway). The carried ORIGINAL lane
+            # id doubles as the RNG counter, so a ray's stream survives
+            # the permutation (and composes with the wavefront driver's
+            # compaction, which shares this kernel).
+            live = jnp.sum(alive.astype(jnp.int32))
             contribution, origins, directions, throughput, alive = (
                 pallas_kernels.mesh_bounce_pallas(
                     scene, mesh, origins, directions, throughput, alive,
                     seed, bounce, total_bounces=max_bounces,
+                    lane=lane, live_count=live,
                 )
             )
             radiance = radiance + contribution
@@ -332,31 +403,7 @@ def render_tile(
     frame renders identically regardless of device/order.
     """
     n = tile_height * tile_width
-    base_key = jax.random.fold_in(
-        jax.random.fold_in(
-            jax.random.fold_in(jax.random.PRNGKey(917), frame.astype(jnp.int32)),
-            jnp.asarray(y0, jnp.int32),
-        ),
-        jnp.asarray(x0, jnp.int32),
-    )
-
-    sample_keys = jax.vmap(lambda s: jax.random.fold_in(base_key, s))(
-        jnp.arange(samples)
-    )
-
-    def rays_for_sample(key):
-        jitter_key, _ = jax.random.split(key)
-        jitter = jax.random.uniform(jitter_key, (n, 2))
-        return camera_rays(
-            camera,
-            width,
-            height,
-            y0=y0,
-            x0=x0,
-            tile_height=tile_height,
-            tile_width=tile_width,
-            jitter=jitter,
-        )
+    base_key = tile_base_key(frame, y0, x0)
 
     from tpu_render_cluster.render import pallas_kernels
 
@@ -376,12 +423,15 @@ def render_tile(
         # total work — a measured ~1.9x on a single chip. Safe here because
         # the fused kernel blocks rays at BLOCK_R; its VMEM working set is
         # independent of the flattened ray count.
-        origins, directions = jax.vmap(rays_for_sample)(sample_keys)  # [S, n, 3]
+        origins, directions = flat_sample_rays(
+            camera, base_key, width=width, height=height, y0=y0, x0=x0,
+            tile_height=tile_height, tile_width=tile_width, samples=samples,
+        )
         radiance = trace_paths(
             scene,
-            origins.reshape(samples * n, 3),
-            directions.reshape(samples * n, 3),
-            jax.random.fold_in(base_key, jnp.int32(-1)),
+            origins,
+            directions,
+            tile_trace_key(base_key),
             max_bounces=max_bounces,
             mesh=mesh,
         )
@@ -391,8 +441,15 @@ def render_tile(
         # so the flattened [samples * n] ray axis would multiply peak memory
         # by 'samples' (an OOM risk for big tiles on CPU/GPU workers); keep
         # the sequential per-sample scan there instead.
+        sample_keys = jax.vmap(lambda s: jax.random.fold_in(base_key, s))(
+            jnp.arange(samples)
+        )
+
         def sample_step(acc, key):
-            origins, directions = rays_for_sample(key)
+            origins, directions = sample_jitter_rays(
+                camera, key, width=width, height=height, y0=y0, x0=x0,
+                tile_height=tile_height, tile_width=tile_width,
+            )
             _, trace_key = jax.random.split(key)
             radiance = trace_paths(
                 scene, origins, directions, trace_key,

@@ -222,17 +222,24 @@ def build_bvh(vertices: np.ndarray, faces: np.ndarray) -> MeshBVH:
     n = np.cross(e1, e2)
     norm = np.linalg.norm(n, axis=1, keepdims=True)
     n = np.where(norm > 1e-12, n / np.maximum(norm, 1e-12), np.array([[0.0, 1.0, 0.0]], np.float32))
-    return MeshBVH(
-        v0=jnp.asarray(v0),
-        e1=jnp.asarray(e1),
-        e2=jnp.asarray(e2),
-        normal=jnp.asarray(n.astype(np.float32)),
-        bounds_min=jnp.asarray(np.stack([nd["min"] for nd in nodes])),
-        bounds_max=jnp.asarray(np.stack([nd["max"] for nd in nodes])),
-        skip=jnp.asarray(skip),
-        first=jnp.asarray(first),
-        count=jnp.asarray(count),
-    )
+    # ensure_compile_time_eval: the first build may happen INSIDE a jit
+    # trace (fused_frame_renderer -> scene_mesh_set -> cached_mesh_bvh),
+    # where bare jnp.asarray would return trace-local tracers — which the
+    # lru_cache would then hand to later EAGER callers (the wavefront
+    # driver) as leaked tracers. This forces concrete, cache-safe arrays
+    # regardless of the first caller's context.
+    with jax.ensure_compile_time_eval():
+        return MeshBVH(
+            v0=jnp.asarray(v0),
+            e1=jnp.asarray(e1),
+            e2=jnp.asarray(e2),
+            normal=jnp.asarray(n.astype(np.float32)),
+            bounds_min=jnp.asarray(np.stack([nd["min"] for nd in nodes])),
+            bounds_max=jnp.asarray(np.stack([nd["max"] for nd in nodes])),
+            skip=jnp.asarray(skip),
+            first=jnp.asarray(first),
+            count=jnp.asarray(count),
+        )
 
 
 @functools.lru_cache(maxsize=8)

@@ -79,6 +79,50 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+# Ray block for the per-bounce STATE-IO sphere kernel (the wavefront
+# driver's sphere bounce). Deliberately smaller than BLOCK_R: the block
+# size is also the bucket quantum of the wavefront compacted relaunch
+# (render/compaction.py), so a 4096 block would make compaction a no-op
+# below 4096 live rays; 1024 matches BVH_BLOCK_R's granularity.
+SPHERE_BOUNCE_BLOCK_R = 1024
+
+
+def wavefront_mode() -> str:
+    """The ``TRC_WAVEFRONT`` env tier: ``off`` / ``auto`` / ``force``.
+
+    - unset (``auto``): wavefront execution is used where it measured
+      faster — deep-walk mesh scenes already on the per-bounce dispatch
+      (``wavefront_eligible``);
+    - ``TRC_WAVEFRONT=0`` (also ``false``/``off``): never;
+    - ``TRC_WAVEFRONT=1`` (anything else truthy): force it for every
+      Pallas-rendered scene, spheres included.
+
+    Like ``TRC_PALLAS`` this is read when the dispatch decision is made
+    (the wavefront driver runs outside jit, so per-frame, not per-trace).
+    """
+    value = (os.environ.get("TRC_WAVEFRONT") or "").strip().lower()
+    if value in ("", "auto"):
+        return "auto"
+    if value in ("0", "false", "off", "no"):
+        return "off"
+    return "force"
+
+
+def wavefront_eligible(mesh) -> bool:
+    """Auto-tier heuristic: scenes already on the per-bounce deep-walk
+    dispatch — exactly where masked dead lanes still pay for BVH packet
+    walks, which is the waste compaction removes. Shallow/megakernel
+    scenes keep path state VMEM-resident across bounces; breaking the
+    loop per bounce there costs more than compaction recovers."""
+    return mesh is not None and not mesh_megakernel_eligible(mesh)
+
+
+# (The combined should-this-scene-go-wavefront decision lives in
+# render/compaction.wavefront_active — the single dispatch site, so the
+# env tier, a backend override, and this heuristic can't be recombined
+# differently by two callers.)
+
+
 def _nearest_hit_kernel(o_ref, d_ref, c_ref, r2_ref, csq_ref, t_ref, idx_ref):
     """One ray block vs all spheres; writes min-t and argmin index."""
     o = o_ref[:, :]  # [3, BR]
@@ -274,11 +318,32 @@ def _uniform_from_hash(h):
     return word.astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
 
 
-def _trace_kernel_factory(max_bounces: int, n_padded: int):
+def _trace_kernel_factory(max_bounces: int, n_padded: int, state_io: bool = False):
+    """Sphere path-trace kernel. Two shapes share one bounce_step (same
+    split as _mesh_trace_kernel_factory):
+
+    - state_io=False: the whole-bounce-loop MEGAKERNEL (state
+      VMEM-resident across all bounces, radiance out);
+    - state_io=True: ONE bounce per launch with path state streamed
+      in/out plus a per-lane ORIGINAL lane id (the RNG counter, so
+      streams survive compaction/re-sorting) and a live-count scalar
+      (blocks whose first lane is past it — all dead by the compaction
+      contract — skip the bounce entirely). ``max_bounces`` still names
+      the TOTAL bounce count so RNG counters match the megakernel.
+    """
     contract_first = (((0,), (0,)), ((), ()))
 
-    def kernel(seed_ref, o_ref, d_ref, c_ref, r2_ref, csq_ref, rad_ref,
-               albedo_ref, emission_ref, dcsun_ref, params_ref, out_ref):
+    def kernel(*refs):
+        if state_io:
+            (seed_ref, bounce_ref, live_ref, o_ref, d_ref, thr_ref,
+             alive_ref, lane_ref, c_ref, r2_ref, csq_ref, rad_ref,
+             albedo_ref, emission_ref, dcsun_ref, params_ref,
+             out_ref, o_out_ref, d_out_ref, thr_out_ref,
+             alive_out_ref) = refs
+        else:
+            (seed_ref, o_ref, d_ref, c_ref, r2_ref, csq_ref, rad_ref,
+             albedo_ref, emission_ref, dcsun_ref, params_ref,
+             out_ref) = refs
         o = o_ref[:, :]  # [3, BR] ray origins
         d = d_ref[:, :]  # [3, BR] ray directions
         c = c_ref[:, :]  # [3, N] sphere centers
@@ -300,10 +365,16 @@ def _trace_kernel_factory(max_bounces: int, n_padded: int):
 
         block = o.shape[1]
         seed = seed_ref[0, 0].astype(jnp.uint32)
-        ray_index = (
-            jax.lax.broadcasted_iota(jnp.int32, (1, block), 1).astype(jnp.uint32)
-            + jnp.uint32(pl.program_id(0) * block)
-        )
+        if state_io:
+            # RNG counters follow the ORIGINAL lane id the caller threads
+            # through compaction/re-sorts, not the current position: a
+            # ray keeps its stream wherever compaction lands it.
+            ray_index = lane_ref[:, :].astype(jnp.uint32)
+        else:
+            ray_index = (
+                jax.lax.broadcasted_iota(jnp.int32, (1, block), 1).astype(jnp.uint32)
+                + jnp.uint32(pl.program_id(0) * block)
+            )
         sphere_iota = jax.lax.broadcasted_iota(jnp.int32, (n_padded, block), 0)
 
         throughput = jnp.ones((3, block), jnp.float32)
@@ -455,11 +526,33 @@ def _trace_kernel_factory(max_bounces: int, n_padded: int):
             d = jnp.where(live, new_d, d)
             return (o, d, throughput, radiance, alive)
 
-        _, _, _, radiance, _ = jax.lax.fori_loop(
-            0, max_bounces, bounce_step,
-            (o, d, throughput, radiance, alive),
-        )
-        out_ref[:, :] = radiance
+        if state_io:
+            # ONE bounce with streamed state. Blocks entirely past the
+            # live count are all-dead (the compaction contract sorts dead
+            # lanes to the tail) and pass their state through untouched —
+            # exactly what the masked bounce computes for dead lanes, for
+            # free.
+            throughput = thr_ref[:, :]
+            alive = alive_ref[:, :]
+            block_start = pl.program_id(0) * block
+            o, d, throughput, radiance, alive = jax.lax.cond(
+                block_start < live_ref[0, 0],
+                lambda: bounce_step(
+                    bounce_ref[0, 0], (o, d, throughput, radiance, alive)
+                ),
+                lambda: (o, d, throughput, radiance, alive),
+            )
+            out_ref[:, :] = radiance
+            o_out_ref[:, :] = o
+            d_out_ref[:, :] = d
+            thr_out_ref[:, :] = throughput
+            alive_out_ref[:, :] = alive
+        else:
+            _, _, _, radiance, _ = jax.lax.fori_loop(
+                0, max_bounces, bounce_step,
+                (o, d, throughput, radiance, alive),
+            )
+            out_ref[:, :] = radiance
 
     return kernel
 
@@ -547,6 +640,126 @@ def trace_paths_fused(scene, origins, directions, seed, *, max_bounces: int):
         seed,
         max_bounces=max_bounces,
         interpret=_interpret(),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("total_bounces", "interpret"))
+def _sphere_bounce(
+    origins, directions, throughput, alive, lane, live_count, seed, bounce,
+    centers, radii, albedo, emission,
+    sun_direction, sun_color, sky_horizon, sky_zenith,
+    plane_albedo_a, plane_albedo_b,
+    *, total_bounces: int, interpret: bool,
+):
+    rays = origins.shape[0]
+    block = SPHERE_BOUNCE_BLOCK_R
+    padded_rays = -(-rays // block) * block
+    ray_pad = padded_rays - rays
+    # Zero pad is fine here (unlike the BVH kernels): the sphere pass has
+    # no cross-lane packet culling, and pad lanes arrive DEAD (alive pad
+    # 0) so their garbage t never reaches an output.
+    o_t = jnp.pad(origins, ((0, ray_pad), (0, 0))).T
+    d_t = jnp.pad(directions, ((0, ray_pad), (0, 0))).T
+    thr_t = jnp.pad(throughput, ((0, ray_pad), (0, 0))).T
+    alive_t = jnp.pad(alive.astype(jnp.float32), (0, ray_pad))[None, :]
+    lane_t = jnp.pad(lane.astype(jnp.int32), (0, ray_pad))[None, :]
+
+    n = centers.shape[0]
+    padded_n = -(-n // _SUBLANE) * _SUBLANE
+    sphere_pad = padded_n - n
+    c_t = jnp.pad(centers, ((0, sphere_pad), (0, 0))).T
+    radii_p = jnp.pad(radii, (0, sphere_pad))
+    r2 = (radii_p * radii_p)[:, None]
+    csq = jnp.sum(c_t * c_t, axis=0)[:, None]
+    rad = radii_p[:, None]
+    albedo_t = jnp.pad(albedo, ((0, sphere_pad), (0, 0))).T
+    emission_t = jnp.pad(emission, ((0, sphere_pad), (0, 0))).T
+    dc_sun = (c_t.T @ sun_direction)[:, None]
+
+    params = jnp.zeros((8, 3), jnp.float32)
+    params = params.at[0].set(sun_direction)
+    params = params.at[1].set(sun_color)
+    params = params.at[2].set(sky_horizon)
+    params = params.at[3].set(sky_zenith)
+    params = params.at[4].set(plane_albedo_a)
+    params = params.at[5].set(plane_albedo_b)
+    seed_arr = jnp.asarray(seed, jnp.int32).reshape(1, 1)
+    bounce_arr = jnp.asarray(bounce, jnp.int32).reshape(1, 1)
+    live_arr = jnp.asarray(live_count, jnp.int32).reshape(1, 1)
+
+    grid = (padded_rays // block,)
+    whole = lambda i: (0, 0)  # noqa: E731
+    ray_block = pl.BlockSpec((3, block), lambda i: (0, i), memory_space=pltpu.VMEM)
+    row_block = pl.BlockSpec((1, block), lambda i: (0, i), memory_space=pltpu.VMEM)
+    contrib, o2, d2, thr2, alive2 = pl.pallas_call(
+        _trace_kernel_factory(total_bounces, padded_n, state_io=True),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), whole, memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), whole, memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), whole, memory_space=pltpu.SMEM),
+            ray_block,
+            ray_block,
+            ray_block,
+            row_block,
+            row_block,
+            pl.BlockSpec((3, padded_n), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, padded_n), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, padded_n), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, 3), whole, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[ray_block, ray_block, ray_block, ray_block, row_block],
+        out_shape=[
+            jax.ShapeDtypeStruct((3, padded_rays), jnp.float32),
+            jax.ShapeDtypeStruct((3, padded_rays), jnp.float32),
+            jax.ShapeDtypeStruct((3, padded_rays), jnp.float32),
+            jax.ShapeDtypeStruct((3, padded_rays), jnp.float32),
+            jax.ShapeDtypeStruct((1, padded_rays), jnp.float32),
+        ],
+        interpret=interpret,
+    )(seed_arr, bounce_arr, live_arr, o_t, d_t, thr_t, alive_t, lane_t,
+      c_t, r2, csq, rad, albedo_t, emission_t, dc_sun, params)
+    return (
+        contrib.T[:rays],
+        o2.T[:rays],
+        d2.T[:rays],
+        thr2.T[:rays],
+        alive2[0, :rays] > 0.5,
+    )
+
+
+def sphere_bounce_pallas(
+    scene, origins, directions, throughput, alive, seed, bounce,
+    *, total_bounces: int, lane=None, live_count=None,
+):
+    """One fused path-trace bounce for sphere-only scenes.
+
+    The sphere megakernel's bounce_step as a single launch with path
+    state streamed in/out — the sphere twin of ``mesh_bounce_pallas``,
+    built for the wavefront driver (render/compaction.py): ``lane``
+    carries each ray's ORIGINAL lane id (the RNG counter, so streams
+    survive compaction) and ``live_count`` lets blocks entirely inside
+    the compacted dead tail skip the bounce. Defaults reproduce the
+    megakernel's full-width behavior (positional lanes, nothing
+    skipped). Returns (radiance contribution [R, 3], new origins, new
+    directions, new throughput, new alive).
+    """
+    n = origins.shape[0]
+    if lane is None:
+        lane = jnp.arange(n, dtype=jnp.int32)
+    if live_count is None:
+        live_count = jnp.int32(n)
+    return _sphere_bounce(
+        origins, directions, throughput, alive, lane, live_count, seed,
+        bounce,
+        scene.centers, scene.radii, scene.albedo, scene.emission,
+        scene.sun_direction, scene.sun_color, scene.sky_horizon,
+        scene.sky_zenith, scene.plane_albedo_a, scene.plane_albedo_b,
+        total_bounces=total_bounces, interpret=_interpret(),
     )
 
 
@@ -1438,7 +1651,8 @@ def _mesh_trace_kernel_factory(
 
     def kernel(*refs):
         if state_io:
-            (seed_ref, bounce_ref, o_ref, d_ref, thr_ref, alive_ref,
+            (seed_ref, bounce_ref, live_ref, o_ref, d_ref, thr_ref,
+             alive_ref, lane_ref,
              c_ref, r2_ref, csq_ref, rad_ref, albedo_ref, emission_ref,
              dcsun_ref, params_ref, sunsm_ref, inst_ref, v0_ref, e1_ref,
              e2_ref, nrm_ref, bmin_ref, bmax_ref, skip_ref, first_ref,
@@ -1468,10 +1682,18 @@ def _mesh_trace_kernel_factory(
 
         block = o.shape[1]
         seed = seed_ref[0, 0].astype(jnp.uint32)
-        ray_index = (
-            jax.lax.broadcasted_iota(jnp.int32, (1, block), 1).astype(jnp.uint32)
-            + jnp.uint32(pl.program_id(0) * block)
-        )
+        if state_io:
+            # RNG counters follow the ORIGINAL lane id the integrator /
+            # wavefront driver threads through its re-sorts and
+            # compaction — a ray keeps its stream wherever the
+            # permutation lands it (the megakernel's positional index IS
+            # the original lane there, since it never reorders).
+            ray_index = lane_ref[:, :].astype(jnp.uint32)
+        else:
+            ray_index = (
+                jax.lax.broadcasted_iota(jnp.int32, (1, block), 1).astype(jnp.uint32)
+                + jnp.uint32(pl.program_id(0) * block)
+            )
         sphere_iota = jax.lax.broadcasted_iota(jnp.int32, (n_padded, block), 0)
         lanes = jax.lax.broadcasted_iota(jnp.int32, (leaf_size, block), 0)
 
@@ -1956,12 +2178,22 @@ def _mesh_trace_kernel_factory(
         if state_io:
             # ONE bounce with streamed state: overwrite the in-kernel
             # initial state with the caller's, run bounce_step once at the
-            # caller's bounce index, stream everything back out.
+            # caller's bounce index, stream everything back out. Blocks
+            # whose first lane is past the live count are all-dead (the
+            # Morton sort / compaction puts dead lanes at the tail) and
+            # pass state through untouched — bit-identical to what the
+            # masked bounce computes for dead lanes, without paying for
+            # the walks.
             throughput = thr_ref[:, :]
             alive = alive_ref[:, :]
             bounce_index = bounce_ref[0, 0]
-            o, d, throughput, radiance, alive = bounce_step(
-                bounce_index, (o, d, throughput, radiance, alive)
+            block_start = pl.program_id(0) * block
+            o, d, throughput, radiance, alive = jax.lax.cond(
+                block_start < live_ref[0, 0],
+                lambda: bounce_step(
+                    bounce_index, (o, d, throughput, radiance, alive)
+                ),
+                lambda: (o, d, throughput, radiance, alive),
             )
             out_ref[:, :] = radiance
             o_out_ref[:, :] = o
@@ -2065,7 +2297,7 @@ def _trace_fused_mesh(
 
 
 def _mesh_bounce_io(
-    origins, directions, throughput, alive, seed, bounce,
+    origins, directions, throughput, alive, lane, live_count, seed, bounce,
     centers, radii, albedo, emission,
     sun_direction, sun_color, sky_horizon, sky_zenith,
     plane_albedo_a, plane_albedo_b,
@@ -2081,6 +2313,7 @@ def _mesh_bounce_io(
     # Pad lanes are DEAD: with their guaranteed-miss rays they never drive
     # a walk and their contribution stays zero.
     alive_t = jnp.pad(alive.astype(jnp.float32), (0, ray_pad))[None, :]
+    lane_t = jnp.pad(lane.astype(jnp.int32), (0, ray_pad))[None, :]
 
     n = centers.shape[0]
     padded_n = -(-n // _SUBLANE) * _SUBLANE
@@ -2103,6 +2336,7 @@ def _mesh_bounce_io(
     params = params.at[5].set(plane_albedo_b)
     seed_arr = jnp.asarray(seed, jnp.int32).reshape(1, 1)
     bounce_arr = jnp.asarray(bounce, jnp.int32).reshape(1, 1)
+    live_arr = jnp.asarray(live_count, jnp.int32).reshape(1, 1)
 
     # Front-to-back instance order (pure data reordering — normals/albedo
     # are tracked in-kernel, so results are order-invariant): near
@@ -2141,9 +2375,11 @@ def _mesh_bounce_io(
         in_specs=[
             pl.BlockSpec((1, 1), whole, memory_space=pltpu.SMEM),
             pl.BlockSpec((1, 1), whole, memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), whole, memory_space=pltpu.SMEM),
             ray_block,
             ray_block,
             ray_block,
+            row_block,
             row_block,
             pl.BlockSpec((3, padded_n), whole, memory_space=pltpu.VMEM),
             pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
@@ -2174,7 +2410,8 @@ def _mesh_bounce_io(
             jax.ShapeDtypeStruct((1, padded_rays), jnp.float32),
         ],
         interpret=interpret,
-    )(seed_arr, bounce_arr, o_t, d_t, thr_t, alive_t, c_t, r2, csq, rad,
+    )(seed_arr, bounce_arr, live_arr, o_t, d_t, thr_t, alive_t, lane_t,
+      c_t, r2, csq, rad,
       albedo_t, emission_t, dc_sun, params, sun_direction, inst_table,
       v0, e1, e2, normal, bounds_min, bounds_max, skip, first, count)
     return (
@@ -2188,7 +2425,7 @@ def _mesh_bounce_io(
 
 def mesh_bounce_pallas(
     scene, mesh, origins, directions, throughput, alive, seed, bounce,
-    *, total_bounces: int,
+    *, total_bounces: int, lane=None, live_count=None,
 ):
     """One fused path-trace bounce for deep-walk mesh scenes.
 
@@ -2196,13 +2433,24 @@ def mesh_bounce_pallas(
     streamed in/out, so integrator.trace_paths can re-sort rays between
     bounces (packet coherence) without paying per-bounce XLA glue —
     separate sphere/shadow kernels, threefry RNG, and a dozen elementwise
-    HBM round trips. Returns (radiance contribution [R, 3], new origins,
+    HBM round trips. ``lane`` carries each ray's ORIGINAL lane id — the
+    RNG counter, so a ray's stream survives the re-sort/compaction
+    permutations; ``live_count`` is the number of leading live lanes
+    (dead lanes must be sorted to the tail), letting all-dead tail
+    blocks skip the bounce. Defaults: positional lanes, nothing skipped.
+    Returns (radiance contribution [R, 3], new origins,
     new directions, new throughput, new alive).
     """
+    n = origins.shape[0]
+    if lane is None:
+        lane = jnp.arange(n, dtype=jnp.int32)
+    if live_count is None:
+        live_count = jnp.int32(n)
     bvh = mesh.bvh
     instances = mesh.instances
     return _mesh_bounce_io(
-        origins, directions, throughput, alive, seed, bounce,
+        origins, directions, throughput, alive, lane, live_count, seed,
+        bounce,
         scene.centers, scene.radii, scene.albedo, scene.emission,
         scene.sun_direction, scene.sun_color, scene.sky_horizon,
         scene.sky_zenith, scene.plane_albedo_a, scene.plane_albedo_b,

@@ -37,6 +37,7 @@ class TpuRaytraceBackend(RenderBackend):
         max_bounces: int = 4,
         tile_size: int | None = None,
         sharding: str | None = None,
+        wavefront: str | None = None,
     ) -> None:
         self.base_directory = Path(base_directory) if base_directory else None
         self.width = width
@@ -47,6 +48,20 @@ class TpuRaytraceBackend(RenderBackend):
         # None = single device; "tile" / "spp" shard across the local mesh
         # (tpu_render_cluster/parallel/sharded_render.py).
         self.sharding = sharding
+        # Wavefront (compact + bucketed relaunch) execution: None defers
+        # to the TRC_WAVEFRONT env tier; "off"/"auto"/"force" override it
+        # per backend (render/compaction.py). Only applies to the
+        # single-device path — tile/spp sharding gets the IN-JIT
+        # compaction (live-count tail skip) instead, which composes with
+        # shard_map.
+        self.wavefront = wavefront
+
+    def _use_wavefront(self, scene_name: str) -> bool:
+        if self.sharding in ("tile", "spp"):
+            return False
+        from tpu_render_cluster.render.compaction import wavefront_active
+
+        return wavefront_active(scene_name, backend_flag=self.wavefront)
 
     def warm(self, scene_name: str) -> None:
         """Compile + execute the renderer once, outside any job window.
@@ -76,6 +91,23 @@ class TpuRaytraceBackend(RenderBackend):
                     samples=self.samples,
                     max_bounces=self.max_bounces,
                     mode=self.sharding,
+                )
+            )
+        elif self._use_wavefront(scene_name):
+            # One full wavefront frame: compiles the compaction +
+            # bounce programs for the buckets this workload actually
+            # visits (render_compiles_total then stays flat over the
+            # job's frames).
+            from tpu_render_cluster.render.compaction import render_frame_wavefront
+
+            np.asarray(
+                render_frame_wavefront(
+                    scene_name,
+                    1,
+                    width=self.width,
+                    height=self.height,
+                    samples=self.samples,
+                    max_bounces=self.max_bounces,
                 )
             )
         else:
@@ -134,7 +166,11 @@ class TpuRaytraceBackend(RenderBackend):
         # Scene construction itself is fused into the XLA program: one
         # device dispatch per frame instead of dozens of eager array ops
         # (which cost ~2 s/frame over a tunneled device).
-        if self.sharding not in ("tile", "spp"):
+        # Wavefront mode has no single cached renderer (its per-bucket
+        # programs compile lazily inside the render — warm() pre-visits
+        # them), so its loading phase is just scene-name resolution.
+        use_wavefront = self._use_wavefront(scene_name)
+        if self.sharding not in ("tile", "spp") and not use_wavefront:
             renderer = fused_frame_renderer(
                 scene_name,
                 self.width,
@@ -156,6 +192,18 @@ class TpuRaytraceBackend(RenderBackend):
                 samples=self.samples,
                 max_bounces=self.max_bounces,
                 mode=self.sharding,
+            )
+            display = tonemap(linear)
+        elif use_wavefront:
+            from tpu_render_cluster.render.compaction import render_frame_wavefront
+
+            linear = render_frame_wavefront(
+                scene_name,
+                frame_index,
+                width=self.width,
+                height=self.height,
+                samples=self.samples,
+                max_bounces=self.max_bounces,
             )
             display = tonemap(linear)
         else:

@@ -75,6 +75,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="tpu-raytrace only: samples per pixel (default 8).",
     )
     parser.add_argument(
+        "--wavefront",
+        choices=["auto", "off", "force"],
+        default=None,
+        help="tpu-raytrace only: wavefront execution (per-bounce active-ray "
+        "compaction + bucketed relaunch; render/compaction.py). Default "
+        "defers to the TRC_WAVEFRONT env tier; auto enables it for "
+        "deep-walk mesh scenes where it measured faster.",
+    )
+    parser.add_argument(
         "--warmScene",
         dest="warm_scene",
         default=None,
@@ -125,6 +134,7 @@ def make_backend(args: argparse.Namespace):
             height=height,
             samples=args.render_samples,
             sharding=None if args.sharding == "none" else args.sharding,
+            wavefront=args.wavefront,
         )
     return create_backend("mock")
 
