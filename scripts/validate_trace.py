@@ -1,0 +1,58 @@
+#!/usr/bin/env python3
+"""Check exported Chrome trace-event artifacts against the repo's
+trace invariants (balanced/complete events, non-negative monotonic
+per-track timestamps, unique pid/tid metadata, resolvable flow ids).
+
+Usage:
+    python scripts/validate_trace.py <trace.json> [<trace.json> ...]
+    python scripts/validate_trace.py results/cluster-runs   # a directory:
+                                                            # validates every
+                                                            # *trace-events.json
+                                                            # under it
+
+Exit status 0 when every file passes, 1 otherwise. The checker itself
+lives in ``tpu_render_cluster/obs/validate.py`` so tests can call it
+in-process on everything they export.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from tpu_render_cluster.obs.validate import validate_trace_file  # noqa: E402
+
+
+def expand(arguments: list[str]) -> list[Path]:
+    paths: list[Path] = []
+    for argument in arguments:
+        path = Path(argument)
+        if path.is_dir():
+            paths.extend(sorted(path.rglob("*trace-events.json")))
+        else:
+            paths.append(path)
+    return paths
+
+
+def main(argv: list[str]) -> int:
+    paths = expand(argv)
+    if not paths:
+        print(__doc__, file=sys.stderr)
+        return 2
+    failures = 0
+    for path in paths:
+        problems = validate_trace_file(path)
+        if problems:
+            failures += 1
+            print(f"FAIL {path} ({len(problems)} problem(s))")
+            for problem in problems:
+                print(f"  - {problem}")
+        else:
+            print(f"ok   {path}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,204 @@
+"""Clock-offset estimator + cluster-timeline rebasing tests.
+
+The estimator must recover an injected clock skew (and its drift rate)
+from a synthetic heartbeat ping stream with realistic asymmetric network
+noise, and rebased worker events must land in correct causal order on the
+master clock — the two properties the merged cluster timeline stands on.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+
+import pytest
+
+from tpu_render_cluster.obs import (
+    ClockOffsetEstimator,
+    TimelineProcess,
+    Tracer,
+    export_cluster_trace,
+    tracer_process,
+)
+from tpu_render_cluster.obs.clocksync import ntp_offset_and_delay
+from tpu_render_cluster.obs.timeline import rebase_events
+
+
+def test_ntp_formula_on_a_clean_exchange():
+    # Worker clock exactly 2 s ahead, symmetric 5 ms legs, 1 ms hold.
+    t1 = 100.0
+    t2 = (t1 + 0.005) + 2.0
+    t3 = t2 + 0.001
+    t4 = t1 + 0.005 + 0.001 + 0.005
+    offset, delay = ntp_offset_and_delay(t1, t2, t3, t4)
+    assert offset == pytest.approx(2.0, abs=1e-9)
+    assert delay == pytest.approx(0.010, abs=1e-9)
+
+
+def _synthetic_ping_stream(
+    estimator: ClockOffsetEstimator,
+    *,
+    base_offset: float,
+    drift: float,
+    pings: int,
+    interval: float = 10.0,
+    seed: int = 7,
+) -> float:
+    """Feed pings with skew+drift and +/-1.5 ms asymmetric leg noise.
+
+    Returns the master time of the last exchange.
+    """
+    rng = random.Random(seed)
+    t0 = 1_700_000_000.0
+    t_last = t0
+    for i in range(pings):
+        t1 = t0 + interval * i
+        leg_out = 0.002 + rng.random() * 0.003
+        leg_back = 0.002 + rng.random() * 0.003
+        hold = 0.0005
+        arrive_master_clock = t1 + leg_out
+        theta = base_offset + drift * (arrive_master_clock - t0)
+        t2 = arrive_master_clock + theta
+        t3 = t2 + hold
+        t4 = arrive_master_clock + hold + leg_back
+        estimator.add_ping(t1, t2, t3, t4)
+        t_last = t4
+    return t_last
+
+
+def test_estimator_recovers_injected_skew():
+    estimator = ClockOffsetEstimator(window=16)
+    _synthetic_ping_stream(
+        estimator, base_offset=0.75, drift=0.0, pings=16
+    )
+    # Error is bounded by the +/-1.5 ms leg asymmetry; the median is well
+    # inside it.
+    assert estimator.offset() == pytest.approx(0.75, abs=0.002)
+    assert abs(estimator.drift_ppm()) < 40.0
+    assert estimator.sample_count == 16
+    assert estimator.last_delay > 0.0
+
+
+def test_estimator_tracks_drift():
+    estimator = ClockOffsetEstimator(window=32)
+    drift = 25e-6  # 25 ppm — a bad-but-real crystal
+    t_end = _synthetic_ping_stream(
+        estimator, base_offset=0.5, drift=drift, pings=30
+    )
+    assert estimator.drift_ppm() == pytest.approx(25.0, abs=10.0)
+    # Extrapolated offset at the end of the stream matches the true skew
+    # there (0.5 + 25e-6 * 290 s ~ 0.50725) within the noise bound.
+    t0 = 1_700_000_000.0
+    true_at_end = 0.5 + drift * (t_end - t0)
+    assert estimator.offset_at(t_end) == pytest.approx(true_at_end, abs=0.003)
+
+
+def test_estimator_window_slides():
+    estimator = ClockOffsetEstimator(window=4)
+    # Old epoch at +10 s, then the clock steps to +1 s: once the window
+    # has slid past the step, the estimate must follow the new epoch.
+    for i in range(4):
+        t1 = 100.0 + i
+        estimator.add_ping(t1, t1 + 10.0, t1 + 10.0, t1)
+    assert estimator.offset() == pytest.approx(10.0)
+    for i in range(4):
+        t1 = 200.0 + i
+        estimator.add_ping(t1, t1 + 1.0, t1 + 1.0, t1)
+    assert estimator.offset() == pytest.approx(1.0)
+
+
+def test_estimator_empty_and_validation():
+    estimator = ClockOffsetEstimator()
+    assert estimator.offset() == 0.0
+    assert estimator.drift_ppm() == 0.0
+    assert estimator.offset_at(123.0) == 0.0
+    assert estimator.last_delay == 0.0
+    with pytest.raises(ValueError):
+        ClockOffsetEstimator(window=0)
+
+
+# ---------------------------------------------------------------------------
+# Rebasing worker events onto the master clock
+
+
+def test_rebase_events_restores_causal_order(tmp_path):
+    """A worker whose clock runs 3 s behind records its queue_wait span
+    BEFORE (in raw timestamps) the master's assign span that caused it;
+    after rebasing by the estimated offset the causal order is restored."""
+    skew = -3.0  # worker clock - master clock
+
+    master = Tracer("master")
+    worker = Tracer("worker-1")
+    assign_at = 1000.0  # master clock
+    master.complete(
+        "assign frame", cat="master", start_wall=assign_at, duration=0.010,
+        track="worker-1", args={"frame": 1},
+    )
+    # The worker starts the frame 50 ms (true time) after the assignment,
+    # but stamps it on its own skewed clock.
+    worker.complete(
+        "queue_wait", cat="worker", start_wall=(assign_at + 0.050) + skew,
+        duration=0.005, track="frames", args={"frame": 1},
+    )
+
+    raw_worker_ts = worker.events()[0]["ts"]
+    raw_master_ts = master.events()[0]["ts"]
+    assert raw_worker_ts < raw_master_ts  # skew inverts raw order
+
+    rebased = rebase_events(worker.events(), skew)
+    assert rebased[0]["ts"] > raw_master_ts  # causal order restored
+    assert rebased[0]["ts"] == pytest.approx((assign_at + 0.050) * 1e6, abs=1)
+
+    # End to end through the exporter: the merged document carries the
+    # applied offsets and one fresh pid per process.
+    path = export_cluster_trace(
+        tmp_path / "cluster_trace-events.json",
+        [tracer_process(master, 0.0), tracer_process(worker, skew)],
+    )
+    document = json.loads(path.read_text())
+    assert document["otherData"]["clock_offsets_seconds"] == {
+        "master": 0.0, "worker-1": skew,
+    }
+    spans = [e for e in document["traceEvents"] if e["ph"] == "X"]
+    by_name = {e["name"]: e for e in spans}
+    assert by_name["queue_wait"]["ts"] > by_name["assign frame"]["ts"]
+    assert by_name["queue_wait"]["pid"] != by_name["assign frame"]["pid"]
+
+
+def test_export_cluster_trace_deduplicates_pids(tmp_path):
+    """Two workers from different processes can both think they are pid 1;
+    the merged file must keep them on separate Perfetto rows."""
+    a = Tracer("worker-a", pid=1)
+    b = Tracer("worker-b", pid=1)
+    a.complete("render", cat="worker", start_wall=1.0, duration=0.1, track="frames")
+    b.complete("render", cat="worker", start_wall=1.0, duration=0.1, track="frames")
+    path = export_cluster_trace(
+        tmp_path / "t_cluster_trace-events.json",
+        [
+            TimelineProcess("worker-a", a.metadata_events() + a.events()),
+            TimelineProcess("worker-b", b.metadata_events() + b.events()),
+        ],
+    )
+    document = json.loads(path.read_text())
+    pids_by_process = {
+        e["args"]["name"]: e["pid"]
+        for e in document["traceEvents"]
+        if e.get("name") == "process_name"
+    }
+    assert pids_by_process["worker-a"] != pids_by_process["worker-b"]
+    span_pids = {e["pid"] for e in document["traceEvents"] if e["ph"] == "X"}
+    assert span_pids == set(pids_by_process.values())
+
+
+def test_export_cluster_trace_surfaces_dropped_events(tmp_path):
+    """A capped contributor's truncation must reach the merged document —
+    same non-silent-truncation contract as Tracer.export."""
+    capped = Tracer("worker-capped", max_events=1)
+    capped.complete("a", start_wall=1.0, duration=0.1, track="frames")
+    capped.complete("b", start_wall=2.0, duration=0.1, track="frames")
+    assert capped.dropped == 1
+    path = export_cluster_trace(
+        tmp_path / "d_cluster_trace-events.json", [tracer_process(capped)]
+    )
+    document = json.loads(path.read_text())
+    assert document["otherData"]["dropped_events"] == {"worker-capped": 1}

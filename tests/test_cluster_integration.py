@@ -407,6 +407,23 @@ target_queue_size = 3
         next(results.glob("*_processed-results.json")).read_text()
     )
     assert processed["scheduler"]["auction_greedy_fallbacks"] == 0
+    # The TRUE multi-process path of the merged cluster timeline: the
+    # worker piggybacked its span events on job-finished over a real
+    # socket, the master rebased them by the heartbeat-estimated clock
+    # offset — the merged file must hold every trace invariant (incl.
+    # resolvable master->worker flow links).
+    from tpu_render_cluster.obs import validate_trace_file
+
+    cluster_trace = next(results.glob("*_cluster_trace-events.json"))
+    assert validate_trace_file(cluster_trace) == []
+    document = json.loads(cluster_trace.read_text())
+    process_names = {
+        e["args"]["name"]
+        for e in document["traceEvents"]
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    assert "master" in process_names
+    assert any(name.startswith("worker-") for name in process_names)
 
 
 def test_dead_worker_is_evicted_and_frames_requeue(monkeypatch):
@@ -462,12 +479,23 @@ def test_dead_worker_is_evicted_and_frames_requeue(monkeypatch):
             await client._connection.close()
         master_trace, worker_traces = await asyncio.wait_for(server_task, 60)
         await asyncio.gather(tasks[0])
-        return master_trace, worker_traces
+        return manager
 
-    asyncio.run(run())
+    manager = asyncio.run(run())
     rendered = sorted(
         set(survivor.rendered_frames) | set(casualty.rendered_frames)
     )
     assert rendered == list(range(1, frames + 1))
     # The casualty died mid-job, so the survivor must have picked up work.
     assert len(survivor.rendered_frames) > frames / 2
+    # Even with a worker lost mid-job, the master's span timeline holds
+    # every trace invariant: eviction terminated the dead worker's
+    # in-flight assignment flows, so no half-open flow arrows remain.
+    from tpu_render_cluster.obs import validate_trace_document
+
+    assert validate_trace_document(manager.span_tracer.to_chrome()) == []
+    evicted_spans = [
+        e for e in manager.span_tracer.events()
+        if e.get("name") == "frame evicted"
+    ]
+    assert evicted_spans, "eviction should close the dead worker's flows"

@@ -1,0 +1,166 @@
+"""Critical-path / straggler analysis unit tests (analysis/critical_path.py).
+
+A hand-built merged timeline with a known shape: worker-aa renders three
+fast frames back to back, worker-bb renders one slow frame that gates the
+makespan. The analysis must walk the correct gating chain, attribute idle
+time, and score bb as the straggler.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tpu_render_cluster.analysis.critical_path import (
+    compute_critical_path,
+    extract_lifecycles,
+    straggler_scores,
+    summarize_critical_path,
+    worker_utilization,
+)
+
+
+def _meta(pid: int, name: str) -> dict:
+    return {
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": name},
+    }
+
+
+def _span(pid, name, start_s, end_s, *, frame=None, flow=None, cat="", extra=None):
+    args = dict(extra or {})
+    if frame is not None:
+        args["frame"] = frame
+    if flow is not None:
+        args["flow"] = flow
+    return {
+        "name": name, "cat": cat, "ph": "X", "pid": pid, "tid": 1,
+        "ts": start_s * 1e6, "dur": (end_s - start_s) * 1e6,
+        "args": args,
+    }
+
+
+MASTER, AA, BB = 1, 2, 3
+
+
+def _phases(pid, frame, flow, queue_end, read_end, render_end, write_end, *, queued):
+    return [
+        _span(pid, "queue_wait", queued, queue_end, frame=frame, flow=flow, cat="worker"),
+        _span(pid, "read", queue_end, read_end, frame=frame, flow=flow, cat="worker"),
+        _span(pid, "render", read_end, render_end, frame=frame, flow=flow, cat="worker"),
+        _span(pid, "write", render_end, write_end, frame=frame, flow=flow, cat="worker"),
+    ]
+
+
+def _timeline() -> list[dict]:
+    events = [_meta(MASTER, "master"), _meta(AA, "worker-aa"), _meta(BB, "worker-bb")]
+    # Assignments (master side).
+    events += [
+        _span(MASTER, "assign frame", 0.00, 0.01, frame=1, flow="f1", cat="master"),
+        _span(MASTER, "assign frame", 0.00, 0.01, frame=4, flow="f4", cat="master"),
+        _span(MASTER, "assign frame", 0.02, 0.03, frame=2, flow="f2", cat="master"),
+        _span(MASTER, "assign frame", 0.04, 0.05, frame=3, flow="f3", cat="master"),
+    ]
+    # worker-aa: three fast frames, back to back (serial queue).
+    events += _phases(AA, 1, "f1", 0.02, 0.05, 0.55, 0.60, queued=0.01)
+    events += _phases(AA, 2, "f2", 0.60, 0.63, 1.13, 1.18, queued=0.03)
+    events += _phases(AA, 3, "f3", 1.18, 1.21, 1.71, 1.76, queued=0.05)
+    # worker-bb: one slow frame gating the makespan.
+    events += _phases(BB, 4, "f4", 0.02, 0.10, 2.60, 2.70, queued=0.01)
+    # Result-received spans (master side).
+    for frame, flow, at in ((1, "f1", 0.605), (2, "f2", 1.185), (3, "f3", 1.765), (4, "f4", 2.705)):
+        events.append(
+            _span(MASTER, "frame result", at, at + 0.001, frame=frame, flow=flow,
+                  cat="master", extra={"result": "ok"})
+        )
+    return events
+
+
+def test_extract_lifecycles_joins_by_flow():
+    lifecycles = {lc.flow: lc for lc in extract_lifecycles(_timeline())}
+    assert set(lifecycles) == {"f1", "f2", "f3", "f4"}
+    f4 = lifecycles["f4"]
+    assert f4.frame == 4
+    assert f4.worker == "worker-bb"
+    assert f4.assign == pytest.approx((0.00, 0.01))
+    assert f4.phases["render"] == pytest.approx((0.10, 2.60))
+    assert f4.result_at == pytest.approx(2.706)
+    assert f4.processing_start == pytest.approx(0.02)
+    assert f4.processing_end == pytest.approx(2.70)
+    assert f4.processing_seconds == pytest.approx(2.68)
+
+
+def test_critical_path_follows_the_gating_chain():
+    segments = compute_critical_path(extract_lifecycles(_timeline()))
+    # The slow bb frame gates the job: assign -> wait -> read -> render ->
+    # write -> result, all frame 4, in forward time order.
+    kinds = [s["kind"] for s in segments]
+    assert kinds == ["assign", "wait", "read", "render", "write", "result"]
+    assert all(s["frame"] == 4 for s in segments)
+    assert [s["start_s"] for s in segments] == sorted(s["start_s"] for s in segments)
+    render = next(s for s in segments if s["kind"] == "render")
+    assert render["worker"] == "worker-bb"
+    assert render["duration_s"] == pytest.approx(2.50)
+    # The path covers the makespan nearly end to end.
+    assert segments[0]["start_s"] == pytest.approx(0.0)
+    assert segments[-1]["end_s"] == pytest.approx(2.706)
+
+
+def test_critical_path_chains_through_serial_worker_queue():
+    # Without bb, the last finisher is aa's frame 3, whose processing was
+    # gated by frame 2, which was gated by frame 1, which waited on its
+    # assignment — the chain must thread all three frames.
+    lifecycles = [
+        lc for lc in extract_lifecycles(_timeline()) if lc.worker != "worker-bb"
+    ]
+    segments = compute_critical_path(lifecycles)
+    assert [s["frame"] for s in segments] == [1, 1, 1, 1, 1, 2, 2, 2, 3, 3, 3, 3]
+    assert [s["kind"] for s in segments] == [
+        "assign", "wait", "read", "render", "write",
+        "read", "render", "write",
+        "read", "render", "write", "result",
+    ]
+    assert [s["start_s"] for s in segments] == sorted(s["start_s"] for s in segments)
+
+
+def test_worker_utilization_and_idle_attribution():
+    window, utilization = worker_utilization(extract_lifecycles(_timeline()))
+    assert window[0] == pytest.approx(0.0)
+    assert window[1] == pytest.approx(2.706)
+    aa = utilization["worker-aa"]
+    bb = utilization["worker-bb"]
+    assert aa["frames"] == 3 and bb["frames"] == 1
+    assert aa["busy_s"] == pytest.approx(1.74, abs=1e-6)
+    assert bb["busy_s"] == pytest.approx(2.68, abs=1e-6)
+    assert aa["idle_s"] == pytest.approx(2.706 - 1.74, abs=1e-6)
+    assert bb["idle_fraction"] < aa["idle_fraction"]
+
+
+def test_straggler_scores_flag_the_slow_worker():
+    scores = straggler_scores(extract_lifecycles(_timeline()))
+    assert scores["worker-aa"]["straggler_score"] == pytest.approx(1.0)
+    assert scores["worker-bb"]["straggler_score"] > 4.0
+    assert scores["worker-bb"]["phase_p50_s"]["render"] == pytest.approx(2.50)
+    assert scores["worker-aa"]["phase_p50_s"]["render"] == pytest.approx(0.50)
+
+
+def test_summarize_critical_path_section_shape():
+    section = summarize_critical_path(_timeline())
+    assert section["frames"] == 4
+    assert section["assignments"] == 4
+    assert section["makespan_s"] == pytest.approx(2.706)
+    path = section["critical_path"]
+    assert path["total_s"] == pytest.approx(
+        sum(s["duration_s"] for s in path["segments"])
+    )
+    assert path["seconds_by_kind"]["render"] == pytest.approx(2.50)
+    assert path["seconds_by_worker"]["worker-bb"] > 2.0
+    assert section["stragglers"][0] == "worker-bb"
+    workers = section["workers"]
+    assert set(workers) == {"worker-aa", "worker-bb"}
+    assert workers["worker-bb"]["straggler_score"] > workers["worker-aa"]["straggler_score"]
+    assert "idle_s" in workers["worker-aa"]
+
+
+def test_summarize_critical_path_none_without_lifecycles():
+    events = [_meta(1, "master"), _span(1, "unrelated", 0.0, 1.0)]
+    assert summarize_critical_path(events) is None

@@ -204,6 +204,61 @@ def test_occupancy_series_flow_into_statistics(tmp_path):
     assert 0.0 < wavefront["lane_occupancy_last"] <= 1.0
 
 
+def test_wavefront_spans_render_on_dedicated_stable_track(tmp_path):
+    """wavefront_bounce spans get their own named Perfetto track with a
+    STABLE tid — not the OS-thread tid of whoever happened to drive the
+    bounce loop, which interleaved them with unrelated render-phase spans
+    and renumbered across runs. The exported artifact must also pass the
+    trace-invariant checker."""
+    import json
+
+    from tpu_render_cluster.obs import get_tracer, validate_trace_file
+    from tpu_render_cluster.render.compaction import trace_paths_wavefront
+    from tpu_render_cluster.render.scene import build_scene
+
+    tracer = get_tracer()
+    tracer.clear()
+    scene = build_scene("04_very-simple", 1)
+    origins, directions = _frame_of_rays(1024, 3)
+    trace_paths_wavefront(scene, origins, directions, 5, max_bounces=2)
+
+    path = tracer.export(tmp_path / "wf1_trace-events.json")
+    assert validate_trace_file(path) == []
+
+    def wavefront_tid(trace_path):
+        events = json.loads(trace_path.read_text())["traceEvents"]
+        track_tids = {
+            e["args"]["name"]: e["tid"]
+            for e in events
+            if e.get("ph") == "M" and e.get("name") == "thread_name"
+        }
+        assert "wavefront" in track_tids, "wavefront track not named"
+        tid = track_tids["wavefront"]
+        bounce_spans = [e for e in events if e.get("name") == "wavefront_bounce"]
+        assert bounce_spans, "no wavefront_bounce spans recorded"
+        assert all(e["tid"] == tid for e in bounce_spans)
+        # Dedicated: nothing else renders on the wavefront lane.
+        intruders = [
+            e for e in events
+            if e.get("ph") == "X" and e["tid"] == tid
+            and e["name"] != "wavefront_bounce"
+        ]
+        assert not intruders, intruders
+        return tid
+
+    first_tid = wavefront_tid(path)
+
+    # Stability: a later frame in the same process exports with the SAME
+    # tid (track assignments survive clear(), so multi-job artifacts from
+    # one process line up in the viewer).
+    tracer.clear()
+    trace_paths_wavefront(scene, origins, directions, 6, max_bounces=2)
+    second = tracer.export(tmp_path / "wf2_trace-events.json")
+    assert validate_trace_file(second) == []
+    assert wavefront_tid(second) == first_tid
+    tracer.clear()
+
+
 @pytest.mark.slow
 def test_wavefront_onchip_sweep():
     """On-chip throughput: wavefront must beat the masked per-bounce path

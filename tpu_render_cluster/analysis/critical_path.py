@@ -1,0 +1,368 @@
+"""Critical-path and straggler analysis over the merged cluster timeline.
+
+The merged ``*_cluster_trace-events.json`` (obs/timeline.py) puts every
+frame's full lifecycle on ONE clock: the master's ``assign frame`` /
+``frame result`` spans and each worker's ``queue_wait``/``read``/
+``render``/``write`` phase spans, joined by the assignment's flow id.
+That is enough to answer the questions per-process artifacts cannot:
+
+- **Critical path**: which chain of spans actually gated the job's
+  makespan? Worker queues are serial, so a frame's processing starts at
+  ``max(assignment done, previous frame's processing end)``; walking back
+  from the last-finishing frame along whichever of those two gated it
+  yields the makespan-covering chain, attributed per phase and worker.
+- **Idle attribution**: per worker, wall time inside the job window not
+  covered by any frame's processing (read/render/write) — the capacity
+  the scheduler failed to use.
+- **Straggler scores**: each worker's median per-frame processing time
+  against the cluster median (score > 1 means slower than the cluster),
+  with per-phase percentiles to show WHERE the straggler loses time.
+
+``summarize_critical_path`` is the ``statistics.json``-shaped roll-up
+``analysis/obs_events.summarize_obs`` folds in.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Iterable
+
+# Shared nearest-rank percentile (obs_events only imports THIS module
+# lazily, so the top-level import is cycle-free).
+from tpu_render_cluster.analysis.obs_events import _percentile
+
+__all__ = [
+    "FrameLifecycle",
+    "extract_lifecycles",
+    "compute_critical_path",
+    "worker_utilization",
+    "straggler_scores",
+    "summarize_critical_path",
+]
+
+PHASES = ("queue_wait", "read", "render", "write")
+PROCESSING_PHASES = ("read", "render", "write")
+
+# Two spans "touch" (one gated the other) when the gap between them is
+# below this: covers event-loop scheduling jitter between a frame's write
+# end and the next frame's read start on a serial worker queue.
+CHAIN_GAP_SECONDS = 0.050
+
+
+@dataclass
+class FrameLifecycle:
+    """One frame ASSIGNMENT's reconstructed spans (seconds, master clock)."""
+
+    frame: int
+    flow: str | None
+    worker: str | None = None
+    assign: tuple[float, float] | None = None
+    phases: dict[str, tuple[float, float]] = field(default_factory=dict)
+    result_at: float | None = None
+    result: str | None = None
+
+    @property
+    def processing_start(self) -> float | None:
+        starts = [self.phases[p][0] for p in PROCESSING_PHASES if p in self.phases]
+        return min(starts) if starts else None
+
+    @property
+    def processing_end(self) -> float | None:
+        ends = [self.phases[p][1] for p in PROCESSING_PHASES if p in self.phases]
+        return max(ends) if ends else None
+
+    @property
+    def processing_seconds(self) -> float | None:
+        return sum(
+            (self.phases[p][1] - self.phases[p][0]
+             for p in PROCESSING_PHASES if p in self.phases),
+            0.0,
+        ) if self.processing_start is not None else None
+
+    @property
+    def end(self) -> float | None:
+        candidates = [self.result_at, self.processing_end]
+        candidates = [c for c in candidates if c is not None]
+        return max(candidates) if candidates else None
+
+
+def _process_names(events: Iterable[dict[str, Any]]) -> dict[Any, str]:
+    names: dict[Any, str] = {}
+    for event in events:
+        if event.get("ph") == "M" and event.get("name") == "process_name":
+            names[event.get("pid")] = str((event.get("args") or {}).get("name"))
+    return names
+
+
+def extract_lifecycles(events: list[dict[str, Any]]) -> list[FrameLifecycle]:
+    """Group the timeline's frame spans into per-assignment lifecycles.
+
+    Spans join on the assignment's flow id when present (exact across
+    re-queues and steals); spans without one — a worker predating trace
+    context — fall back to joining on the frame index alone.
+    """
+    names = _process_names(events)
+    lifecycles: dict[Any, FrameLifecycle] = {}
+
+    def lifecycle_for(event: dict[str, Any]) -> FrameLifecycle | None:
+        args = event.get("args") or {}
+        frame = args.get("frame")
+        if frame is None:
+            return None
+        flow = args.get("flow")
+        key = flow if flow is not None else ("frame", frame)
+        lc = lifecycles.get(key)
+        if lc is None:
+            lc = lifecycles[key] = FrameLifecycle(frame=int(frame), flow=flow)
+        return lc
+
+    for event in events:
+        if event.get("ph") != "X":
+            continue
+        name = event.get("name")
+        start = float(event.get("ts", 0.0)) / 1e6
+        end = start + float(event.get("dur", 0.0)) / 1e6
+        if name == "assign frame":
+            lc = lifecycle_for(event)
+            if lc is not None:
+                lc.assign = (start, end)
+        elif name in ("frame result", "frame stolen"):
+            lc = lifecycle_for(event)
+            if lc is not None:
+                lc.result_at = end
+                lc.result = (event.get("args") or {}).get("result")
+        elif name in PHASES:
+            lc = lifecycle_for(event)
+            if lc is not None:
+                lc.phases[name] = (start, end)
+                worker = names.get(event.get("pid"))
+                if worker is not None:
+                    lc.worker = worker
+    return list(lifecycles.values())
+
+
+def compute_critical_path(
+    lifecycles: list[FrameLifecycle],
+) -> list[dict[str, Any]]:
+    """Walk the makespan-gating chain back from the last-finishing frame.
+
+    Returns segments in forward time order; each is
+    ``{kind, frame, worker, start_s, end_s, duration_s}`` where ``kind``
+    is a phase name, ``assign`` (the master-side RPC), or ``wait``
+    (a gap on the path nobody's span covers — master think time).
+    """
+    candidates = [lc for lc in lifecycles if lc.end is not None]
+    if not candidates:
+        return []
+    by_worker: dict[Any, list[FrameLifecycle]] = {}
+    for lc in candidates:
+        if lc.processing_end is not None:
+            by_worker.setdefault(lc.worker, []).append(lc)
+    for chains in by_worker.values():
+        chains.sort(key=lambda lc: lc.processing_end)
+
+    segments: list[dict[str, Any]] = []
+
+    def add(kind: str, lc: FrameLifecycle | None, start: float, end: float) -> None:
+        if end <= start:
+            return
+        segments.append(
+            {
+                "kind": kind,
+                "frame": lc.frame if lc is not None else None,
+                "worker": lc.worker if lc is not None else None,
+                "start_s": start,
+                "end_s": end,
+                "duration_s": end - start,
+            }
+        )
+
+    current: FrameLifecycle | None = max(candidates, key=lambda lc: lc.end)
+    seen: set[int] = set()
+    terminal = True
+    while current is not None and id(current) not in seen:
+        seen.add(id(current))
+        # Only the LAST-finishing frame's result-received hop is on the
+        # path; intermediate chained frames were gating through their
+        # worker's serial queue, not through the master's receipt.
+        if (
+            terminal
+            and current.result_at is not None
+            and current.processing_end is not None
+        ):
+            add("result", current, current.processing_end, current.result_at)
+        terminal = False
+        for phase in reversed(PROCESSING_PHASES):
+            if phase in current.phases:
+                start, end = current.phases[phase]
+                add(phase, current, start, end)
+        proc_start = current.processing_start
+        if proc_start is None:
+            break
+        # What gated this frame's processing start: the previous frame on
+        # the same serial worker queue, or the master's assignment?
+        previous = None
+        for lc in by_worker.get(current.worker, ()):
+            if lc is current:
+                continue
+            if lc.processing_end <= proc_start + CHAIN_GAP_SECONDS and (
+                previous is None or lc.processing_end > previous.processing_end
+            ):
+                previous = lc
+        if (
+            previous is not None
+            and proc_start - previous.processing_end <= CHAIN_GAP_SECONDS
+        ):
+            current = previous
+            continue
+        # Master-gated: the frame sat queued (or the worker sat empty)
+        # until the assignment landed.
+        if current.assign is not None:
+            assign_start, assign_end = current.assign
+            add("wait", current, assign_end, proc_start)
+            add("assign", current, assign_start, assign_end)
+        break
+    segments.reverse()
+    return segments
+
+
+def worker_utilization(
+    lifecycles: list[FrameLifecycle],
+) -> tuple[tuple[float, float] | None, dict[str, dict[str, float]]]:
+    """Job window + per-worker busy/idle split inside it.
+
+    Busy is the union of each frame's processing interval (read through
+    write) on that worker; idle is the window remainder — time the worker
+    existed but rendered nothing (queue starvation, barrier waits, tail).
+    """
+    starts = [lc.assign[0] for lc in lifecycles if lc.assign is not None]
+    starts += [s for lc in lifecycles if (s := lc.processing_start) is not None]
+    ends = [e for lc in lifecycles if (e := lc.end) is not None]
+    if not starts or not ends:
+        return None, {}
+    window = (min(starts), max(ends))
+    window_seconds = window[1] - window[0]
+    out: dict[str, dict[str, float]] = {}
+    intervals_by_worker: dict[str, list[tuple[float, float]]] = {}
+    for lc in lifecycles:
+        if lc.worker is None or lc.processing_start is None:
+            continue
+        intervals_by_worker.setdefault(lc.worker, []).append(
+            (lc.processing_start, lc.processing_end)
+        )
+    for worker, intervals in intervals_by_worker.items():
+        intervals.sort()
+        busy = 0.0
+        cursor = window[0]
+        for start, end in intervals:
+            start = max(start, cursor)
+            if end > start:
+                busy += end - start
+                cursor = end
+        out[worker] = {
+            "frames": float(len(intervals)),
+            "busy_s": busy,
+            "idle_s": max(0.0, window_seconds - busy),
+            "idle_fraction": (
+                max(0.0, window_seconds - busy) / window_seconds
+                if window_seconds > 0
+                else 0.0
+            ),
+        }
+    return window, out
+
+
+def straggler_scores(
+    lifecycles: list[FrameLifecycle],
+) -> dict[str, dict[str, Any]]:
+    """Per-worker phase percentiles vs the cluster distribution.
+
+    ``score`` is the worker's median per-frame processing time over the
+    cluster median: 1.0 is a typical worker, 2.0 renders frames twice as
+    slowly as the cluster's midpoint. Phase percentiles localize the loss
+    (slow read = I/O, slow render = compute, slow write = storage).
+    """
+    per_worker_processing: dict[str, list[float]] = {}
+    per_worker_phase: dict[str, dict[str, list[float]]] = {}
+    cluster_processing: list[float] = []
+    for lc in lifecycles:
+        if lc.worker is None:
+            continue
+        seconds = lc.processing_seconds
+        if seconds is None:
+            continue
+        per_worker_processing.setdefault(lc.worker, []).append(seconds)
+        cluster_processing.append(seconds)
+        phases = per_worker_phase.setdefault(lc.worker, {})
+        for phase in PHASES:
+            if phase in lc.phases:
+                start, end = lc.phases[phase]
+                phases.setdefault(phase, []).append(end - start)
+    cluster_processing.sort()
+    cluster_p50 = _percentile(cluster_processing, 0.50)
+    out: dict[str, dict[str, Any]] = {}
+    for worker, values in per_worker_processing.items():
+        values.sort()
+        p50 = _percentile(values, 0.50)
+        phase_p50 = {}
+        phase_p95 = {}
+        for phase, durations in per_worker_phase[worker].items():
+            durations.sort()
+            phase_p50[phase] = _percentile(durations, 0.50)
+            phase_p95[phase] = _percentile(durations, 0.95)
+        out[worker] = {
+            "frames": len(values),
+            "processing_p50_s": p50,
+            "processing_p95_s": _percentile(values, 0.95),
+            "straggler_score": (p50 / cluster_p50) if cluster_p50 > 0 else 1.0,
+            "phase_p50_s": phase_p50,
+            "phase_p95_s": phase_p95,
+        }
+    return out
+
+
+def summarize_critical_path(events: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """The ``statistics.json`` roll-up for one merged cluster timeline.
+
+    None when the timeline carries no frame lifecycles (an uninstrumented
+    or non-cluster trace file).
+    """
+    lifecycles = extract_lifecycles(events)
+    if not any(lc.phases or lc.assign for lc in lifecycles):
+        return None
+    window, utilization = worker_utilization(lifecycles)
+    segments = compute_critical_path(lifecycles)
+    scores = straggler_scores(lifecycles)
+    workers: dict[str, dict[str, Any]] = {}
+    for worker, entry in scores.items():
+        workers[worker] = dict(entry)
+    for worker, entry in utilization.items():
+        workers.setdefault(worker, {}).update(
+            {k: v for k, v in entry.items() if k != "frames"}
+        )
+    by_kind: dict[str, float] = {}
+    by_worker: dict[str, float] = {}
+    for segment in segments:
+        by_kind[segment["kind"]] = (
+            by_kind.get(segment["kind"], 0.0) + segment["duration_s"]
+        )
+        if segment["worker"] is not None:
+            by_worker[segment["worker"]] = (
+                by_worker.get(segment["worker"], 0.0) + segment["duration_s"]
+            )
+    out: dict[str, Any] = {
+        "frames": len([lc for lc in lifecycles if lc.phases]),
+        "assignments": len(lifecycles),
+        "makespan_s": (window[1] - window[0]) if window is not None else 0.0,
+        "critical_path": {
+            "segments": segments,
+            "total_s": sum(s["duration_s"] for s in segments),
+            "seconds_by_kind": by_kind,
+            "seconds_by_worker": by_worker,
+        },
+        "workers": workers,
+        "stragglers": sorted(
+            scores, key=lambda w: scores[w]["straggler_score"], reverse=True
+        ),
+    }
+    return out

@@ -1,15 +1,20 @@
 """Loaders for the obs subsystem's artifacts (reference has no analog).
 
-Two new file families land next to the legacy ``*_raw-trace.json``:
+Three file families land next to the legacy ``*_raw-trace.json``:
 
 - ``*_trace-events.json`` — Chrome trace-event JSON (Perfetto-loadable)
-  with master / worker / transport spans;
+  with master / worker / transport spans, one file per process clock;
+- ``*_cluster_trace-events.json`` — the MERGED cluster timeline: every
+  process's spans rebased onto the master clock by the heartbeat
+  clock-offset estimates, with flow arrows per frame lifecycle
+  (obs/timeline.py);
 - ``*_metrics.json`` — metrics registry snapshots (+ the cluster view and
   per-worker heartbeat payload aggregation).
 
-This module validates and loads both so ``run_all`` can fold live-signal
-summaries (per-phase span statistics, span counts by category) into
-``statistics.json`` alongside the legacy post-hoc metrics.
+This module validates and loads all of them so ``run_all`` can fold
+live-signal summaries (per-phase span statistics, span counts by
+category, and the cluster timelines' critical-path/straggler analysis)
+into ``statistics.json`` alongside the legacy post-hoc metrics.
 """
 
 from __future__ import annotations
@@ -21,10 +26,25 @@ from typing import Any, Callable
 
 TRACE_EVENTS_GLOB = "*_trace-events.json"
 METRICS_SNAPSHOT_GLOB = "*_metrics.json"
+# Merged, clock-corrected cluster timelines (obs/timeline.py). They match
+# TRACE_EVENTS_GLOB too, so the per-process finder excludes them — their
+# events are the per-process files' events re-based, and counting both
+# would double every span in the roll-up. The leading underscore is part
+# of the discriminator: exporters write "<prefix>_cluster_trace-events.json",
+# and a run PREFIX that merely ends in "cluster" must not be misclassified.
+CLUSTER_TRACE_SUFFIX = "_cluster_trace-events.json"
 
 
 def find_trace_event_files(results_directory: str | Path) -> list[Path]:
-    return sorted(Path(results_directory).rglob(TRACE_EVENTS_GLOB))
+    return sorted(
+        path
+        for path in Path(results_directory).rglob(TRACE_EVENTS_GLOB)
+        if not path.name.endswith(CLUSTER_TRACE_SUFFIX)
+    )
+
+
+def find_cluster_trace_files(results_directory: str | Path) -> list[Path]:
+    return sorted(Path(results_directory).rglob(f"*{CLUSTER_TRACE_SUFFIX}"))
 
 
 def find_metrics_files(results_directory: str | Path) -> list[Path]:
@@ -119,6 +139,23 @@ def load_obs_artifacts(
                     raise
                 on_error(path, e)
     return traces, metrics
+
+
+def load_cluster_traces(
+    results_directory: str | Path,
+    *,
+    on_error: "Callable[[Path, Exception], None] | None" = None,
+) -> list[ObsTrace]:
+    """Load every merged cluster timeline under a results directory."""
+    traces: list[ObsTrace] = []
+    for path in find_cluster_trace_files(results_directory):
+        try:
+            traces.append(load_trace_events(path))
+        except (ValueError, OSError, json.JSONDecodeError) as e:
+            if on_error is None:
+                raise
+            on_error(path, e)
+    return traces
 
 
 def _percentile(sorted_values: list[float], q: float) -> float:
@@ -242,9 +279,18 @@ def summarize_wavefront(metrics: list[dict[str, Any]]) -> dict[str, Any] | None:
 
 
 def summarize_obs(
-    traces: list[ObsTrace], metrics: list[dict[str, Any]]
+    traces: list[ObsTrace],
+    metrics: list[dict[str, Any]],
+    cluster_traces: list[ObsTrace] | None = None,
 ) -> dict[str, Any]:
-    """Roll obs artifacts into a ``statistics.json``-shaped summary."""
+    """Roll obs artifacts into a ``statistics.json``-shaped summary.
+
+    ``cluster_traces`` (the merged clock-corrected timelines from
+    ``load_cluster_traces``) additionally contribute a ``critical_path``
+    section — per-run makespan critical path, per-worker idle attribution,
+    and straggler scores (``analysis/critical_path.py``) — keyed by the
+    run's file stem.
+    """
     span_counts: dict[str, int] = {}
     durations: dict[str, list[float]] = {}
     for trace in traces:
@@ -271,4 +317,16 @@ def summarize_obs(
     wavefront = summarize_wavefront(metrics)
     if wavefront is not None:
         out["wavefront"] = wavefront
+    if cluster_traces:
+        from tpu_render_cluster.analysis.critical_path import (
+            summarize_critical_path,
+        )
+
+        sections = {}
+        for trace in cluster_traces:
+            section = summarize_critical_path(trace.events)
+            if section is not None:
+                sections[trace.path.stem] = section
+        if sections:
+            out["critical_path"] = sections
     return out

@@ -17,7 +17,11 @@ import sys
 from pathlib import Path
 
 from tpu_render_cluster.analysis import metrics as M
-from tpu_render_cluster.analysis.obs_events import load_obs_artifacts, summarize_obs
+from tpu_render_cluster.analysis.obs_events import (
+    load_cluster_traces,
+    load_obs_artifacts,
+    summarize_obs,
+)
 from tpu_render_cluster.analysis.parser import load_traces
 from tpu_render_cluster.analysis.paths import DEFAULT_ANALYSIS_DIR, DEFAULT_RESULTS_DIR
 from tpu_render_cluster.analysis.timed_context import timed_section
@@ -49,16 +53,18 @@ def main(argv: list[str] | None = None) -> int:
     # the legacy raw traces when the run was instrumented; absent files
     # just mean an uninstrumented (or reference-produced) population.
     with timed_section("load obs artifacts"):
-        obs_traces, obs_metrics = load_obs_artifacts(
-            args.results,
-            on_error=lambda path, e: print(
-                f"Skipping malformed obs artifact {path}: {e}", file=sys.stderr
-            ),
+        on_obs_error = lambda path, e: print(  # noqa: E731
+            f"Skipping malformed obs artifact {path}: {e}", file=sys.stderr
         )
-    if obs_traces or obs_metrics:
+        obs_traces, obs_metrics = load_obs_artifacts(
+            args.results, on_error=on_obs_error
+        )
+        cluster_traces = load_cluster_traces(args.results, on_error=on_obs_error)
+    if obs_traces or obs_metrics or cluster_traces:
         print(
             f"Loaded {len(obs_traces)} trace-event file(s), "
-            f"{len(obs_metrics)} metrics snapshot(s)."
+            f"{len(obs_metrics)} metrics snapshot(s), "
+            f"{len(cluster_traces)} merged cluster timeline(s)."
         )
 
     out = Path(args.out)
@@ -73,8 +79,8 @@ def main(argv: list[str] | None = None) -> int:
         "phase_split": {str(k): v for k, v in M.phase_split_stats(traces).items()},
         "run_statistics": {str(k): v for k, v in M.run_statistics(traces).items()},
     }
-    if obs_traces or obs_metrics:
-        stats["obs"] = summarize_obs(obs_traces, obs_metrics)
+    if obs_traces or obs_metrics or cluster_traces:
+        stats["obs"] = summarize_obs(obs_traces, obs_metrics, cluster_traces)
     stats_path = out / "statistics.json"
     stats_path.write_text(json.dumps(stats, indent=2))
     print(f"Statistics written to {stats_path}")

@@ -25,6 +25,7 @@ from tpu_render_cluster.master.persist import (
 from tpu_render_cluster.obs import (
     MetricsRegistry,
     export_chrome_trace,
+    export_cluster_trace,
     merge_wire,
     write_metrics_snapshot,
 )
@@ -83,8 +84,9 @@ def run_local_job(
 
 def save_obs_artifacts(
     prefix_path: Path, manager: ClusterManager, workers: list[Worker]
-) -> tuple[Path, Path]:
-    """Write ``<prefix>_trace-events.json`` + ``<prefix>_metrics.json``.
+) -> tuple[Path, Path, Path]:
+    """Write ``<prefix>_trace-events.json`` + ``<prefix>_metrics.json``
+    + ``<prefix>_cluster_trace-events.json``.
 
     The trace-event file merges the master's span tracer with every
     worker's (one Perfetto process row each) and loads directly in
@@ -92,7 +94,11 @@ def save_obs_artifacts(
     the master registry snapshot, the live cluster view, each worker's
     full registry snapshot, and their ``merge_wire`` aggregation —
     exactly what a multi-host master assembles from heartbeat payloads,
-    but collected in-process after the run.
+    but collected in-process after the run. The cluster trace is the
+    CAUSAL timeline: the span events each worker piggybacked on its
+    job-finished response, rebased onto the master clock by the heartbeat
+    clock-offset estimates, pids deduplicated, with flow arrows linking
+    every frame's assign span to its worker phases and result span.
     """
     from tpu_render_cluster.obs import get_registry, get_tracer
 
@@ -107,6 +113,14 @@ def save_obs_artifacts(
         [manager.span_tracer] + [w.span_tracer for w in workers] + [get_tracer()],
     )
     get_tracer().clear()
+    # The merged causal timeline goes through the same collection path a
+    # multi-host master uses (span events shipped on job-finished, offsets
+    # from the heartbeat estimator) — in-process the offsets are near zero,
+    # but the machinery is identical.
+    cluster_trace_path = export_cluster_trace(
+        prefix_path.with_name(prefix_path.name + "_cluster_trace-events.json"),
+        manager.cluster_timeline_processes(),
+    )
     worker_snapshots = {
         worker_id_to_string(w.worker_id): w.metrics.snapshot() for w in workers
     }
@@ -134,7 +148,7 @@ def save_obs_artifacts(
             },
         },
     )
-    return trace_path, metrics_path
+    return trace_path, metrics_path, cluster_trace_path
 
 
 def run_and_persist(
@@ -147,8 +161,10 @@ def run_and_persist(
     """Run and write ``*_raw-trace.json`` + processed results; returns the raw path.
 
     Also emits the obs artifacts next to them: ``*_trace-events.json``
-    (Chrome trace-event spans for master, workers, and transport) and
-    ``*_metrics.json`` (metrics snapshot incl. frame-phase histograms).
+    (Chrome trace-event spans for master, workers, and transport),
+    ``*_metrics.json`` (metrics snapshot incl. frame-phase histograms),
+    and ``*_cluster_trace-events.json`` (the merged clock-corrected causal
+    timeline with per-frame flow arrows).
     """
     from tpu_render_cluster.ops import assignment as assignment_ops
 

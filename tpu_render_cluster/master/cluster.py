@@ -31,9 +31,11 @@ from tpu_render_cluster.master.worker_handle import WorkerHandle
 from tpu_render_cluster.obs import (
     MetricsRegistry,
     SnapshotWriter,
+    TimelineProcess,
     Tracer,
     get_registry,
     merge_wire,
+    tracer_process,
 )
 from tpu_render_cluster.protocol import messages as pm
 from tpu_render_cluster.traces.master_trace import MasterTrace
@@ -167,6 +169,53 @@ class ClusterManager:
             except Exception as e:  # noqa: BLE001
                 logger.warning("Worker metrics payloads failed to merge: %s", e)
         return view
+
+    def cluster_timeline_processes(self) -> list[TimelineProcess]:
+        """Everything the merged cluster timeline needs, master row first.
+
+        One entry per process: the master's own span tracer (offset 0 by
+        definition) plus, for every worker that piggybacked its span
+        events on the job-finished response, those events tagged with the
+        heartbeat estimator's offset for rebasing at export time. Workers that sent nothing (C++
+        daemons, version skew) are simply absent — their causal links
+        still show as master-side assign/result spans.
+        """
+        processes = [tracer_process(self.span_tracer, 0.0)]
+        for worker in self.workers.values():
+            collected = worker.collected_span_events
+            if not collected or not isinstance(collected.get("events"), list):
+                continue
+            # The payload crossed the wire from a worker we don't control
+            # and decode only shape-checks the top level: drop non-object
+            # entries so a version-skewed peer degrades its own row instead
+            # of killing the master's end-of-job artifact export.
+            events = [e for e in collected["events"] if isinstance(e, dict)]
+            if len(events) != len(collected["events"]):
+                logger.warning(
+                    "Worker %08x sent %d malformed span event(s); skipped.",
+                    worker.worker_id,
+                    len(collected["events"]) - len(events),
+                )
+            name = str(
+                collected.get("process_name")
+                or f"worker-{pm.worker_id_to_string(worker.worker_id)}"
+            )
+            try:
+                dropped = int(collected.get("dropped") or 0)
+            except (TypeError, ValueError):
+                dropped = 0
+            processes.append(
+                TimelineProcess(
+                    name=name,
+                    events=events,
+                    # Extrapolate the offset to NOW along the drift fit
+                    # (collection time ~ the span timestamps' tail); with
+                    # fewer than two samples this is the plain median.
+                    offset_seconds=worker.clock_offset.offset_at(time.time()),
+                    dropped=dropped,
+                )
+            )
+        return processes
 
     # -- accept loop --------------------------------------------------------
 

@@ -23,7 +23,7 @@ from tpu_render_cluster.master.persist import (
     save_processed_results,
     save_raw_traces,
 )
-from tpu_render_cluster.obs import write_metrics_snapshot
+from tpu_render_cluster.obs import export_cluster_trace, write_metrics_snapshot
 from tpu_render_cluster.utils.logging import initialize_console_and_file_logging
 
 
@@ -108,6 +108,14 @@ async def run_job_command(args: argparse.Namespace) -> int:
     # kept during the run is replaced by this final write.
     prefix = raw_path.name.replace("_raw-trace.json", "")
     manager.span_tracer.export(results_directory / f"{prefix}_trace-events.json")
+    # Merged cluster timeline: the workers' span events (piggybacked on
+    # their job-finished responses) rebased onto the master clock by the
+    # heartbeat clock-offset estimates — one Perfetto file with a process
+    # row per worker and flow arrows for every frame's lifecycle.
+    export_cluster_trace(
+        results_directory / f"{prefix}_cluster_trace-events.json",
+        manager.cluster_timeline_processes(),
+    )
     write_metrics_snapshot(
         results_directory / f"{prefix}_metrics.json",
         manager.metrics,

@@ -10,6 +10,10 @@ mutation happens on one event loop.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from tpu_render_cluster.protocol.messages import TraceContext
 
 
 @dataclass
@@ -18,6 +22,10 @@ class FrameOnWorker:
     queued_at: float
     is_rendering: bool = False
     stolen_from: int | None = None
+    # Trace context of this assignment, kept so the master can close the
+    # frame's Perfetto flow even when the terminating event (a
+    # reference-shaped C++ worker's, a steal, an eviction) doesn't echo it.
+    trace: "TraceContext | None" = None
 
 
 class WorkerQueueMirror:

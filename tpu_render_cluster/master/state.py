@@ -16,6 +16,7 @@ from collections import deque
 from dataclasses import dataclass, field
 
 from tpu_render_cluster.jobs.models import BlenderJob
+from tpu_render_cluster.protocol.messages import generate_trace_id
 
 
 class FrameStatus(enum.Enum):
@@ -43,6 +44,10 @@ class ClusterManagerState:
 
     def __init__(self, job: BlenderJob) -> None:
         self.job = job
+        # One trace id per job run: every assignment span and worker echo
+        # carries it, so artifacts from different runs never alias
+        # (protocol/messages.py TraceContext rides on this).
+        self.trace_id: int = generate_trace_id()
         self.frames: dict[int, FrameRecord] = {
             index: FrameRecord(index) for index in job.frame_indices()
         }

@@ -25,7 +25,7 @@ from typing import Awaitable, Callable
 from tpu_render_cluster.jobs.models import BlenderJob
 from tpu_render_cluster.master.queue_mirror import FrameOnWorker, WorkerQueueMirror
 from tpu_render_cluster.master.state import ClusterManagerState
-from tpu_render_cluster.obs import MetricsRegistry, Tracer
+from tpu_render_cluster.obs import ClockOffsetEstimator, MetricsRegistry, Tracer
 from tpu_render_cluster.protocol import messages as pm
 from tpu_render_cluster.transport.actors import MessageRouter, SenderHandle, request_response
 from tpu_render_cluster.transport.reconnect import ReconnectableServerConnection
@@ -60,6 +60,13 @@ class WorkerHandle:
         # Most recent compact metrics payload this worker piggybacked on a
         # heartbeat pong (None until the first instrumented pong arrives).
         self.latest_worker_metrics: dict | None = None
+        # NTP-style clock-offset estimate (worker clock - master clock),
+        # fed by the heartbeat's four timestamps; the merged cluster
+        # timeline rebases this worker's span events by it.
+        self.clock_offset = ClockOffsetEstimator()
+        # Chrome trace events the worker piggybacked on its job-finished
+        # response ({"process_name", "events"}), for the cluster timeline.
+        self.collected_span_events: dict | None = None
         # Observed per-frame render durations (for scheduler cost models).
         self._rendering_started_at: dict[int, float] = {}
         self._completion_observations: list[tuple[int, float]] = []
@@ -116,6 +123,20 @@ class WorkerHandle:
             return
         self.is_dead = True
         self.logger.warning("Worker marked dead: %s", reason)
+        # Terminate the Perfetto flows of every assignment still mirrored
+        # here: the requeued frames open fresh chains elsewhere, and a
+        # dangling flow-start would fail the trace validator on artifacts
+        # from any run that lost a worker.
+        now = time.time()
+        for frame in self.queue.all_frames():
+            self._complete_frame_flow(
+                "frame evicted",
+                frame.frame_index,
+                frame.trace,
+                start_wall=now,
+                duration=0.0,
+                extra_args={"reason": reason},
+            )
         if self.metrics is not None:
             self.metrics.counter(
                 "master_worker_evictions_total", "Workers marked dead and evicted"
@@ -144,6 +165,43 @@ class WorkerHandle:
                 labels=("worker",),
             ).set(len(self.queue), worker=self._worker_label())
 
+    def _complete_frame_flow(
+        self,
+        name: str,
+        frame_index: int,
+        trace: pm.TraceContext | None,
+        *,
+        start_wall: float,
+        duration: float,
+        extra_args: dict | None = None,
+    ) -> None:
+        """Master-side terminal span for one assignment chain (result
+        received / frame stolen), with the flow arrowhead bound inside it
+        when the assignment's trace context is known."""
+        if self.span_tracer is None:
+            return
+        args = {"frame": frame_index, **(extra_args or {})}
+        track = f"worker-{self._worker_label()}"
+        if trace is not None:
+            args["flow"] = trace.flow_id
+        self.span_tracer.complete(
+            name,
+            cat="master",
+            start_wall=start_wall,
+            duration=duration,
+            track=track,
+            args=args,
+        )
+        if trace is not None:
+            self.span_tracer.flow_end(
+                "frame",
+                id=trace.flow_id,
+                ts=start_wall + duration / 2.0,
+                cat="frame",
+                track=track,
+                args={"frame": frame_index},
+            )
+
     # -- scheduling RPCs ----------------------------------------------------
 
     async def queue_frame(
@@ -157,7 +215,10 @@ class WorkerHandle:
 
         Reference: master/src/connection/mod.rs:139-168.
         """
-        request = pm.MasterFrameQueueAddRequest.new(job, frame_index)
+        # Fresh span per ASSIGNMENT (not per frame): a re-queued or stolen
+        # frame starts a new causal chain with its own Perfetto flow.
+        trace = pm.TraceContext.new(self.state.trace_id)
+        request = pm.MasterFrameQueueAddRequest.new(job, frame_index, trace=trace)
         rpc_started = time.perf_counter()
         rpc_started_wall = time.time()
         response = await request_response(
@@ -178,20 +239,34 @@ class WorkerHandle:
         if self.span_tracer is not None:
             # Constant span name (frame index in args) so viewers and the
             # analysis roll-up aggregate all assignments into one stat.
-            args = {"frame": frame_index}
+            args = {"frame": frame_index, "flow": trace.flow_id}
             if stolen_from is not None:
                 args["stolen_from"] = stolen_from
+            track = f"worker-{self._worker_label()}"
             self.span_tracer.complete(
                 "assign frame",
                 cat="master",
                 start_wall=rpc_started_wall,
                 duration=rpc_seconds,
-                track=f"worker-{self._worker_label()}",
+                track=track,
                 args=args,
+            )
+            # Flow source, mid-span so it binds inside the assign slice;
+            # the worker's queue_wait/read/render/write spans route it and
+            # the result-received span terminates it.
+            self.span_tracer.flow_start(
+                "frame",
+                id=trace.flow_id,
+                ts=rpc_started_wall + rpc_seconds / 2.0,
+                cat="frame",
+                track=track,
+                args={"frame": frame_index},
             )
         now = time.time()
         self.queue.add(
-            FrameOnWorker(frame_index, queued_at=now, stolen_from=stolen_from)
+            FrameOnWorker(
+                frame_index, queued_at=now, stolen_from=stolen_from, trace=trace
+            )
         )
         self._update_queue_depth_gauge()
         self.state.mark_frame_as_queued(
@@ -210,12 +285,26 @@ class WorkerHandle:
         to the caller).
         """
         request = pm.MasterFrameQueueRemoveRequest.new(job_name, frame_index)
+        rpc_started_wall = time.time()
+        rpc_started = time.perf_counter()
         response = await request_response(
             self.sender, self.router, request, pm.WorkerFrameQueueRemoveResponse
         )
         if response.result == pm.FRAME_QUEUE_REMOVE_RESULT_REMOVED:
-            self.queue.remove(frame_index)
+            removed = self.queue.remove(frame_index)
             self._update_queue_depth_gauge()
+            # A successful steal ends this assignment's causal chain (the
+            # thief's queue_frame opens a fresh one) — terminate the flow
+            # here so no dangling flow-start survives a stolen frame.
+            if self.span_tracer is not None:
+                self._complete_frame_flow(
+                    "frame stolen",
+                    frame_index,
+                    removed.trace if removed is not None else None,
+                    start_wall=rpc_started_wall,
+                    duration=time.perf_counter() - rpc_started,
+                    extra_args={"result": response.result},
+                )
         return response.result
 
     def has_empty_queue(self) -> bool:
@@ -229,7 +318,9 @@ class WorkerHandle:
     # -- job lifecycle RPCs --------------------------------------------------
 
     async def send_job_started(self) -> None:
-        await self.sender.send_message(pm.MasterJobStartedEvent())
+        await self.sender.send_message(
+            pm.MasterJobStartedEvent(trace_id=self.state.trace_id)
+        )
 
     async def finish_job_and_get_trace(self):
         """Request the worker's trace; 600 s budget for huge traces."""
@@ -241,6 +332,9 @@ class WorkerHandle:
             pm.WorkerJobFinishedResponse,
             timeout=JOB_FINISH_TRACE_TIMEOUT,
         )
+        # Keep the piggybacked span timeline (None from a C++ worker) for
+        # the merged cluster timeline export.
+        self.collected_span_events = response.span_events
         return response.trace
 
     # -- background loops ----------------------------------------------------
@@ -264,8 +358,29 @@ class WorkerHandle:
         async def handle_finished() -> None:
             while True:
                 event = await finished_queue.get()
+                received_wall = time.time()
+                received_mono = time.perf_counter()
                 frame_on_worker = self.queue.remove(event.frame_index)
                 self._update_queue_depth_gauge()
+                # Terminal span of the assignment's causal chain on the
+                # master timeline: the flow arrow from "assign frame"
+                # through the worker's phases ends here. Prefer the trace
+                # the event echoed (exact even across re-queues); fall back
+                # to the mirror's record (a C++ worker echoes nothing).
+                # After _mark_dead the eviction already terminated every
+                # mirrored flow, so a late in-flight event records its span
+                # WITHOUT a second terminal arrowhead.
+                trace = event.trace
+                if trace is None and frame_on_worker is not None:
+                    trace = frame_on_worker.trace
+                self._complete_frame_flow(
+                    "frame result",
+                    event.frame_index,
+                    None if self.is_dead else trace,
+                    start_wall=received_wall,
+                    duration=time.perf_counter() - received_mono,
+                    extra_args={"result": event.result},
+                )
                 if event.result == pm.FRAME_QUEUE_ITEM_FINISHED_OK:
                     self.logger.debug("Frame %d finished.", event.frame_index)
                     started = self._rendering_started_at.pop(event.frame_index, None)
@@ -315,7 +430,12 @@ class WorkerHandle:
         pong_queue = self.router.subscribe(pm.WorkerHeartbeatResponse)
         try:
             while True:
-                await asyncio.sleep(HEARTBEAT_INTERVAL_SECONDS)
+                # Ping FIRST, then sleep (the reference sleeps first): the
+                # immediate first exchange seeds the clock-offset estimator
+                # at registration time, so even short jobs get their worker
+                # rows rebased in the merged cluster timeline. Safe against
+                # drops because the worker subscribes its heartbeat queue
+                # before starting its receive loop.
                 request = pm.MasterHeartbeatRequest.new_now()
                 try:
                     sent_at = time.perf_counter()
@@ -325,6 +445,7 @@ class WorkerHandle:
                         timeout=HEARTBEAT_RESPONSE_TIMEOUT,
                         queue=pong_queue,
                     )
+                    pong_wall = time.time()
                     if self.metrics is not None:
                         self.metrics.histogram(
                             "transport_heartbeat_rtt_seconds",
@@ -334,6 +455,13 @@ class WorkerHandle:
                             time.perf_counter() - sent_at,
                             worker=self._worker_label(),
                         )
+                    if pong.received_at is not None and pong.responded_at is not None:
+                        self._observe_clock_sample(
+                            request.request_time,
+                            pong.received_at,
+                            pong.responded_at,
+                            pong_wall,
+                        )
                     if pong.metrics is not None:
                         self.latest_worker_metrics = pong.metrics
                 except (asyncio.TimeoutError, ConnectionError, Exception) as e:
@@ -341,7 +469,28 @@ class WorkerHandle:
                         raise
                     await self._mark_dead(f"heartbeat failed: {e}")
                     return
+                await asyncio.sleep(HEARTBEAT_INTERVAL_SECONDS)
         except asyncio.CancelledError:
             raise
         finally:
             self.router.unsubscribe(pm.WorkerHeartbeatResponse, pong_queue)
+
+    def _observe_clock_sample(
+        self, t1: float, t2: float, t3: float, t4: float
+    ) -> None:
+        """Fold one NTP exchange into the estimator and export the gauges."""
+        self.clock_offset.add_ping(t1, t2, t3, t4)
+        if self.metrics is None:
+            return
+        label = self._worker_label()
+        self.metrics.gauge(
+            "master_worker_clock_offset_seconds",
+            "Estimated worker-minus-master wall clock offset "
+            "(median of the heartbeat NTP window)",
+            labels=("worker",),
+        ).set(self.clock_offset.offset(), worker=label)
+        self.metrics.gauge(
+            "master_worker_clock_drift_ppm",
+            "Estimated worker clock drift rate vs the master (ppm)",
+            labels=("worker",),
+        ).set(self.clock_offset.drift_ppm(), worker=label)

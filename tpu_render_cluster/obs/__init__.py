@@ -5,6 +5,11 @@
 - ``tracer`` — spans (wall-clock anchor + monotonic duration) exported as
   Chrome trace-event JSON, loadable in Perfetto / chrome://tracing.
 - ``snapshot`` — periodic atomic JSON snapshots for live inspection.
+- ``clocksync`` — NTP-style per-worker clock-offset estimation from the
+  heartbeat's four timestamps (median-of-window + drift tracking).
+- ``timeline`` — merged cluster timeline: per-process events rebased onto
+  the master clock by the estimated offsets, pids deduplicated.
+- ``validate`` — trace-invariant checker backing scripts/validate_trace.py.
 
 ``get_registry()`` / ``get_tracer()`` return the process-global instances
 used by process-scoped subsystems (the render path, ``ops/assignment``,
@@ -15,6 +20,7 @@ instances so per-component views stay separable.
 
 from __future__ import annotations
 
+from tpu_render_cluster.obs.clocksync import ClockOffsetEstimator
 from tpu_render_cluster.obs.registry import (
     DEFAULT_BUCKETS,
     Counter,
@@ -25,22 +31,37 @@ from tpu_render_cluster.obs.registry import (
     merge_wire,
 )
 from tpu_render_cluster.obs.snapshot import SnapshotWriter, write_metrics_snapshot
+from tpu_render_cluster.obs.timeline import (
+    TimelineProcess,
+    export_cluster_trace,
+    tracer_process,
+)
 from tpu_render_cluster.obs.tracer import Tracer, export_chrome_trace
+from tpu_render_cluster.obs.validate import (
+    validate_trace_document,
+    validate_trace_file,
+)
 
 __all__ = [
     "DEFAULT_BUCKETS",
+    "ClockOffsetEstimator",
     "Counter",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
     "SnapshotWriter",
+    "TimelineProcess",
     "Tracer",
     "export_chrome_trace",
+    "export_cluster_trace",
     "get_registry",
     "get_tracer",
     "log_buckets",
     "merge_wire",
     "render_fps_gauge",
+    "tracer_process",
+    "validate_trace_document",
+    "validate_trace_file",
     "write_metrics_snapshot",
 ]
 

@@ -1,0 +1,129 @@
+"""NTP-style per-worker clock-offset estimation from heartbeat pings.
+
+Master and worker timestamps live on different wall clocks; merging their
+span timelines into one causal view (``obs/timeline.py``) needs the offset
+between them. Every heartbeat already crosses the wire twice with a
+fractional-unix timestamp on each leg, which is exactly the classic NTP
+four-timestamp exchange:
+
+    t1  master sends the ping        (master clock — the ping's request_time)
+    t2  worker receives the ping     (worker clock)
+    t3  worker sends the pong        (worker clock)
+    t4  master receives the pong     (master clock)
+
+    offset = ((t2 - t1) + (t3 - t4)) / 2      (worker clock - master clock)
+    delay  = (t4 - t1) - (t3 - t2)            (round trip minus worker hold)
+
+The offset estimate's error is bounded by the *asymmetry* of the two
+network legs (at most delay/2), so single samples jitter by the scheduling
+noise of both event loops. ``ClockOffsetEstimator`` keeps a sliding window
+of samples and reports the window median — robust to the occasional
+GC-pause outlier — plus a least-squares drift slope so slow clock skew
+(crystal drift, NTP slewing on one host) is visible as a rate.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+
+__all__ = ["ClockOffsetEstimator", "ntp_offset_and_delay"]
+
+
+def ntp_offset_and_delay(
+    t1: float, t2: float, t3: float, t4: float
+) -> tuple[float, float]:
+    """The classic NTP estimate from one four-timestamp exchange.
+
+    Returns ``(offset, delay)`` where ``offset`` is (worker clock -
+    master clock) in seconds and ``delay`` is the network round trip
+    excluding the worker's hold time (clamped at 0 against clock noise).
+    """
+    offset = ((t2 - t1) + (t3 - t4)) / 2.0
+    delay = max(0.0, (t4 - t1) - (t3 - t2))
+    return offset, delay
+
+
+class ClockOffsetEstimator:
+    """Online median-of-window offset estimator with drift tracking.
+
+    One instance per worker, held by the master's ``WorkerHandle`` and fed
+    from the heartbeat loop. Thread-free by design: all mutation happens on
+    the master's event loop.
+    """
+
+    def __init__(self, window: int = 16) -> None:
+        if window < 1:
+            raise ValueError("window must be >= 1")
+        # (sample midpoint on the master clock, offset, delay) triples.
+        self._samples: deque[tuple[float, float, float]] = deque(maxlen=window)
+
+    def add_ping(self, t1: float, t2: float, t3: float, t4: float) -> float:
+        """Fold one ping exchange in; returns that sample's raw offset."""
+        offset, delay = ntp_offset_and_delay(t1, t2, t3, t4)
+        self._samples.append(((t1 + t4) / 2.0, offset, delay))
+        return offset
+
+    @property
+    def sample_count(self) -> int:
+        return len(self._samples)
+
+    @property
+    def last_delay(self) -> float:
+        """Network delay of the most recent sample (0.0 with no samples)."""
+        return self._samples[-1][2] if self._samples else 0.0
+
+    def offset(self) -> float:
+        """Median offset over the window (worker - master, seconds).
+
+        0.0 with no samples — a worker that never reported timestamps
+        (e.g. the C++ daemon's reference-shaped empty pong) merges into
+        the cluster timeline unshifted.
+        """
+        if not self._samples:
+            return 0.0
+        offsets = sorted(s[1] for s in self._samples)
+        mid = len(offsets) // 2
+        if len(offsets) % 2:
+            return offsets[mid]
+        return (offsets[mid - 1] + offsets[mid]) / 2.0
+
+    def _drift_fit(self) -> tuple[float, float] | None:
+        """Least-squares (reference time, slope) of offset vs master time."""
+        if len(self._samples) < 2:
+            return None
+        times = [s[0] for s in self._samples]
+        offsets = [s[1] for s in self._samples]
+        t_mean = sum(times) / len(times)
+        o_mean = sum(offsets) / len(offsets)
+        var = sum((t - t_mean) ** 2 for t in times)
+        if var <= 0.0:
+            return None
+        cov = sum(
+            (t - t_mean) * (o - o_mean) for t, o in zip(times, offsets)
+        )
+        return t_mean, cov / var
+
+    def drift(self) -> float:
+        """Offset slope in seconds per second (0.0 until two samples)."""
+        fit = self._drift_fit()
+        return fit[1] if fit is not None else 0.0
+
+    def drift_ppm(self) -> float:
+        """Drift expressed as parts-per-million, the usual crystal unit."""
+        return self.drift() * 1e6
+
+    def offset_at(self, t: float) -> float:
+        """Offset extrapolated to master time ``t`` using the drift fit.
+
+        Anchored at the window's median offset (robust) and slid along the
+        least-squares slope; with fewer than two samples this degrades to
+        the plain median.
+        """
+        fit = self._drift_fit()
+        base = self.offset()
+        if fit is None:
+            return base
+        t_mean, slope = fit
+        out = base + slope * (t - t_mean)
+        return out if math.isfinite(out) else base

@@ -310,12 +310,30 @@ class MetricsRegistry:
         return {"c": counters, "g": gauges, "h": histograms}
 
 
+def _check_wire_histogram(key: str, entry: Mapping[str, Any]) -> None:
+    """Reject malformed histogram wire entries BEFORE they fold in.
+
+    The bucket-count vector must carry exactly one count per bound plus
+    the +inf overflow; a shorter/longer vector zipped element-wise would
+    silently drop or misfile counts, which is worse than failing loud.
+    """
+    bounds = entry["le"]
+    counts = entry["b"]
+    if len(counts) != len(bounds) + 1:
+        raise ValueError(
+            f"Histogram {key!r}: bucket count vector has {len(counts)} "
+            f"entries for {len(bounds)} bounds (expected {len(bounds) + 1} "
+            f"including the +inf overflow bucket)"
+        )
+
+
 def merge_wire(payloads: Iterable[Mapping[str, Any]]) -> dict[str, Any]:
     """Aggregate compact wire payloads into one cluster-wide view.
 
     Counters, gauges, and histogram counts/sums are summed per series key;
     histogram min/max combine; bucket vectors add element-wise (all
-    processes share DEFAULT_BUCKETS — mismatched bounds raise).
+    processes share DEFAULT_BUCKETS — mismatched or malformed bucket
+    layouts raise instead of silently misfolding counts).
     """
     counters: dict[str, float] = {}
     gauges: dict[str, float] = {}
@@ -326,6 +344,7 @@ def merge_wire(payloads: Iterable[Mapping[str, Any]]) -> dict[str, Any]:
         for key, value in (payload.get("g") or {}).items():
             gauges[key] = gauges.get(key, 0.0) + float(value)
         for key, entry in (payload.get("h") or {}).items():
+            _check_wire_histogram(key, entry)
             merged = histograms.get(key)
             if merged is None:
                 histograms[key] = {
@@ -338,7 +357,14 @@ def merge_wire(payloads: Iterable[Mapping[str, Any]]) -> dict[str, Any]:
                 }
                 continue
             if merged["le"] != list(entry["le"]):
-                raise ValueError(f"Histogram bounds mismatch for {key!r}")
+                raise ValueError(
+                    f"Histogram bounds mismatch for {key!r}: a previous "
+                    f"payload declared {len(merged['le'])} bounds "
+                    f"{merged['le'][:3]}..., this one declares "
+                    f"{len(list(entry['le']))} bounds "
+                    f"{list(entry['le'])[:3]}... — refusing to misfold "
+                    f"counts across layouts"
+                )
             merged["n"] += int(entry["n"])
             merged["s"] += float(entry["s"])
             merged["b"] = [a + b for a, b in zip(merged["b"], entry["b"])]

@@ -42,7 +42,14 @@ def write_metrics_snapshot(
     if extra:
         payload.update(extra)
     tmp = path.with_suffix(path.suffix + ".tmp")
-    tmp.write_text(json.dumps(payload, indent=2), encoding="utf-8")
+    # flush + fsync BEFORE the atomic rename: os.replace is atomic against
+    # concurrent readers, but without the fsync a crash (or SIGKILL) after
+    # the rename can still leave a truncated/empty file once the page cache
+    # is lost — the rename must only ever publish fully-durable bytes.
+    with open(tmp, "w", encoding="utf-8") as handle:
+        handle.write(json.dumps(payload, indent=2))
+        handle.flush()
+        os.fsync(handle.fileno())
     os.replace(tmp, path)
     return path
 

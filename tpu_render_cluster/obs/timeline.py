@@ -1,0 +1,121 @@
+"""Merged cluster timeline: one Perfetto file across process boundaries.
+
+``export_chrome_trace`` can already merge colocated tracers, but a real
+cluster collects span events from SEPARATE processes whose tracers (a) may
+reuse the same ``pid`` values (each process numbers its tracers from 1)
+and (b) timestamp on uncorrelated wall clocks. This module fixes both:
+
+- every contributing process gets a FRESH pid in the merged document (its
+  metadata and span events are rewritten consistently), so two workers
+  that both called themselves pid 1 land on separate Perfetto rows;
+- each process's events are REBASED onto the master's clock by the
+  per-worker offset the heartbeat estimator measured
+  (``obs/clocksync.py``): ``ts_master = ts_worker - offset``.
+
+The applied offsets are recorded under ``otherData.clock_offsets_seconds``
+so a reader can tell a corrected timeline from a raw one.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Iterable
+
+from tpu_render_cluster.obs.tracer import Tracer
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "TimelineProcess",
+    "export_cluster_trace",
+    "rebase_events",
+    "tracer_process",
+]
+
+
+@dataclass
+class TimelineProcess:
+    """One process's contribution: its raw events + estimated clock offset.
+
+    ``events`` must include the tracer's metadata events (``process_name``
+    etc.) — ``Tracer.metadata_events() + Tracer.events()``, or the
+    equivalent list a worker shipped over the wire. ``offset_seconds`` is
+    (process clock - master clock); the master itself contributes 0.0.
+    ``dropped`` carries the source tracer's past-the-cap drop count so a
+    truncated contribution stays visible in the merged document.
+    """
+
+    name: str
+    events: list[dict[str, Any]] = field(default_factory=list)
+    offset_seconds: float = 0.0
+    dropped: int = 0
+
+
+def tracer_process(tracer: Tracer, offset_seconds: float = 0.0) -> TimelineProcess:
+    """Wrap a live in-process tracer (harness path) as a timeline process."""
+    return TimelineProcess(
+        name=tracer.process_name,
+        events=tracer.metadata_events() + tracer.events(),
+        offset_seconds=offset_seconds,
+        dropped=tracer.dropped,
+    )
+
+
+def rebase_events(
+    events: Iterable[dict[str, Any]], offset_seconds: float, *, pid: int | None = None
+) -> list[dict[str, Any]]:
+    """Copy events onto the master clock (ts -= offset) and optionally
+    rewrite their pid. Metadata events carry no ``ts``; they pass through
+    with only the pid rewritten."""
+    shift_us = offset_seconds * 1e6
+    out: list[dict[str, Any]] = []
+    for event in events:
+        copy = dict(event)
+        if pid is not None:
+            copy["pid"] = pid
+        if shift_us and "ts" in copy:
+            copy["ts"] = round(float(copy["ts"]) - shift_us, 3)
+        out.append(copy)
+    return out
+
+
+def export_cluster_trace(
+    path: str | Path, processes: Iterable[TimelineProcess]
+) -> Path:
+    """Write the merged, offset-corrected cluster timeline.
+
+    Process order is preserved (callers put the master first so it renders
+    as the top row); pids are reassigned 1..N.
+    """
+    events: list[dict[str, Any]] = []
+    offsets: dict[str, float] = {}
+    dropped: dict[str, int] = {}
+    for new_pid, process in enumerate(processes, start=1):
+        offsets[process.name] = process.offset_seconds
+        events.extend(
+            rebase_events(process.events, process.offset_seconds, pid=new_pid)
+        )
+        if process.dropped:
+            # Same non-silent-truncation contract as Tracer.export: a
+            # capped contributor's timeline is missing its TAIL, and a
+            # clean-looking merged file must not imply full coverage.
+            dropped[process.name] = process.dropped
+            logger.warning(
+                "Cluster timeline contribution %r dropped %d events past "
+                "its cap; that process row is truncated.",
+                process.name, process.dropped,
+            )
+    document: dict[str, Any] = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"clock_offsets_seconds": offsets},
+    }
+    if dropped:
+        document["otherData"]["dropped_events"] = dropped
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(document), encoding="utf-8")
+    return path

@@ -115,6 +115,53 @@ class Tracer:
             event["args"] = dict(args)
         self._append(event)
 
+    # -- flow events ---------------------------------------------------------
+    #
+    # Perfetto flow events ("s" start / "t" step / "f" end, matched by id)
+    # draw arrows between spans on different process rows — the causal link
+    # from a master-side assignment to the worker-side frame phases. A flow
+    # event binds to the slice that encloses its ``ts`` on its (pid, tid)
+    # track, so emitters place the flow timestamp INSIDE the span it should
+    # attach to (mid-span is the safe choice for zero-duration spans).
+
+    def _flow(
+        self,
+        phase: str,
+        name: str,
+        *,
+        id: str,
+        ts: float,
+        cat: str = "",
+        track: str | None = None,
+        args: Mapping[str, Any] | None = None,
+    ) -> None:
+        event: dict[str, Any] = {
+            "name": name,
+            "cat": cat or "flow",
+            "ph": phase,
+            "id": id,
+            "pid": self.pid,
+            "tid": self._tid(track),
+            "ts": round(ts * 1e6, 3),
+        }
+        if phase == "f":
+            event["bp"] = "e"  # bind the arrowhead to the enclosing slice
+        if args:
+            event["args"] = dict(args)
+        self._append(event)
+
+    def flow_start(self, name: str, *, id: str, ts: float, **kwargs: Any) -> None:
+        """Open a flow arrow (source side) at wall time ``ts`` (seconds)."""
+        self._flow("s", name, id=id, ts=ts, **kwargs)
+
+    def flow_step(self, name: str, *, id: str, ts: float, **kwargs: Any) -> None:
+        """Route an open flow through the span enclosing ``ts``."""
+        self._flow("t", name, id=id, ts=ts, **kwargs)
+
+    def flow_end(self, name: str, *, id: str, ts: float, **kwargs: Any) -> None:
+        """Terminate a flow arrow (sink side) at wall time ``ts``."""
+        self._flow("f", name, id=id, ts=ts, **kwargs)
+
     @contextmanager
     def span(
         self,

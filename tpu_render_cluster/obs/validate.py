@@ -1,0 +1,209 @@
+"""Trace-invariant checker for exported Chrome trace-event artifacts.
+
+Every timeline this repo writes — per-run ``*_trace-events.json``, the
+merged ``*_cluster_trace-events.json``, worker-daemon exports — must hold
+a small set of structural invariants or the Perfetto view silently lies
+(mis-nested slices, arrows pointing nowhere, two processes folded onto one
+row). ``validate_trace_events`` returns a list of human-readable problem
+strings (empty = valid):
+
+1.  Every event is an object with a ``ph``; complete (``X``) events carry
+    finite, non-negative ``ts`` and ``dur``; all timestamped events carry
+    finite non-negative ``ts``.
+2.  ``B``/``E`` duration events balance per (pid, tid) in stack order.
+3.  Per (pid, tid) track, ``X`` events appear in non-decreasing END-time
+    order (the tracer appends at completion, so out-of-order ends mean a
+    clock went backwards or a merge interleaved two tracks onto one tid).
+    A small tolerance absorbs wall-vs-monotonic rounding.
+4.  Metadata is unique: one ``process_name`` per pid, one ``thread_name``
+    per (pid, tid) — conflicting claims are exactly the pid-collision bug
+    a bad multi-process merge produces.
+5.  Flow ids resolve: no half-open arrows — an id with a start (``s``)
+    must carry a terminal (``f``) and vice versa — and every flow event
+    binds inside some ``X`` span on its own (pid, tid) track. Step-only
+    (``t``) chains are legal: a per-process fragment (a worker daemon's
+    own export) routes flows whose start and terminal live on the
+    master's timeline; the merged cluster file carries all three.
+
+``scripts/validate_trace.py`` is the CLI wrapper; tests call these
+functions directly on every artifact they export.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import math
+from pathlib import Path
+from typing import Any, Iterable
+
+__all__ = [
+    "validate_trace_events",
+    "validate_trace_document",
+    "validate_trace_file",
+]
+
+# End-time ordering tolerance per track, in trace microseconds. Spans anchor
+# on wall-clock but measure duration on the monotonic clock, so two spans
+# completing back-to-back can disagree about "now" by the rounding jitter
+# between the clocks; 5 ms is far above that and far below any real
+# ordering violation a merge or rebase bug would introduce.
+END_ORDER_TOLERANCE_US = 5000.0
+
+
+def _finite_nonneg(value: Any) -> bool:
+    return (
+        isinstance(value, (int, float))
+        and not isinstance(value, bool)
+        and math.isfinite(value)
+        and value >= 0
+    )
+
+
+def validate_trace_events(events: Iterable[Any]) -> list[str]:
+    problems: list[str] = []
+    spans_by_track: dict[tuple[Any, Any], list[dict[str, Any]]] = {}
+    open_stacks: dict[tuple[Any, Any], list[str]] = {}
+    process_names: dict[Any, str] = {}
+    thread_names: dict[tuple[Any, Any], str] = {}
+    flow_events: list[dict[str, Any]] = []
+
+    for i, event in enumerate(events):
+        if not isinstance(event, dict) or "ph" not in event:
+            problems.append(f"event #{i}: not an object with a 'ph' field")
+            continue
+        ph = event["ph"]
+        track = (event.get("pid"), event.get("tid"))
+        if ph == "M":
+            name = event.get("name")
+            claimed = (event.get("args") or {}).get("name")
+            if name == "process_name":
+                previous = process_names.setdefault(event.get("pid"), claimed)
+                if previous != claimed:
+                    problems.append(
+                        f"pid {event.get('pid')}: conflicting process_name "
+                        f"metadata ({previous!r} vs {claimed!r})"
+                    )
+            elif name == "thread_name":
+                previous = thread_names.setdefault(track, claimed)
+                if previous != claimed:
+                    problems.append(
+                        f"track {track}: conflicting thread_name metadata "
+                        f"({previous!r} vs {claimed!r})"
+                    )
+            continue
+        if not _finite_nonneg(event.get("ts")):
+            problems.append(
+                f"event #{i} ({event.get('name')!r}, ph={ph!r}): "
+                f"missing or negative ts"
+            )
+            continue
+        if ph == "X":
+            if not _finite_nonneg(event.get("dur")):
+                problems.append(
+                    f"event #{i} ({event.get('name')!r}): complete event "
+                    f"with missing or negative dur"
+                )
+                continue
+            spans_by_track.setdefault(track, []).append(event)
+        elif ph == "B":
+            open_stacks.setdefault(track, []).append(str(event.get("name")))
+        elif ph == "E":
+            stack = open_stacks.setdefault(track, [])
+            if not stack:
+                problems.append(
+                    f"track {track}: 'E' event ({event.get('name')!r}) "
+                    f"with no open 'B'"
+                )
+            else:
+                stack.pop()
+        elif ph in ("s", "t", "f"):
+            flow_events.append(event)
+
+    for track, stack in open_stacks.items():
+        if stack:
+            problems.append(
+                f"track {track}: {len(stack)} unclosed 'B' event(s): {stack}"
+            )
+
+    # Per-track monotonic end times (completion order is append order).
+    for track, spans in spans_by_track.items():
+        high_water = -math.inf
+        for span in spans:
+            end = float(span["ts"]) + float(span["dur"])
+            if end < high_water - END_ORDER_TOLERANCE_US:
+                problems.append(
+                    f"track {track}: span {span.get('name')!r} ends at "
+                    f"{end:.1f}us, {high_water - end:.1f}us before an "
+                    f"earlier-appended span's end (non-monotonic track)"
+                )
+            high_water = max(high_water, end)
+
+    # Flow resolution: start + terminal per id, every event bound to a span.
+    # Binding is a point-stabbing query per flow event; a linear scan over
+    # the track's spans is quadratic on production artifacts (a 14400-frame
+    # job puts ~60k spans and as many flow steps on one track). Sorting by
+    # start with a running max-end answers "does any span contain ts?" in
+    # O(log n): a containing span exists iff the max end among spans
+    # starting at or before ts reaches ts.
+    stab_index: dict[tuple[Any, Any], tuple[list[float], list[float]]] = {}
+    for track, spans in spans_by_track.items():
+        intervals = sorted(
+            (float(s["ts"]), float(s["ts"]) + float(s["dur"])) for s in spans
+        )
+        starts = [start for start, _ in intervals]
+        max_ends: list[float] = []
+        high = -math.inf
+        for _, end in intervals:
+            high = max(high, end)
+            max_ends.append(high)
+        stab_index[track] = (starts, max_ends)
+
+    phases_by_id: dict[Any, set[str]] = {}
+    for event in flow_events:
+        phases_by_id.setdefault(event.get("id"), set()).add(event["ph"])
+        track = (event.get("pid"), event.get("tid"))
+        ts = float(event["ts"])
+        starts, max_ends = stab_index.get(track, ([], []))
+        index = bisect.bisect_right(starts, ts) - 1
+        bound = index >= 0 and max_ends[index] >= ts
+        if not bound:
+            problems.append(
+                f"flow {event.get('id')!r} ({event['ph']}) at {ts:.1f}us on "
+                f"track {track}: no enclosing span to bind to"
+            )
+    for flow_id, phases in phases_by_id.items():
+        # Step-only chains are per-process fragments (start/terminal live
+        # on another process's timeline); half-open chains are broken.
+        if "s" in phases and "f" not in phases:
+            problems.append(
+                f"flow {flow_id!r}: start ('s') without terminal ('f')"
+            )
+        elif "f" in phases and "s" not in phases:
+            problems.append(
+                f"flow {flow_id!r}: terminal ('f') without start ('s')"
+            )
+
+    return problems
+
+
+def validate_trace_document(document: Any) -> list[str]:
+    """Validate a parsed trace document (object or bare-array format)."""
+    if isinstance(document, dict):
+        events = document.get("traceEvents")
+        if not isinstance(events, list):
+            return ["document: 'traceEvents' missing or not a list"]
+    elif isinstance(document, list):
+        events = document
+    else:
+        return ["document: not a Chrome trace-event document"]
+    return validate_trace_events(events)
+
+
+def validate_trace_file(path: str | Path) -> list[str]:
+    path = Path(path)
+    try:
+        document = json.loads(path.read_text(encoding="utf-8"))
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{path}: unreadable ({e})"]
+    return [f"{path}: {p}" for p in validate_trace_document(document)]

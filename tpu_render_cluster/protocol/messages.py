@@ -36,6 +36,57 @@ def generate_worker_id() -> int:
     return secrets.randbits(32)
 
 
+def generate_trace_id() -> int:
+    """Random u64 trace id: one per job, shared by every frame's spans."""
+    return secrets.randbits(64)
+
+
+# ---------------------------------------------------------------------------
+# Trace context (optional, beyond-reference)
+#
+# A (trace_id, span_id) pair rides protocol messages the same way the
+# heartbeat metrics payload does: an OPTIONAL key that absent decodes to
+# None and that reference-shaped peers (the C++ daemons) simply ignore.
+# The master mints one span_id per frame ASSIGNMENT (a re-queued or stolen
+# frame starts a fresh span chain) and the worker echoes the context on its
+# rendering/finished events, so the two sides' Perfetto spans link up as
+# flow arrows without any clock agreement.
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """Causal link for one frame assignment: job trace id + assignment span."""
+
+    trace_id: int
+    span_id: int
+
+    @classmethod
+    def new(cls, trace_id: int) -> "TraceContext":
+        return cls(trace_id=trace_id, span_id=secrets.randbits(64))
+
+    @property
+    def flow_id(self) -> str:
+        """Perfetto flow-event id (string: u64s overflow JSON readers)."""
+        return f"{self.span_id:016x}"
+
+    def to_dict(self) -> dict[str, int]:
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "TraceContext":
+        return cls(trace_id=int(data["trace_id"]), span_id=int(data["span_id"]))
+
+
+def _trace_from_payload(payload: dict[str, Any]) -> TraceContext | None:
+    """Decode the optional ``trace`` key (piggyback idiom: absent -> None)."""
+    data = payload.get("trace")
+    if data is None:
+        return None
+    if not isinstance(data, dict):
+        raise ValueError("trace context must be an object")
+    return TraceContext.from_dict(data)
+
+
 def worker_id_to_string(worker_id: int) -> str:
     """Workers display as 8-hex (reference: shared/src/messages/handshake.rs:14-17)."""
     return f"{worker_id:08x}"
@@ -150,17 +201,25 @@ class MasterFrameQueueAddRequest(Message):
     message_request_id: int
     job: BlenderJob
     frame_index: int
+    # Optional causal context (beyond-reference, piggyback idiom): absent
+    # on the wire decodes to None; the C++ worker ignores the extra key.
+    trace: TraceContext | None = None
 
     @classmethod
-    def new(cls, job: BlenderJob, frame_index: int) -> "MasterFrameQueueAddRequest":
-        return cls(generate_message_request_id(), job, frame_index)
+    def new(
+        cls, job: BlenderJob, frame_index: int, *, trace: TraceContext | None = None
+    ) -> "MasterFrameQueueAddRequest":
+        return cls(generate_message_request_id(), job, frame_index, trace)
 
     def to_payload(self) -> dict[str, Any]:
-        return {
+        out = {
             "message_request_id": self.message_request_id,
             "job": self.job.to_dict(),
             "frame_index": self.frame_index,
         }
+        if self.trace is not None:
+            out["trace"] = self.trace.to_dict()
+        return out
 
     @classmethod
     def from_payload(cls, payload: dict[str, Any]) -> "MasterFrameQueueAddRequest":
@@ -168,6 +227,7 @@ class MasterFrameQueueAddRequest(Message):
             message_request_id=int(payload["message_request_id"]),
             job=BlenderJob.from_dict(payload["job"]),
             frame_index=int(payload["frame_index"]),
+            trace=_trace_from_payload(payload),
         )
 
 
@@ -267,13 +327,25 @@ class WorkerFrameQueueItemRenderingEvent(Message):
     type_name: ClassVar[str] = "event_frame-queue_item-started-rendering"
     job_name: str
     frame_index: int
+    # Echo of the queue-add request's optional trace context.
+    trace: TraceContext | None = None
 
     def to_payload(self) -> dict[str, Any]:
-        return {"job_name": self.job_name, "frame_index": self.frame_index}
+        out: dict[str, Any] = {
+            "job_name": self.job_name,
+            "frame_index": self.frame_index,
+        }
+        if self.trace is not None:
+            out["trace"] = self.trace.to_dict()
+        return out
 
     @classmethod
     def from_payload(cls, payload: dict[str, Any]) -> "WorkerFrameQueueItemRenderingEvent":
-        return cls(str(payload["job_name"]), int(payload["frame_index"]))
+        return cls(
+            str(payload["job_name"]),
+            int(payload["frame_index"]),
+            trace=_trace_from_payload(payload),
+        )
 
 
 @dataclass(frozen=True)
@@ -290,28 +362,50 @@ class WorkerFrameQueueItemFinishedEvent(Message):
     frame_index: int
     result: str  # "ok" | "errored"
     error_reason: str | None = None
+    # Echo of the queue-add request's optional trace context, so the
+    # master can terminate the frame's flow without local bookkeeping.
+    trace: TraceContext | None = None
 
     @classmethod
-    def new_ok(cls, job_name: str, frame_index: int) -> "WorkerFrameQueueItemFinishedEvent":
-        return cls(job_name, frame_index, FRAME_QUEUE_ITEM_FINISHED_OK)
+    def new_ok(
+        cls, job_name: str, frame_index: int, *, trace: TraceContext | None = None
+    ) -> "WorkerFrameQueueItemFinishedEvent":
+        return cls(job_name, frame_index, FRAME_QUEUE_ITEM_FINISHED_OK, trace=trace)
 
     @classmethod
     def new_errored(
-        cls, job_name: str, frame_index: int, reason: str
+        cls,
+        job_name: str,
+        frame_index: int,
+        reason: str,
+        *,
+        trace: TraceContext | None = None,
     ) -> "WorkerFrameQueueItemFinishedEvent":
-        return cls(job_name, frame_index, FRAME_QUEUE_ITEM_FINISHED_ERRORED, reason)
+        return cls(
+            job_name, frame_index, FRAME_QUEUE_ITEM_FINISHED_ERRORED, reason,
+            trace=trace,
+        )
 
     def to_payload(self) -> dict[str, Any]:
-        return {
+        out: dict[str, Any] = {
             "job_name": self.job_name,
             "frame_index": self.frame_index,
             "result": _result_to_dict(self.result, self.error_reason),
         }
+        if self.trace is not None:
+            out["trace"] = self.trace.to_dict()
+        return out
 
     @classmethod
     def from_payload(cls, payload: dict[str, Any]) -> "WorkerFrameQueueItemFinishedEvent":
         result, reason = _result_from_dict(payload["result"])
-        return cls(str(payload["job_name"]), int(payload["frame_index"]), result, reason)
+        return cls(
+            str(payload["job_name"]),
+            int(payload["frame_index"]),
+            result,
+            reason,
+            trace=_trace_from_payload(payload),
+        )
 
 
 @dataclass(frozen=True)
@@ -337,43 +431,70 @@ class MasterHeartbeatRequest(Message):
 class WorkerHeartbeatResponse(Message):
     """W→M pong (shared/src/messages/heartbeat.rs:52-66).
 
-    Extension over the reference's empty payload: an OPTIONAL compact
-    metrics payload (``obs.registry.to_wire()`` shape) piggybacks on the
-    pong so the master can aggregate a live cluster-wide view with zero
-    extra round-trips. Backward/forward compatible in both directions:
-    a missing ``metrics`` key decodes to ``None`` (the C++ worker sends
-    the reference's empty payload), and peers that don't know the key
-    ignore it (the C++ master reads only ``message_type``).
+    Extensions over the reference's empty payload, all riding the same
+    piggyback idiom (absent key decodes to ``None``; the C++ worker sends
+    the reference's empty payload and the C++ master reads only
+    ``message_type``, so both directions stay reference-compatible):
+
+    - ``metrics`` — OPTIONAL compact metrics payload
+      (``obs.registry.to_wire()`` shape) so the master can aggregate a
+      live cluster-wide view with zero extra round-trips;
+    - ``received_at`` / ``responded_at`` — OPTIONAL fractional-unix
+      timestamps on the worker's clock. Together with the ping's
+      ``request_time`` and the master's receive time they complete the
+      NTP four-timestamp exchange the per-worker clock-offset estimator
+      (``obs/clocksync.py``) feeds on.
     """
 
     type_name: ClassVar[str] = "response_heartbeat"
     metrics: dict[str, Any] | None = None
+    received_at: float | None = None
+    responded_at: float | None = None
 
     def to_payload(self) -> dict[str, Any]:
-        if self.metrics is None:
-            return {}
-        return {"metrics": self.metrics}
+        out: dict[str, Any] = {}
+        if self.metrics is not None:
+            out["metrics"] = self.metrics
+        if self.received_at is not None:
+            out["received_at"] = self.received_at
+        if self.responded_at is not None:
+            out["responded_at"] = self.responded_at
+        return out
 
     @classmethod
     def from_payload(cls, payload: dict[str, Any]) -> "WorkerHeartbeatResponse":
         metrics = payload.get("metrics")
         if metrics is not None and not isinstance(metrics, dict):
             raise ValueError("heartbeat metrics payload must be an object")
-        return cls(metrics=metrics)
+        received_at = payload.get("received_at")
+        responded_at = payload.get("responded_at")
+        return cls(
+            metrics=metrics,
+            received_at=None if received_at is None else float(received_at),
+            responded_at=None if responded_at is None else float(responded_at),
+        )
 
 
 @dataclass(frozen=True)
 class MasterJobStartedEvent(Message):
-    """M→W empty job-started broadcast (shared/src/messages/job.rs:11-25)."""
+    """M→W job-started broadcast (shared/src/messages/job.rs:11-25).
+
+    Empty in the reference; this repo's master piggybacks the OPTIONAL job
+    ``trace_id`` so every process stamps its spans with the same trace.
+    """
 
     type_name: ClassVar[str] = "event_job-started"
+    trace_id: int | None = None
 
     def to_payload(self) -> dict[str, Any]:
-        return {}
+        if self.trace_id is None:
+            return {}
+        return {"trace_id": self.trace_id}
 
     @classmethod
     def from_payload(cls, payload: dict[str, Any]) -> "MasterJobStartedEvent":
-        return cls()
+        trace_id = payload.get("trace_id")
+        return cls(trace_id=None if trace_id is None else int(trace_id))
 
 
 @dataclass(frozen=True)
@@ -397,23 +518,38 @@ class MasterJobFinishedRequest(Message):
 
 @dataclass(frozen=True)
 class WorkerJobFinishedResponse(Message):
-    """W→M: the full WorkerTrace (shared/src/messages/job.rs:90-110)."""
+    """W→M: the full WorkerTrace (shared/src/messages/job.rs:90-110).
+
+    Piggyback extension: ``span_events`` optionally carries the worker's
+    Chrome trace-event timeline (``{"process_name": ..., "events": [...]}``)
+    so a multi-host master can assemble the merged cluster timeline without
+    a separate collection RPC. Absent (the C++ worker, a version-skewed
+    peer) decodes to ``None`` and the master simply omits that worker's row.
+    """
 
     type_name: ClassVar[str] = "response_job-finished"
     message_request_context_id: int
     trace: WorkerTrace
+    span_events: dict[str, Any] | None = None
 
     def to_payload(self) -> dict[str, Any]:
-        return {
+        out: dict[str, Any] = {
             "message_request_context_id": self.message_request_context_id,
             "trace": self.trace.to_dict(),
         }
+        if self.span_events is not None:
+            out["span_events"] = self.span_events
+        return out
 
     @classmethod
     def from_payload(cls, payload: dict[str, Any]) -> "WorkerJobFinishedResponse":
+        span_events = payload.get("span_events")
+        if span_events is not None and not isinstance(span_events, dict):
+            raise ValueError("span_events payload must be an object")
         return cls(
             message_request_context_id=int(payload["message_request_context_id"]),
             trace=WorkerTrace.from_dict(payload["trace"]),
+            span_events=span_events,
         )
 
 

@@ -250,6 +250,7 @@ def trace_paths_wavefront(
             tracer.complete(
                 "wavefront_bounce", cat="render", start_wall=start_wall,
                 duration=time.perf_counter() - start_mono,
+                track="wavefront",
                 args={"bounce": bounce, "live": 0, "bucket": 0,
                       "alive_fraction": 0.0},
             )
@@ -282,6 +283,7 @@ def trace_paths_wavefront(
         tracer.complete(
             "wavefront_bounce", cat="render", start_wall=start_wall,
             duration=time.perf_counter() - start_mono,
+            track="wavefront",
             args={"bounce": bounce, "live": live, "bucket": bucket,
                   "alive_fraction": round(live / n0, 4)},
         )

@@ -15,7 +15,11 @@ import os
 import sys
 from pathlib import Path
 
-from tpu_render_cluster.obs import write_metrics_snapshot
+from tpu_render_cluster.obs import (
+    export_chrome_trace,
+    get_tracer,
+    write_metrics_snapshot,
+)
 from tpu_render_cluster.protocol import messages as pm
 from tpu_render_cluster.utils.logging import initialize_console_and_file_logging
 from tpu_render_cluster.worker.backends import create_backend
@@ -159,9 +163,14 @@ def main(argv: list[str] | None = None) -> int:
         obs_directory = Path(args.base_directory) / "obs"
         worker_name = f"worker-{pm.worker_id_to_string(worker.worker_id)}"
         try:
-            worker.span_tracer.export(
-                obs_directory / f"{worker_name}_trace-events.json"
+            # The process-global tracer rides along: render-path spans (the
+            # wavefront driver's per-bounce track) belong in the same file
+            # as this worker's connection + frame-phase rows.
+            export_chrome_trace(
+                obs_directory / f"{worker_name}_trace-events.json",
+                [worker.span_tracer, get_tracer()],
             )
+            get_tracer().clear()
             write_metrics_snapshot(
                 obs_directory / f"{worker_name}_metrics.json", worker.metrics
             )

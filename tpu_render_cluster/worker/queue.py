@@ -50,6 +50,10 @@ class QueuedFrame:
     frame_index: int
     state: FrameState = FrameState.QUEUED
     queued_at: float = field(default_factory=time.time)
+    # Trace context from the master's queue-add request (None from a
+    # reference-shaped master); echoed on rendering/finished events and
+    # routed through the phase spans as a Perfetto flow.
+    trace: pm.TraceContext | None = None
 
 
 class WorkerAutomaticQueue:
@@ -90,8 +94,14 @@ class WorkerAutomaticQueue:
 
     # -- queue interface (called from the message manager) -------------------
 
-    def queue_frame(self, job: BlenderJob, frame_index: int) -> None:
-        self._frames.append(QueuedFrame(job, frame_index))
+    def queue_frame(
+        self,
+        job: BlenderJob,
+        frame_index: int,
+        *,
+        trace: pm.TraceContext | None = None,
+    ) -> None:
+        self._frames.append(QueuedFrame(job, frame_index, trace=trace))
         self._work_available.set()
 
     def unqueue_frame(self, job_name: str, frame_index: int) -> str:
@@ -151,7 +161,9 @@ class WorkerAutomaticQueue:
         frame.state = FrameState.RENDERING
         job_name = frame.job.job_name
         await self._sender.send_message(
-            pm.WorkerFrameQueueItemRenderingEvent(job_name, frame.frame_index)
+            pm.WorkerFrameQueueItemRenderingEvent(
+                job_name, frame.frame_index, trace=frame.trace
+            )
         )
         try:
             timing = await self._backend.render_frame(frame.job, frame.frame_index)
@@ -167,7 +179,7 @@ class WorkerAutomaticQueue:
             self._remove(frame)
             await self._sender.send_message(
                 pm.WorkerFrameQueueItemFinishedEvent.new_errored(
-                    job_name, frame.frame_index, str(e)
+                    job_name, frame.frame_index, str(e), trace=frame.trace
                 )
             )
             return
@@ -176,7 +188,9 @@ class WorkerAutomaticQueue:
         self._remove(frame)
         self._finished_indices.add((job_name, frame.frame_index))
         await self._sender.send_message(
-            pm.WorkerFrameQueueItemFinishedEvent.new_ok(job_name, frame.frame_index)
+            pm.WorkerFrameQueueItemFinishedEvent.new_ok(
+                job_name, frame.frame_index, trace=frame.trace
+            )
         )
 
     def _observe_frame_phases(self, frame: QueuedFrame, timing) -> None:
@@ -200,14 +214,30 @@ class WorkerAutomaticQueue:
             if self._phase_histogram is not None:
                 self._phase_histogram.observe(duration, phase=phase)
             if self._span_tracer is not None:
+                args = {"frame": frame.frame_index}
+                if frame.trace is not None:
+                    args["flow"] = frame.trace.flow_id
                 self._span_tracer.complete(
                     phase,
                     cat="worker",
                     start_wall=start,
                     duration=duration,
                     track="frames",
-                    args={"frame": frame.frame_index},
+                    args=args,
                 )
+                if frame.trace is not None:
+                    # Route the assignment's flow through each phase span
+                    # (mid-span so it binds even to zero-length phases):
+                    # the master's assign span started it; its
+                    # result-received span will terminate it.
+                    self._span_tracer.flow_step(
+                        "frame",
+                        id=frame.trace.flow_id,
+                        ts=start + duration / 2.0,
+                        cat="frame",
+                        track="frames",
+                        args={"frame": frame.frame_index, "phase": phase},
+                    )
         if self._metrics is not None:
             self._metrics.counter(
                 "worker_frames_rendered_total", "Frames rendered successfully"

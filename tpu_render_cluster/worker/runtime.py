@@ -133,6 +133,11 @@ class Worker:
             return pm.decode_message(await client.receive_text())
 
         router = MessageRouter(receive)
+        # Subscribe BEFORE the receive loop can dispatch: the master pings
+        # immediately at registration (seeding its clock-offset estimator),
+        # and an unsubscribed dispatch drops the message — the responder
+        # task's own subscribe would run one scheduling pass too late.
+        heartbeat_queue = router.subscribe(pm.MasterHeartbeatRequest)
         router.start()
 
         frame_queue = WorkerAutomaticQueue(
@@ -146,7 +151,8 @@ class Worker:
         frame_queue.start()
 
         heartbeat_task = asyncio.create_task(
-            self._respond_to_heartbeats(router, sender), name="heartbeats"
+            self._respond_to_heartbeats(heartbeat_queue, sender),
+            name="heartbeats",
         )
         try:
             await self._manage_incoming_messages(router, sender, frame_queue)
@@ -161,21 +167,28 @@ class Worker:
         return self._final_trace
 
     async def _respond_to_heartbeats(
-        self, router: MessageRouter, sender: SenderHandle
+        self, queue: asyncio.Queue, sender: SenderHandle
     ) -> None:
         """Answer pings; record every 8th as a ping trace.
 
-        Reference: worker/src/connection/mod.rs:503-599.
+        Reference: worker/src/connection/mod.rs:503-599. The queue is
+        subscribed by the caller before the router starts, so the master's
+        immediate first ping can never be dropped.
         """
-        queue = router.subscribe(pm.MasterHeartbeatRequest)
         ping_counter = 0
         while True:
             request = await queue.get()
             received_at = time.time()
-            # Every pong carries the compact metrics payload: the master
-            # aggregates a live cluster-wide view with zero extra RPCs.
+            # Every pong carries the compact metrics payload (the master
+            # aggregates a live cluster-wide view with zero extra RPCs)
+            # plus the worker-clock receive/respond timestamps that close
+            # the NTP loop for the master's clock-offset estimator.
             await sender.send_message(
-                pm.WorkerHeartbeatResponse(metrics=self.metrics.to_wire())
+                pm.WorkerHeartbeatResponse(
+                    metrics=self.metrics.to_wire(),
+                    received_at=received_at,
+                    responded_at=time.time(),
+                )
             )
             ping_counter += 1
             if ping_counter % TRACE_EVERY_NTH_PING == 0:
@@ -201,7 +214,9 @@ class Worker:
             while True:
                 request = await add_queue.get()
                 try:
-                    frame_queue.queue_frame(request.job, request.frame_index)
+                    frame_queue.queue_frame(
+                        request.job, request.frame_index, trace=request.trace
+                    )
                     self.tracer.increment_total_queued_frames()
                     response = pm.WorkerFrameQueueAddResponse.new_ok(
                         request.message_request_id
@@ -228,9 +243,22 @@ class Worker:
 
         async def handle_job_started() -> None:
             while True:
-                await started_queue.get()
+                event = await started_queue.get()
                 logger.info("Job started.")
                 self.tracer.set_job_start_time(time.time())
+                # Stamp the span timeline with the job's trace id (when the
+                # master piggybacked one) so multi-job worker artifacts can
+                # be partitioned by run.
+                self.span_tracer.instant(
+                    "job started",
+                    cat="worker",
+                    track="job",
+                    args=(
+                        {"trace_id": f"{event.trace_id:016x}"}
+                        if event.trace_id is not None
+                        else None
+                    ),
+                )
 
         async def handle_job_finished() -> None:
             request = await finished_queue.get()
@@ -238,8 +266,23 @@ class Worker:
             self.tracer.set_job_finish_time(time.time())
             trace = self.tracer.build()
             self._final_trace = trace
+            # Piggyback this worker's Chrome span timeline on the response:
+            # every frame is finished by now, so the phase spans (and their
+            # flow steps) are all recorded, and the master can assemble the
+            # merged cluster timeline without another RPC.
+            span_events = {
+                "process_name": self.span_tracer.process_name,
+                "events": self.span_tracer.metadata_events()
+                + self.span_tracer.events(),
+            }
+            if self.span_tracer.dropped:
+                # Truncation must stay visible across the wire: the master
+                # records it in the merged document's otherData.
+                span_events["dropped"] = self.span_tracer.dropped
             await sender.send_message(
-                pm.WorkerJobFinishedResponse(request.message_request_id, trace)
+                pm.WorkerJobFinishedResponse(
+                    request.message_request_id, trace, span_events=span_events
+                )
             )
             job_done.set()
 
