@@ -72,11 +72,14 @@ EXPECTED_WIRE_TAGS = {
     pm.MasterJobStartedEvent: "event_job-started",
     pm.MasterJobFinishedRequest: "request_job-finished",
     pm.WorkerJobFinishedResponse: "response_job-finished",
+    # Beyond-reference extension (graceful drain); C++ peers may ignore it.
+    pm.WorkerGoodbyeEvent: "event_worker-goodbye",
 }
 
 
-def test_all_14_wire_tags_exact():
-    assert len(pm.ALL_MESSAGE_TYPES) == 14
+def test_all_wire_tags_exact():
+    # The reference's 14 messages plus the goodbye drain extension.
+    assert len(pm.ALL_MESSAGE_TYPES) == 15
     for cls, tag in EXPECTED_WIRE_TAGS.items():
         assert cls.type_name == tag
 
@@ -100,6 +103,13 @@ def all_example_messages() -> list[pm.Message]:
         pm.WorkerFrameQueueItemFinishedEvent.new_errored(job.job_name, 5, "render failed"),
         pm.MasterHeartbeatRequest(1234.5),
         pm.WorkerHeartbeatResponse(),
+        pm.WorkerHeartbeatResponse(
+            received_at=1234.6, responded_at=1234.7, echo_request_time=1234.5
+        ),
+        pm.WorkerGoodbyeEvent(),
+        pm.WorkerGoodbyeEvent(
+            reason="drain", job_name=job.job_name, returned_frames=(3, 4, 9)
+        ),
         pm.MasterJobStartedEvent(),
         pm.MasterJobFinishedRequest(99),
         pm.WorkerJobFinishedResponse(99, make_trace()),

@@ -266,3 +266,99 @@ def test_server_connection_swap():
         server.close()
 
     run(scenario())
+
+
+def test_backoff_full_jitter_and_env_overrides(monkeypatch):
+    # Satellite of the chaos PR: backoff delays are full-jitter
+    # (uniform(0, min(cap, base**attempt))) so a mass disconnect cannot
+    # reconnect in lockstep, and the caps are TRC_*-env-configurable.
+    from tpu_render_cluster.transport import reconnect
+
+    calls = []
+
+    def recording_uniform(lo, hi):
+        calls.append((lo, hi))
+        return 0.0  # don't actually sleep
+
+    monkeypatch.setattr(reconnect.random, "uniform", recording_uniform)
+    monkeypatch.setenv("TRC_MAX_CONNECT_RETRIES", "3")
+    monkeypatch.setenv("TRC_BACKOFF_BASE", "2.0")
+    monkeypatch.setenv("TRC_BACKOFF_CAP_SECONDS", "1.5")
+
+    async def scenario():
+        with pytest.raises(WebSocketClosed) as error:
+            await connect_with_exponential_backoff("127.0.0.1", 1)
+        assert "after 3 retries" in str(error.value)
+
+    run(scenario())
+    # One jitter draw per retry, each bounded by min(cap, base**attempt).
+    assert calls == [(0.0, 1.0), (0.0, 1.5), (0.0, 1.5)]
+
+
+def test_transport_knobs_read_env(monkeypatch):
+    from tpu_render_cluster.transport import reconnect
+
+    monkeypatch.setenv("TRC_OP_DEADLINE_SECONDS", "12.5")
+    monkeypatch.setenv("TRC_MAX_RECONNECTS_PER_OP", "7")
+    assert reconnect.op_deadline_seconds() == 12.5
+    assert reconnect.max_reconnects_per_op() == 7
+    monkeypatch.setenv("TRC_OP_DEADLINE_SECONDS", "not-a-number")
+    assert reconnect.op_deadline_seconds() == reconnect.OP_DEADLINE_SECONDS
+
+
+def test_reconnect_outage_window_stamped_from_failure_time():
+    # Satellite of the chaos PR: ``lost_at`` must be the failing op's
+    # FIRST exception time. Here op A fails, holds the reconnect lock for
+    # a 0.3 s FAILED reconnect; op B (which failed at the same moment)
+    # then performs the successful reconnect — and must record the outage
+    # from its own failure time, not from when it finally got the lock.
+    import time as time_mod
+
+    class _DeadConnection:
+        is_closed = False
+
+        def abort(self):
+            pass
+
+        async def send_text(self, text):
+            raise WebSocketClosed("dead")
+
+    class _GoodConnection:
+        is_closed = False
+
+        def abort(self):
+            pass
+
+        async def send_text(self, text):
+            return None
+
+    async def scenario():
+        attempts = {"n": 0}
+
+        async def reconnect_fn():
+            attempts["n"] += 1
+            if attempts["n"] == 1:
+                await asyncio.sleep(0.3)
+                raise WebSocketClosed("master still down")
+            return _GoodConnection()
+
+        windows = []
+        client = ReconnectingClient(
+            _DeadConnection(),
+            reconnect_fn,
+            on_reconnect=lambda lost, restored: windows.append((lost, restored)),
+        )
+        start = time_mod.time()
+        results = await asyncio.gather(
+            client.send_text("a"), client.send_text("b"), return_exceptions=True
+        )
+        # One op died with the failed first reconnect; the other recovered.
+        assert sum(1 for r in results if isinstance(r, WebSocketClosed)) == 1
+        assert len(windows) == 1
+        lost_at, restored_at = windows[0]
+        # Stamped at the op's failure (~start), NOT at lock acquisition
+        # (~start + 0.3 s, after the failed reconnect released the lock).
+        assert lost_at - start < 0.15
+        assert restored_at - start >= 0.28
+
+    run(scenario())

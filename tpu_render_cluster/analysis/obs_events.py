@@ -278,6 +278,65 @@ def summarize_wavefront(metrics: list[dict[str, Any]]) -> dict[str, Any] | None:
     return out
 
 
+_CHAOS_LEDGER_COUNTERS = (
+    "master_frame_results_total",
+    "master_duplicate_results_total",
+    "master_late_results_total",
+    "master_stale_results_total",
+    "master_worker_evictions_total",
+    "master_worker_drains_total",
+)
+
+
+def accumulate_chaos_fault_counts(
+    registry_snapshot: dict[str, Any], into: dict[str, float]
+) -> dict[str, float]:
+    """Fold one registry snapshot's ``chaos_faults_injected_total`` series
+    into ``into`` keyed by fault kind. Single definition site — the chaos
+    runner's live report and this module's statistics.json section must
+    parse the series labels identically."""
+    entry = registry_snapshot.get("chaos_faults_injected_total")
+    if entry:
+        for label, value in entry.get("series", {}).items():
+            kind = label.partition("=")[2] or label
+            into[kind] = into.get(kind, 0.0) + float(value)
+    return into
+
+
+def summarize_chaos(metrics: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """Roll the fault-injection evidence up (chaos/ engine artifacts).
+
+    Aggregates ``chaos_faults_injected_total`` (what was done to the
+    cluster) across every registry family a snapshot carries, plus the
+    master's exactly-once ledger counters (what the cluster did about it).
+    None when no snapshot shows any injected fault — ordinary runs get no
+    ``chaos`` section even though the ledger counters exist.
+    """
+    faults: dict[str, float] = {}
+    ledger: dict[str, dict[str, float]] = {}
+
+    def take_registry(names: dict[str, Any]) -> None:
+        accumulate_chaos_fault_counts(names, faults)
+        for counter in _CHAOS_LEDGER_COUNTERS:
+            counter_entry = names.get(counter)
+            if not counter_entry:
+                continue
+            sink = ledger.setdefault(counter, {})
+            for label, value in counter_entry.get("series", {}).items():
+                sink[label or "total"] = sink.get(label or "total", 0.0) + float(
+                    value
+                )
+
+    for snapshot in metrics:
+        take_registry(snapshot.get("metrics", {}))
+        for worker_registry in (snapshot.get("workers") or {}).values():
+            if isinstance(worker_registry, dict):
+                take_registry(worker_registry)
+    if not faults:
+        return None
+    return {"faults_injected": faults, "ledger": ledger}
+
+
 def summarize_obs(
     traces: list[ObsTrace],
     metrics: list[dict[str, Any]],
@@ -317,6 +376,9 @@ def summarize_obs(
     wavefront = summarize_wavefront(metrics)
     if wavefront is not None:
         out["wavefront"] = wavefront
+    chaos = summarize_chaos(metrics)
+    if chaos is not None:
+        out["chaos"] = chaos
     if cluster_traces:
         from tpu_render_cluster.analysis.critical_path import (
             summarize_critical_path,

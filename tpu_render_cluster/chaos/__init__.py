@@ -1,0 +1,28 @@
+"""Deterministic chaos engine: seeded fault injection + exactly-once audit.
+
+- ``plan`` — ``FaultPlan``: a reproducible, PCG-seeded schedule of
+  transport / worker / master faults (env/TOML configurable);
+- ``inject`` — the executors that turn plan events into runtime behavior
+  at the three seams (``FaultyConnection`` wrapping, backend hooks, the
+  master dispatch-delay shim);
+- ``invariants`` — the exactly-once audit a faulted run must pass;
+- ``runner`` — the harness (and ``python -m tpu_render_cluster.chaos.runner``
+  CLI) that runs a real in-process cluster under a plan and audits it.
+"""
+
+from tpu_render_cluster.chaos.inject import MasterChaosHooks, WorkerChaosController
+from tpu_render_cluster.chaos.invariants import check_invariants, ledger_stats
+from tpu_render_cluster.chaos.plan import ChaosTimings, FaultEvent, FaultPlan
+from tpu_render_cluster.chaos.runner import ChaosReport, run_chaos_job
+
+__all__ = [
+    "ChaosReport",
+    "ChaosTimings",
+    "FaultEvent",
+    "FaultPlan",
+    "MasterChaosHooks",
+    "WorkerChaosController",
+    "check_invariants",
+    "ledger_stats",
+    "run_chaos_job",
+]

@@ -1,0 +1,332 @@
+"""Plan-driven fault executors.
+
+Two controllers turn a ``FaultPlan``'s pure-data events into runtime
+behavior, one per side of the cluster link:
+
+- ``WorkerChaosController`` — one per worker slot. It is simultaneously
+  the ``FaultController`` behind that worker's ``FaultyConnection``
+  (transport faults), the hook consulted by ``FaultyBackend`` (render
+  faults), and the owner of a watchdog coroutine that fires the
+  time-triggered faults (partitions, drains).
+- ``MasterChaosHooks`` — the master-side dispatch-delay shim, keyed by
+  worker id once the runner has mapped slots to live workers.
+
+Every injected fault increments ``chaos_faults_injected_total{kind=...}``
+in the owning component's metrics registry, so run artifacts (and the
+``chaos`` section of statistics.json) record exactly what was done to the
+cluster alongside what the cluster did about it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import TYPE_CHECKING, Callable
+
+from tpu_render_cluster.chaos.plan import (
+    FINISHED_EVENT_TYPE,
+    KIND_CRASH_AFTER_RESULT,
+    KIND_CRASH_BEFORE_RESULT,
+    KIND_DELAY_DISPATCH,
+    KIND_DELAY_SEND,
+    KIND_DRAIN,
+    KIND_DROP_SEND,
+    KIND_DUPLICATE_SEND,
+    KIND_HANG,
+    KIND_KILL_SOCKET,
+    KIND_PARTITION,
+    KIND_SLOW_RENDER,
+    FaultEvent,
+    FaultPlan,
+)
+from tpu_render_cluster.transport.faults import (
+    PASS_DECISION,
+    SEND_ACTION_DROP,
+    SEND_ACTION_DUPLICATE,
+    SEND_ACTION_KILL,
+    FaultyConnection,
+    SendDecision,
+)
+from tpu_render_cluster.transport.ws import WebSocketClosed, WebSocketConnection
+
+if TYPE_CHECKING:
+    from tpu_render_cluster.obs import MetricsRegistry
+
+logger = logging.getLogger(__name__)
+
+_SEND_KINDS = (
+    KIND_DROP_SEND,
+    KIND_DELAY_SEND,
+    KIND_DUPLICATE_SEND,
+    KIND_KILL_SOCKET,
+)
+_TIMED_KINDS = (KIND_PARTITION, KIND_DRAIN)
+
+
+class _Pending:
+    """One schedulable fault instance with its own match counter."""
+
+    def __init__(self, event: FaultEvent) -> None:
+        self.event = event
+        self.seen = 0
+        self.consumed = False
+
+    def matches(self, text: str) -> bool:
+        match = self.event.match_message_type
+        return match is None or f'"message_type":"{match}"' in text
+
+
+def _payload_frame_index(text: str) -> int | None:
+    try:
+        payload = json.loads(text).get("payload", {})
+        index = payload.get("frame_index")
+        return None if index is None else int(index)
+    except (ValueError, AttributeError):
+        return None
+
+
+class WorkerChaosController:
+    """Fault executor for one worker slot."""
+
+    def __init__(
+        self,
+        slot: int,
+        events: tuple[FaultEvent, ...],
+        *,
+        registry: "MetricsRegistry | None" = None,
+    ) -> None:
+        self.slot = slot
+        self._events = events
+        self._registry = registry
+        self._send_faults = [_Pending(e) for e in events if e.kind in _SEND_KINDS]
+        self._render_faults = [
+            _Pending(e)
+            for e in events
+            if e.kind in (KIND_CRASH_BEFORE_RESULT, KIND_CRASH_AFTER_RESULT, KIND_HANG)
+        ]
+        self._slow_multiplier = 1.0
+        self._slow_counted = False
+        for event in events:
+            if event.kind == KIND_SLOW_RENDER:
+                self._slow_multiplier *= max(1.0, event.multiplier)
+        self.killed = False
+        self.silent = False
+        self._partition_until = 0.0
+        self._kill_after_frame: int | None = None
+        self._current: FaultyConnection | None = None
+        self._worker = None
+        self._cancel_worker: Callable[[], None] | None = None
+
+    # -- wiring (chaos/runner.py) -------------------------------------------
+
+    def attach(self, worker, cancel_worker: Callable[[], None]) -> None:
+        """Give the controller its live worker + a task-cancel callback."""
+        self._worker = worker
+        self._cancel_worker = cancel_worker
+
+    def wrap_connection(self, ws: WebSocketConnection) -> FaultyConnection:
+        """The ``wrap`` hook for ``connect_with_exponential_backoff``."""
+        self.check_gate(raw=ws)
+        connection = FaultyConnection(ws, self)
+        self._current = connection
+        return connection
+
+    async def run_timed_faults(self) -> None:
+        """Fire partitions/drains at their scheduled offsets (watchdog)."""
+        loop = asyncio.get_running_loop()
+        start = loop.time()
+        for event in sorted(
+            (e for e in self._events if e.kind in _TIMED_KINDS),
+            key=lambda e: e.at_seconds,
+        ):
+            await asyncio.sleep(max(0.0, start + event.at_seconds - loop.time()))
+            if event.kind == KIND_PARTITION:
+                self._count(KIND_PARTITION)
+                logger.info(
+                    "chaos: partitioning slot %d for %.2f s",
+                    self.slot,
+                    event.duration_seconds,
+                )
+                self._partition_until = loop.time() + event.duration_seconds
+                if self._current is not None:
+                    self._current.abort()
+            elif event.kind == KIND_DRAIN:
+                self._count(KIND_DRAIN)
+                logger.info("chaos: draining slot %d", self.slot)
+                if self._worker is not None:
+                    self._worker.request_drain()
+
+    # -- FaultController (transport/faults.py) ------------------------------
+
+    def check_gate(self, raw: WebSocketConnection | None = None) -> None:
+        loop = asyncio.get_running_loop()
+        if self.killed or self.silent or loop.time() < self._partition_until:
+            if raw is not None:
+                raw.abort()
+            reason = "worker killed" if self.killed or self.silent else "partition"
+            raise WebSocketClosed(f"chaos: {reason} (slot {self.slot})")
+
+    def on_send(self, text: str) -> SendDecision:
+        # Every matching fault's ordinal counter advances on every match —
+        # even when another fault fires first on this message — so each
+        # nth trigger lands exactly where the plan's schedule declares.
+        # One fault acts per send (first in schedule order); a fault whose
+        # ordinal was reached on a message another consumed fires on the
+        # next match (hence >=).
+        fired: _Pending | None = None
+        for pending in self._send_faults:
+            if pending.consumed or not pending.matches(text):
+                continue
+            pending.seen += 1
+            if fired is None and pending.seen >= pending.event.nth:
+                fired = pending
+        if fired is None:
+            return PASS_DECISION
+        fired.consumed = True
+        kind = fired.event.kind
+        self._count(kind)
+        logger.info("chaos: %s fired on slot %d", kind, self.slot)
+        if kind == KIND_DROP_SEND:
+            return SendDecision(SEND_ACTION_DROP)
+        if kind == KIND_DUPLICATE_SEND:
+            return SendDecision(SEND_ACTION_DUPLICATE)
+        if kind == KIND_KILL_SOCKET:
+            return SendDecision(SEND_ACTION_KILL)
+        return SendDecision(delay_seconds=fired.event.duration_seconds)
+
+    def after_send(self, text: str) -> None:
+        if self._kill_after_frame is None:
+            return
+        if f'"message_type":"{FINISHED_EVENT_TYPE}"' not in text:
+            return
+        if _payload_frame_index(text) != self._kill_after_frame:
+            return
+        self._kill_after_frame = None
+        self.kill_now(KIND_CRASH_AFTER_RESULT)
+
+    # -- FaultyBackend hooks (worker/backends/chaos.py) ----------------------
+
+    def render_multiplier(self) -> float:
+        if self._slow_multiplier > 1.0 and not self._slow_counted:
+            self._slow_counted = True
+            self._count(KIND_SLOW_RENDER)
+        return self._slow_multiplier
+
+    def note_render_start(self, frame_index: int, ordinal: int) -> None:
+        for pending in self._render_faults:
+            if (
+                not pending.consumed
+                and pending.event.kind == KIND_CRASH_BEFORE_RESULT
+                and ordinal == pending.event.nth
+            ):
+                pending.consumed = True
+                self.kill_now(KIND_CRASH_BEFORE_RESULT)
+
+    def note_render_done(self, frame_index: int, ordinal: int) -> None:
+        for pending in self._render_faults:
+            if (
+                not pending.consumed
+                and pending.event.kind == KIND_CRASH_AFTER_RESULT
+                and ordinal == pending.event.nth
+            ):
+                pending.consumed = True
+                # Armed: the kill fires the instant the finished event for
+                # this frame clears the socket (after_send above) — "crash
+                # after sending a frame result", with zero timing slack.
+                self._kill_after_frame = frame_index
+
+    def should_hang(self, ordinal: int) -> bool:
+        for pending in self._render_faults:
+            if (
+                not pending.consumed
+                and pending.event.kind == KIND_HANG
+                and ordinal == pending.event.nth
+            ):
+                pending.consumed = True
+                self._count(KIND_HANG)
+                logger.info("chaos: hanging slot %d", self.slot)
+                self.silent = True
+                if self._current is not None:
+                    self._current.abort()
+                return True
+        return False
+
+    # -- kill mechanics ------------------------------------------------------
+
+    def kill_now(self, kind: str) -> None:
+        """Crash the worker: dead socket, no reconnect, task cancelled."""
+        if self.killed:
+            return
+        self.killed = True
+        self._count(kind)
+        logger.info("chaos: killing slot %d (%s)", self.slot, kind)
+        if self._current is not None:
+            self._current.abort()
+        if self._cancel_worker is not None:
+            self._cancel_worker()
+
+    def _count(self, kind: str) -> None:
+        if self._registry is not None:
+            self._registry.counter(
+                "chaos_faults_injected_total",
+                "Faults the chaos engine injected, by kind",
+                labels=("kind",),
+            ).inc(kind=kind)
+
+
+class MasterChaosHooks:
+    """Master-side faults: the assignment dispatch-delay shim.
+
+    ``dispatch_delay`` is handed to ``ClusterManager`` and consulted at
+    the top of every ``WorkerHandle.queue_frame``; it returns how long to
+    stall that dispatch (0.0 almost always). Slot mapping arrives late —
+    worker ids are random — via ``map_worker``.
+    """
+
+    def __init__(
+        self, plan: FaultPlan, *, registry: "MetricsRegistry | None" = None
+    ) -> None:
+        self._registry = registry
+        self._pending_by_slot: dict[int, list[_Pending]] = {}
+        for event in plan.events:
+            if event.kind == KIND_DELAY_DISPATCH:
+                self._pending_by_slot.setdefault(event.target, []).append(
+                    _Pending(event)
+                )
+        self._slot_by_worker_id: dict[int, int] = {}
+
+    def map_worker(self, worker_id: int, slot: int) -> None:
+        self._slot_by_worker_id[worker_id] = slot
+
+    def dispatch_delay(self, worker_id: int, frame_index: int) -> float:
+        slot = self._slot_by_worker_id.get(worker_id)
+        if slot is None:
+            return 0.0
+        # Same ordinal contract as WorkerChaosController.on_send: every
+        # pending fault's counter advances on every dispatch, one fault
+        # acts per dispatch, and a fault whose ordinal was reached while
+        # another fired acts on the next dispatch (hence >=).
+        fired: _Pending | None = None
+        for pending in self._pending_by_slot.get(slot, []):
+            if pending.consumed:
+                continue
+            pending.seen += 1
+            if fired is None and pending.seen >= pending.event.nth:
+                fired = pending
+        if fired is None:
+            return 0.0
+        fired.consumed = True
+        if self._registry is not None:
+            self._registry.counter(
+                "chaos_faults_injected_total",
+                "Faults the chaos engine injected, by kind",
+                labels=("kind",),
+            ).inc(kind=KIND_DELAY_DISPATCH)
+        logger.info(
+            "chaos: delaying dispatch of frame %d to slot %d by %.2f s",
+            frame_index,
+            slot,
+            fired.event.duration_seconds,
+        )
+        return fired.event.duration_seconds

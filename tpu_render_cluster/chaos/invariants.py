@@ -1,0 +1,156 @@
+"""Exactly-once invariants a cluster must hold under any fault schedule.
+
+``check_invariants`` inspects a finished run (the live ``ClusterManager``
+plus its metrics) against the plan that tormented it and returns a list of
+human-readable violations (empty = the cluster survived correctly):
+
+1.  **Completion** — every frame reached FINISHED and the O(1) finished
+    counter agrees with the frame table.
+2.  **Exactly-once ledger** — ``ok_results - duplicate_results`` equals
+    the frame count: every frame was counted finished exactly once, and
+    every extra ok delivery (duplicated send, late result from an evicted
+    worker whose frame was re-rendered elsewhere) was explicitly absorbed
+    by the dedup path rather than double-counted.
+3.  **No ghost assignments** — no worker handle (dead or alive) still
+    mirrors a frame: eviction, drain, steals, and finished events must
+    between them sweep every queue mirror clean.
+4.  **Eviction/drain accounting** — ``master_worker_evictions_total`` and
+    ``master_worker_drains_total`` match exactly what the plan injected:
+    kills and wedges evict, drains drain, and nothing else (a healed
+    partition, a straggler, a duplicated send) may cost a worker.
+5.  **Duplicate visibility** — when the plan duplicated a result send,
+    the dedup counter must show it was seen and absorbed.
+6.  **Trace validity** — the merged cluster timeline (when given) holds
+    every structural invariant in ``obs/validate.py``: even a run that
+    lost workers mid-flight must export a Perfetto file whose flows all
+    resolve.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Any
+
+from tpu_render_cluster.chaos.plan import KIND_DUPLICATE_SEND, FaultPlan
+from tpu_render_cluster.master.state import FrameStatus
+
+if TYPE_CHECKING:
+    from tpu_render_cluster.master.cluster import ClusterManager
+
+__all__ = ["check_invariants", "counter_total", "ledger_stats"]
+
+
+def counter_total(
+    snapshot: dict[str, Any], name: str, label: str | None = None
+) -> float:
+    """Sum a counter's series from a ``MetricsRegistry.snapshot()`` dict."""
+    entry = snapshot.get(name)
+    if not entry:
+        return 0.0
+    series = entry.get("series", {})
+    if label is not None:
+        return float(series.get(label, 0.0))
+    return sum(float(v) for v in series.values())
+
+
+def ledger_stats(snapshot: dict[str, Any]) -> dict[str, float]:
+    """The master-side exactly-once ledger, as one flat dict."""
+    return {
+        "ok_results": counter_total(
+            snapshot, "master_frame_results_total", "result=ok"
+        ),
+        "errored_results": counter_total(
+            snapshot, "master_frame_results_total", "result=errored"
+        ),
+        "duplicate_results": counter_total(
+            snapshot, "master_duplicate_results_total"
+        ),
+        "late_results": counter_total(snapshot, "master_late_results_total"),
+        "stale_results": counter_total(snapshot, "master_stale_results_total"),
+        "evictions": counter_total(snapshot, "master_worker_evictions_total"),
+        "drains": counter_total(snapshot, "master_worker_drains_total"),
+    }
+
+
+def check_invariants(
+    manager: "ClusterManager",
+    plan: FaultPlan,
+    *,
+    cluster_trace_document: Any | None = None,
+) -> list[str]:
+    violations: list[str] = []
+    state = manager.state
+    total = len(state.frames)
+
+    unfinished = sorted(
+        index
+        for index, record in state.frames.items()
+        if record.status is not FrameStatus.FINISHED
+    )
+    if unfinished:
+        violations.append(
+            f"completion: {len(unfinished)} frame(s) not FINISHED: "
+            f"{unfinished[:10]}"
+        )
+    if state.finished_count() != total:
+        violations.append(
+            f"completion: finished_count {state.finished_count()} != "
+            f"frame table size {total}"
+        )
+
+    snapshot = manager.metrics.snapshot()
+    ledger = ledger_stats(snapshot)
+    delivered_once = ledger["ok_results"] - ledger["duplicate_results"]
+    if delivered_once != total:
+        violations.append(
+            "exactly-once: ok_results - duplicate_results = "
+            f"{ledger['ok_results']:.0f} - {ledger['duplicate_results']:.0f} "
+            f"= {delivered_once:.0f}, expected {total} (frame table size)"
+        )
+
+    for worker in manager.workers.values():
+        if len(worker.queue) > 0:
+            ghosts = sorted(f.frame_index for f in worker.queue.all_frames())
+            violations.append(
+                f"ghost assignments: worker {worker.worker_id:08x} "
+                f"({'dead' if worker.is_dead else 'alive'}) still mirrors "
+                f"frame(s) {ghosts[:10]}"
+            )
+
+    expected_evictions = plan.expected_evictions()
+    if ledger["evictions"] != expected_evictions:
+        violations.append(
+            f"evictions: master_worker_evictions_total = "
+            f"{ledger['evictions']:.0f}, plan injected {expected_evictions} "
+            f"eviction-causing fault(s)"
+        )
+    expected_drains = plan.expected_drains()
+    if ledger["drains"] != expected_drains:
+        violations.append(
+            f"drains: master_worker_drains_total = {ledger['drains']:.0f}, "
+            f"plan injected {expected_drains} drain(s)"
+        )
+    drained_handles = sum(
+        1 for worker in manager.workers.values() if worker.drained
+    )
+    if drained_handles != expected_drains:
+        violations.append(
+            f"drains: {drained_handles} worker handle(s) took the goodbye "
+            f"path, plan injected {expected_drains} drain(s) — a drain "
+            f"collapsed into an eviction (or vice versa)"
+        )
+
+    if KIND_DUPLICATE_SEND in plan.kinds() and ledger["duplicate_results"] < 1:
+        violations.append(
+            "duplicate visibility: plan duplicated a result send but "
+            "master_duplicate_results_total is 0 — the duplicate was never "
+            "seen (or was double-counted as a fresh finish)"
+        )
+
+    if cluster_trace_document is not None:
+        from tpu_render_cluster.obs import validate_trace_document
+
+        problems = validate_trace_document(cluster_trace_document)
+        for problem in problems[:10]:
+            violations.append(f"cluster trace: {problem}")
+
+    return violations

@@ -1,0 +1,355 @@
+"""The chaos harness: run a real in-process cluster under a fault plan.
+
+Wraps ``harness/local.py`` — the full production stack (accepting server,
+3-step handshake, heartbeats, real distribution strategies, real
+WebSockets on localhost) — with the plan's fault executors wired into the
+three seams: ``FaultyConnection`` under each worker's reconnecting client,
+``FaultyBackend`` around each mock renderer, and the dispatch-delay shim
+inside the master's worker handles. After the job completes (and it MUST
+complete — that is invariant #1) the run is audited by
+``chaos/invariants.py`` and its obs artifacts are exported like any other
+run's, so the merged cluster timeline of a faulted job can be validated
+and eyeballed in Perfetto.
+
+Timeout compression: production heartbeat/backoff budgets (10 s pings,
+60 s pong windows) would stretch each scenario to minutes, so the run
+executes under the plan's ``ChaosTimings`` via the same ``TRC_*``
+overrides a deployment would use, restored afterwards.
+
+CLI::
+
+    python -m tpu_render_cluster.chaos.runner --seed 7 --workers 3 \
+        [--frames 24] [--plan plan.toml] [--results-directory DIR]
+
+exits non-zero if any invariant is violated, and prints the report (plan
+fingerprint, injected faults, the master's exactly-once ledger) as JSON.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+from tpu_render_cluster.chaos.inject import MasterChaosHooks, WorkerChaosController
+from tpu_render_cluster.chaos.invariants import (
+    check_invariants,
+    counter_total,
+    ledger_stats,
+)
+from tpu_render_cluster.chaos.plan import FaultPlan
+from tpu_render_cluster.harness import local as local_harness
+from tpu_render_cluster.jobs.models import (
+    BlenderJob,
+    DistributionStrategy,
+    DynamicStrategyOptions,
+)
+from tpu_render_cluster.master.cluster import ClusterManager
+from tpu_render_cluster.obs import MetricsRegistry
+from tpu_render_cluster.worker.backends.chaos import FaultyBackend
+from tpu_render_cluster.worker.backends.mock import MockBackend
+from tpu_render_cluster.worker.runtime import Worker
+
+DEFAULT_FRAMES = 24
+DEFAULT_RENDER_SECONDS = 0.12
+
+
+@dataclass
+class ChaosReport:
+    """Everything a chaos run produced: schedule, audit, ledger."""
+
+    plan: FaultPlan
+    violations: list[str]
+    stats: dict[str, Any]
+    artifacts: dict[str, str] = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "plan": self.plan.to_dict(),
+            "fingerprint": self.plan.fingerprint(),
+            "ok": self.ok,
+            "violations": self.violations,
+            "stats": self.stats,
+            "artifacts": self.artifacts,
+        }
+
+
+def _make_job(plan: FaultPlan, frames: int, strategy) -> BlenderJob:
+    if strategy is None:
+        # Dynamic (work-stealing) by default: the strategy with the most
+        # fault-sensitive moving parts — steals race evictions, queue
+        # mirrors drive victim selection.
+        strategy = DistributionStrategy.dynamic_strategy(
+            DynamicStrategyOptions(
+                target_queue_size=3,
+                min_queue_size_to_steal=1,
+                min_seconds_before_resteal_to_elsewhere=1,
+                min_seconds_before_resteal_to_original_worker=2,
+            )
+        )
+    return BlenderJob(
+        job_name=f"chaos-seed-{plan.seed}",
+        job_description=f"chaos run (plan {plan.fingerprint()})",
+        project_file_path="%BASE%/p.blend",
+        render_script_path="%BASE%/s.py",
+        frame_range_from=1,
+        frame_range_to=frames,
+        wait_for_number_of_workers=plan.workers,
+        frame_distribution_strategy=strategy,
+        output_directory_path="%BASE%/out",
+        output_file_name_format="rendered-#####",
+        output_file_format="PNG",
+    )
+
+
+@contextmanager
+def _timing_overrides(timings):
+    """Apply the plan's compressed timeout profile; restore on exit.
+
+    Uses exactly the tuning surface a deployment has: the ``TRC_*``
+    environment overrides plus the two heartbeat module constants and the
+    master's reconnect-wait class attribute.
+    """
+    from tpu_render_cluster.master import worker_handle as wh
+    from tpu_render_cluster.transport.reconnect import (
+        ReconnectableServerConnection,
+    )
+
+    env = {
+        "TRC_BACKOFF_BASE": str(timings.backoff_base),
+        "TRC_BACKOFF_CAP_SECONDS": str(timings.backoff_cap_seconds),
+        "TRC_MAX_CONNECT_RETRIES": str(timings.max_connect_retries),
+        "TRC_MAX_RECONNECTS_PER_OP": str(timings.max_reconnects_per_op),
+        "TRC_OP_DEADLINE_SECONDS": str(timings.op_deadline_seconds),
+        "TRC_SEND_DEADLINE_SECONDS": str(timings.send_deadline_seconds),
+        "TRC_RPC_DEADLINE_SECONDS": str(timings.rpc_deadline_seconds),
+        "TRC_HEARTBEAT_PONG_RETRIES": str(timings.heartbeat_pong_retries),
+    }
+    saved_env = {name: os.environ.get(name) for name in env}
+    saved_interval = wh.HEARTBEAT_INTERVAL_SECONDS
+    saved_timeout = wh.HEARTBEAT_RESPONSE_TIMEOUT
+    saved_wait = ReconnectableServerConnection.MAX_WAIT_FOR_RECONNECT
+    os.environ.update(env)
+    wh.HEARTBEAT_INTERVAL_SECONDS = timings.heartbeat_interval
+    wh.HEARTBEAT_RESPONSE_TIMEOUT = timings.heartbeat_response_timeout
+    ReconnectableServerConnection.MAX_WAIT_FOR_RECONNECT = (
+        timings.max_wait_for_reconnect
+    )
+    try:
+        yield
+    finally:
+        for name, value in saved_env.items():
+            if value is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = value
+        wh.HEARTBEAT_INTERVAL_SECONDS = saved_interval
+        wh.HEARTBEAT_RESPONSE_TIMEOUT = saved_timeout
+        ReconnectableServerConnection.MAX_WAIT_FOR_RECONNECT = saved_wait
+
+
+async def _chaos_run(
+    job: BlenderJob,
+    backends: list[FaultyBackend],
+    controllers: list[WorkerChaosController],
+    hooks: MasterChaosHooks,
+    registries: list[MetricsRegistry],
+    master_registry: MetricsRegistry,
+):
+    watchdogs: list[asyncio.Task] = []
+
+    async def on_cluster_started(manager, workers, worker_tasks) -> None:
+        for slot, worker in enumerate(workers):
+            hooks.map_worker(worker.worker_id, slot)
+            controllers[slot].attach(worker, worker_tasks[slot].cancel)
+            watchdogs.append(
+                asyncio.create_task(
+                    controllers[slot].run_timed_faults(),
+                    name=f"chaos-watchdog-{slot}",
+                )
+            )
+
+    try:
+        return await local_harness._run(
+            job,
+            backends,
+            manager_factory=lambda job: ClusterManager(
+                "127.0.0.1",
+                0,
+                job,
+                metrics=master_registry,
+                dispatch_delay_fn=hooks.dispatch_delay,
+            ),
+            worker_factory=lambda slot, port, backend: Worker(
+                "127.0.0.1",
+                port,
+                backend,
+                metrics=registries[slot],
+                connection_wrapper=controllers[slot].wrap_connection,
+            ),
+            on_cluster_started=on_cluster_started,
+            # Killed/hung workers never exit on their own (the master
+            # skips dead workers at trace collection); reap them.
+            worker_grace=3.0,
+            allow_worker_failures=True,
+        )
+    finally:
+        for watchdog in watchdogs:
+            watchdog.cancel()
+        await asyncio.gather(*watchdogs, return_exceptions=True)
+
+
+def _aggregate_fault_counts(
+    registries: list[MetricsRegistry], master_registry: MetricsRegistry
+) -> dict[str, float]:
+    from tpu_render_cluster.analysis.obs_events import (
+        accumulate_chaos_fault_counts,
+    )
+
+    out: dict[str, float] = {}
+    for registry in [*registries, master_registry]:
+        accumulate_chaos_fault_counts(registry.snapshot(), out)
+    return out
+
+
+def run_chaos_job(
+    plan: FaultPlan,
+    *,
+    frames: int = DEFAULT_FRAMES,
+    strategy=None,
+    results_directory: str | Path | None = None,
+    render_seconds: float = DEFAULT_RENDER_SECONDS,
+    timeout: float = 180.0,
+) -> ChaosReport:
+    """Run one seeded chaos job end to end and audit the invariants."""
+    job = _make_job(plan, frames, strategy)
+    registries = [MetricsRegistry() for _ in range(plan.workers)]
+    controllers = [
+        WorkerChaosController(slot, plan.events_for(slot), registry=registries[slot])
+        for slot in range(plan.workers)
+    ]
+    master_registry = MetricsRegistry()
+    hooks = MasterChaosHooks(plan, registry=master_registry)
+    backends = [
+        FaultyBackend(
+            MockBackend(
+                load_seconds=0.004,
+                save_seconds=0.004,
+                render_seconds=render_seconds,
+            ),
+            controllers[slot],
+        )
+        for slot in range(plan.workers)
+    ]
+    started = time.time()
+    with _timing_overrides(plan.timings):
+        master_trace, worker_traces, manager, workers = asyncio.run(
+            asyncio.wait_for(
+                _chaos_run(
+                    job, backends, controllers, hooks, registries, master_registry
+                ),
+                timeout,
+            )
+        )
+
+    artifacts: dict[str, str] = {}
+    cluster_trace_document = None
+    if results_directory is not None:
+        results_directory = Path(results_directory)
+        results_directory.mkdir(parents=True, exist_ok=True)
+        prefix = results_directory / f"chaos-{plan.seed}-{plan.fingerprint()}"
+        trace_path, metrics_path, cluster_trace_path = (
+            local_harness.save_obs_artifacts(prefix, manager, workers)
+        )
+        artifacts = {
+            "trace_events": str(trace_path),
+            "metrics": str(metrics_path),
+            "cluster_trace": str(cluster_trace_path),
+        }
+        cluster_trace_document = json.loads(
+            Path(cluster_trace_path).read_text(encoding="utf-8")
+        )
+    else:
+        # No directory given: still validate the merged timeline by
+        # building the document in memory from the same collection path.
+        from tpu_render_cluster.obs import merge_timeline
+
+        cluster_trace_document = merge_timeline(
+            manager.cluster_timeline_processes()
+        )
+
+    violations = check_invariants(
+        manager, plan, cluster_trace_document=cluster_trace_document
+    )
+    master_snapshot = manager.metrics.snapshot()
+    stats: dict[str, Any] = {
+        "frames_total": len(manager.state.frames),
+        "job_seconds": master_trace.job_finish_time - master_trace.job_start_time,
+        "wall_seconds": time.time() - started,
+        "worker_traces_collected": len(worker_traces),
+        "faults_injected": _aggregate_fault_counts(registries, master_registry),
+        "ledger": ledger_stats(master_snapshot),
+        "reconnects": counter_total(
+            master_snapshot, "master_worker_reconnects_total"
+        ),
+    }
+    return ChaosReport(
+        plan=plan, violations=violations, stats=stats, artifacts=artifacts
+    )
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="trc-chaos", description="Seeded fault-injection harness"
+    )
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--workers", type=int, default=3)
+    parser.add_argument("--frames", type=int, default=DEFAULT_FRAMES)
+    parser.add_argument(
+        "--plan",
+        default=None,
+        help="TOML fault plan (overrides --seed/--workers; see chaos/plan.py)",
+    )
+    parser.add_argument(
+        "--results-directory",
+        default=None,
+        help="Where to write the run's obs artifacts (default: results/chaos-runs)",
+    )
+    parser.add_argument("--timeout", type=float, default=180.0)
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.plan:
+        plan = FaultPlan.from_toml(args.plan)
+    else:
+        plan = FaultPlan.generate(args.seed, args.workers)
+    results_directory = args.results_directory
+    if results_directory is None:
+        from tpu_render_cluster.analysis.paths import RESULTS_ROOT
+
+        results_directory = RESULTS_ROOT / "chaos-runs"
+    report = run_chaos_job(
+        plan,
+        frames=args.frames,
+        results_directory=results_directory,
+        timeout=args.timeout,
+    )
+    print(json.dumps(report.to_dict(), indent=2))
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

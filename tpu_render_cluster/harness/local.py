@@ -36,12 +36,40 @@ from tpu_render_cluster.worker.backends.base import RenderBackend
 from tpu_render_cluster.worker.runtime import Worker
 
 
-async def _run(job: BlenderJob, backends: list[RenderBackend]):
+async def _run(
+    job: BlenderJob,
+    backends: list[RenderBackend],
+    *,
+    manager_factory=None,
+    worker_factory=None,
+    on_cluster_started=None,
+    worker_grace: float | None = None,
+    allow_worker_failures: bool = False,
+):
+    """Run one in-process cluster job.
+
+    The optional hooks are the chaos harness's seams (all default to the
+    plain production path):
+
+    - ``manager_factory(job)`` / ``worker_factory(slot, port, backend)``
+      construct the components (e.g. with fault-injecting connection
+      wrappers and per-slot registries);
+    - ``on_cluster_started(manager, workers, worker_tasks)`` runs once the
+      tasks exist — where fault watchdogs attach and start;
+    - ``worker_grace`` bounds how long to wait for worker tasks after the
+      master finishes; leftovers (crashed/hung workers that will never
+      exit) are cancelled instead of hanging the harness;
+    - ``allow_worker_failures`` tolerates worker tasks that died of
+      injected faults; without it the first worker exception re-raises.
+    """
     # A fresh registry per run: harness callers (tests, sweep scripts)
     # run many jobs in one process, and per-run artifacts must not
     # accumulate counts across runs the way the CLI's process-global
     # default (one job per process) is allowed to.
-    manager = ClusterManager("127.0.0.1", 0, job, metrics=MetricsRegistry())
+    if manager_factory is not None:
+        manager = manager_factory(job)
+    else:
+        manager = ClusterManager("127.0.0.1", 0, job, metrics=MetricsRegistry())
     server_task = asyncio.create_task(manager.initialize_server_and_run_job())
     while manager._server is None:
         if server_task.done():
@@ -53,15 +81,41 @@ async def _run(job: BlenderJob, backends: list[RenderBackend]):
     # Fresh per-worker registries too: colocated workers must not share
     # the process-global registry or their heartbeat payloads (and the
     # per-worker snapshots in the metrics artifact) would double-count.
-    workers = [
-        Worker("127.0.0.1", manager.port, backend, metrics=MetricsRegistry())
-        for backend in backends
-    ]
+    if worker_factory is not None:
+        workers = [
+            worker_factory(slot, manager.port, backend)
+            for slot, backend in enumerate(backends)
+        ]
+    else:
+        workers = [
+            Worker("127.0.0.1", manager.port, backend, metrics=MetricsRegistry())
+            for backend in backends
+        ]
     worker_tasks = [
         asyncio.create_task(w.connect_and_run_to_job_completion()) for w in workers
     ]
+    if on_cluster_started is not None:
+        await on_cluster_started(manager, workers, worker_tasks)
     master_trace, worker_traces = await server_task
-    await asyncio.gather(*worker_tasks)
+    if allow_worker_failures and worker_grace is None:
+        # Tolerating failures implies tolerating workers that never exit
+        # (a hung/killed worker's task has no reason to finish): an
+        # unbounded wait here would hang the harness, so failure-tolerant
+        # runs always get a finite reap window.
+        worker_grace = 60.0
+    if worker_grace is None and not allow_worker_failures:
+        await asyncio.gather(*worker_tasks)
+    else:
+        _done, pending = await asyncio.wait(
+            worker_tasks, timeout=worker_grace
+        )
+        for task in pending:
+            task.cancel()
+        results = await asyncio.gather(*worker_tasks, return_exceptions=True)
+        if not allow_worker_failures:
+            for result in results:
+                if isinstance(result, Exception):
+                    raise result
     return master_trace, worker_traces, manager, workers
 
 

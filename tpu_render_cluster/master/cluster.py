@@ -69,10 +69,14 @@ class ClusterManager:
         metrics: MetricsRegistry | None = None,
         span_tracer: Tracer | None = None,
         metrics_snapshot_path: str | Path | None = None,
+        dispatch_delay_fn=None,
     ) -> None:
         self.host = host
         self.port = port
         self.job = job
+        # Chaos shim: ``(worker_id, frame_index) -> seconds`` to stall a
+        # queue-add dispatch (master/worker_handle.py). None in production.
+        self._dispatch_delay_fn = dispatch_delay_fn
         self.state = ClusterManagerState(job)
         self.workers: dict[int, WorkerHandle] = {}
         self.cancellation = CancellationToken()
@@ -293,6 +297,12 @@ class ClusterManager:
         connection = ReconnectableServerConnection(
             ws, metrics=self._transport_metrics
         )
+        dispatch_delay_fn = None
+        if self._dispatch_delay_fn is not None:
+            manager_fn = self._dispatch_delay_fn
+            dispatch_delay_fn = lambda frame_index: manager_fn(  # noqa: E731
+                worker_id, frame_index
+            )
         worker = WorkerHandle(
             worker_id,
             connection,
@@ -300,6 +310,7 @@ class ClusterManager:
             on_dead=self._evict_worker,
             metrics=self.metrics,
             span_tracer=self.span_tracer,
+            dispatch_delay_fn=dispatch_delay_fn,
         )
         self.workers[worker_id] = worker
         worker.start()
@@ -322,6 +333,10 @@ class ClusterManager:
             record = self.state.frames.get(frame.frame_index)
             if record is not None and record.status is not FrameStatus.FINISHED:
                 self.state.return_frame_to_pending(frame.frame_index)
+        # No ghost assignments: a dead worker's mirror must not keep
+        # offering steal candidates (or claim queue depth) for frames that
+        # just went back to the pool.
+        worker.queue.clear()
 
     # -- job execution ------------------------------------------------------
 

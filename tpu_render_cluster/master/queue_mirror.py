@@ -46,6 +46,12 @@ class WorkerQueueMirror:
     def remove(self, frame_index: int) -> FrameOnWorker | None:
         return self._frames.pop(frame_index, None)
 
+    def clear(self) -> None:
+        """Drop every mirrored frame (eviction/drain: the worker is gone
+        and keeping its mirror would leave ghost assignments a later steal
+        pass could try to act on)."""
+        self._frames.clear()
+
     def set_rendering(self, frame_index: int) -> None:
         frame = self._frames.get(frame_index)
         if frame is not None:

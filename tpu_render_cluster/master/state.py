@@ -126,9 +126,11 @@ class ClusterManagerState:
         """Frame comes back to the pool (steal succeeded, render errored,
         or its worker died). Unlike the reference — where a dead worker's
         frames stay QueuedOnWorker forever (SURVEY.md §5.3) — this makes
-        eviction recoverable."""
+        eviction recoverable. Idempotent: under fault races (an eviction
+        and a failed dispatch both returning the same frame) the second
+        call must not add a second pending entry."""
         record = self.frames[frame_index]
-        if record.status is FrameStatus.FINISHED:
+        if record.status in (FrameStatus.FINISHED, FrameStatus.PENDING):
             return
         record.status = FrameStatus.PENDING
         record.worker_id = None

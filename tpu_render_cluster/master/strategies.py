@@ -31,7 +31,7 @@ from tpu_render_cluster.jobs.models import (
     DynamicStrategyOptions,
 )
 from tpu_render_cluster.master.queue_mirror import FrameOnWorker
-from tpu_render_cluster.master.state import ClusterManagerState
+from tpu_render_cluster.master.state import ClusterManagerState, FrameStatus
 from tpu_render_cluster.protocol import messages as pm
 from tpu_render_cluster.utils.cancellation import CancellationToken
 
@@ -224,6 +224,32 @@ async def steal_frame(
         return False
     if result != pm.FRAME_QUEUE_REMOVE_RESULT_REMOVED:
         logger.warning("Steal unqueue errored on %08x: %s", victim.worker_id, result)
+        return False
+    # The victim can be marked dead between steal selection and here (the
+    # unqueue RPC is an await point — heartbeat eviction interleaves).
+    # Three cases, each leaving the frame pending-or-owned EXACTLY once:
+    # - eviction already requeued it (record no longer points at the
+    #   victim): do nothing — requeueing on the thief as well would put
+    #   the frame in play twice;
+    # - the victim died but eviction can no longer see the frame (the
+    #   unqueue above removed it from the mirror eviction sweeps): requeue
+    #   it HERE or it would be lost forever;
+    # - victim alive and still owning the record: proceed with the steal.
+    record = state.frames.get(frame_index)
+    owned_by_victim = (
+        record is not None
+        and record.status is FrameStatus.QUEUED_ON_WORKER
+        and record.worker_id == victim.worker_id
+    )
+    if victim.is_dead or not owned_by_victim:
+        if owned_by_victim:
+            state.return_frame_to_pending(frame_index)
+        logger.warning(
+            "Steal of frame %d aborted: victim %08x %s mid-steal.",
+            frame_index,
+            victim.worker_id,
+            "died" if victim.is_dead else "lost the assignment",
+        )
         return False
     victim.frames_stolen_count += 1
     try:

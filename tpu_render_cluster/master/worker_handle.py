@@ -13,6 +13,18 @@ Improvements over the reference (SURVEY.md §7 "known bugs to fix"):
 an errored finished-event returns the frame to the pending pool instead of
 hanging the job, and a heartbeat failure triggers worker eviction via the
 ``on_dead`` callback instead of leaving frames assigned to a ghost.
+
+Exactly-once accounting under faults (driven by the chaos engine): every
+incoming rendering/finished event is checked against the frame's CURRENT
+assignment. A duplicated delivery, a late result from an evicted worker
+whose frame was re-rendered elsewhere, or an errored result for a frame
+this worker no longer owns are all recorded
+(``master_duplicate_results_total`` / ``master_late_results_total`` /
+``master_stale_results_total``) instead of corrupting the frame table —
+the ledger invariant ``ok_results - duplicates == frames_total`` is what
+``chaos/invariants.py`` asserts after every fault run. Master→worker RPCs
+additionally carry send-side + ack deadlines so one wedged socket can
+never stall the assignment loop for every other worker.
 """
 
 from __future__ import annotations
@@ -24,16 +36,45 @@ from typing import Awaitable, Callable
 
 from tpu_render_cluster.jobs.models import BlenderJob
 from tpu_render_cluster.master.queue_mirror import FrameOnWorker, WorkerQueueMirror
-from tpu_render_cluster.master.state import ClusterManagerState
+from tpu_render_cluster.master.state import ClusterManagerState, FrameStatus
 from tpu_render_cluster.obs import ClockOffsetEstimator, MetricsRegistry, Tracer
 from tpu_render_cluster.protocol import messages as pm
-from tpu_render_cluster.transport.actors import MessageRouter, SenderHandle, request_response
+from tpu_render_cluster.transport.actors import (
+    DEFAULT_WAIT_TIMEOUT,
+    MessageRouter,
+    SenderHandle,
+    request_response,
+)
 from tpu_render_cluster.transport.reconnect import ReconnectableServerConnection
+from tpu_render_cluster.utils.env import env_float, env_int
 from tpu_render_cluster.utils.logging import WorkerLogger
 
 HEARTBEAT_INTERVAL_SECONDS = 10.0  # reference: master/src/connection/mod.rs:36
 HEARTBEAT_RESPONSE_TIMEOUT = 60.0  # reference: master/src/connection/receiver.rs:27
 JOB_FINISH_TRACE_TIMEOUT = 600.0  # reference: master/src/connection/requester.rs:97
+
+
+def send_deadline_seconds() -> float:
+    """Write-side deadline on master→worker sends (``TRC_SEND_DEADLINE_SECONDS``).
+
+    Must exceed ``ReconnectableServerConnection.MAX_WAIT_FOR_RECONNECT``
+    (30 s) or ordinary reconnect windows would be misread as wedges."""
+    return env_float("TRC_SEND_DEADLINE_SECONDS", 45.0)
+
+
+def rpc_deadline_seconds() -> float:
+    """Ack deadline on queue add/remove RPCs (``TRC_RPC_DEADLINE_SECONDS``)."""
+    return env_float("TRC_RPC_DEADLINE_SECONDS", DEFAULT_WAIT_TIMEOUT)
+
+
+def heartbeat_pong_retries() -> int:
+    """Extra pings after a missed pong before eviction
+    (``TRC_HEARTBEAT_PONG_RETRIES``). A pong can be lost to a transient
+    partition that heals within the response window; one retry
+    distinguishes that from a dead worker. Send *failures* still evict
+    immediately — they mean the socket is gone and the reconnect window
+    already expired."""
+    return env_int("TRC_HEARTBEAT_PONG_RETRIES", 1)
 
 
 class WorkerHandle:
@@ -48,6 +89,7 @@ class WorkerHandle:
         on_dead: Callable[["WorkerHandle", str], Awaitable[None]] | None = None,
         metrics: MetricsRegistry | None = None,
         span_tracer: Tracer | None = None,
+        dispatch_delay_fn: Callable[[int], float] | None = None,
     ) -> None:
         self.worker_id = worker_id
         self.connection = connection
@@ -55,6 +97,12 @@ class WorkerHandle:
         self.queue = WorkerQueueMirror()
         self.frames_stolen_count = 0
         self.is_dead = False
+        # True when is_dead was reached via the graceful goodbye path
+        # (counted as a drain, not an eviction).
+        self.drained = False
+        # Chaos shim: seconds to stall before dispatching a given frame's
+        # queue-add RPC (no-op when None — the production default).
+        self._dispatch_delay_fn = dispatch_delay_fn
         self.metrics = metrics
         self.span_tracer = span_tracer
         # Most recent compact metrics payload this worker piggybacked on a
@@ -86,7 +134,14 @@ class WorkerHandle:
     # -- transport adapters -------------------------------------------------
 
     async def _send_message(self, message: pm.Message) -> None:
-        await self.connection.send_text(pm.encode_message(message))
+        # Send-side deadline: a socket that accepts writes but never
+        # drains (or a reconnect window that never closes) must surface as
+        # a failure here instead of parking the sender actor — and with it
+        # every RPC on this worker — forever.
+        await asyncio.wait_for(
+            self.connection.send_text(pm.encode_message(message)),
+            send_deadline_seconds(),
+        )
 
     async def _receive_message(self) -> pm.Message:
         return pm.decode_message(await self.connection.receive_text())
@@ -215,6 +270,12 @@ class WorkerHandle:
 
         Reference: master/src/connection/mod.rs:139-168.
         """
+        if self.is_dead:
+            raise RuntimeError("Worker is dead; refusing dispatch.")
+        if self._dispatch_delay_fn is not None:
+            delay = self._dispatch_delay_fn(frame_index)
+            if delay > 0.0:
+                await asyncio.sleep(delay)
         # Fresh span per ASSIGNMENT (not per frame): a re-queued or stolen
         # frame starts a new causal chain with its own Perfetto flow.
         trace = pm.TraceContext.new(self.state.trace_id)
@@ -222,11 +283,31 @@ class WorkerHandle:
         rpc_started = time.perf_counter()
         rpc_started_wall = time.time()
         response = await request_response(
-            self.sender, self.router, request, pm.WorkerFrameQueueAddResponse
+            self.sender,
+            self.router,
+            request,
+            pm.WorkerFrameQueueAddResponse,
+            timeout=rpc_deadline_seconds(),
         )
         if response.result != pm.FRAME_QUEUE_ADD_RESULT_ADDED:
             raise RuntimeError(
                 f"Worker rejected frame {frame_index}: {response.error_reason}"
+            )
+        # The ack can arrive AFTER this worker was evicted (or after the
+        # frame finished elsewhere): the eviction already requeued the
+        # frame and swept the mirror, so completing the assignment here
+        # would stomp the live record and open a Perfetto flow nothing
+        # ever closes. The worker may still render its ghost copy; the
+        # finished-event dedup path absorbs that result.
+        record = self.state.frames.get(frame_index)
+        if (
+            self.is_dead
+            or record is None
+            or record.status is FrameStatus.FINISHED
+        ):
+            raise RuntimeError(
+                f"Assignment of frame {frame_index} was superseded "
+                f"mid-dispatch ({'worker died' if self.is_dead else 'frame finished'})."
             )
         rpc_seconds = time.perf_counter() - rpc_started
         if self.metrics is not None:
@@ -288,7 +369,11 @@ class WorkerHandle:
         rpc_started_wall = time.time()
         rpc_started = time.perf_counter()
         response = await request_response(
-            self.sender, self.router, request, pm.WorkerFrameQueueRemoveResponse
+            self.sender,
+            self.router,
+            request,
+            pm.WorkerFrameQueueRemoveResponse,
+            timeout=rpc_deadline_seconds(),
         )
         if response.result == pm.FRAME_QUEUE_REMOVE_RESULT_REMOVED:
             removed = self.queue.remove(frame_index)
@@ -339,74 +424,227 @@ class WorkerHandle:
 
     # -- background loops ----------------------------------------------------
 
-    async def _manage_incoming_events(self) -> None:
-        """Apply rendering/finished events to the mirror + global state.
+    def _count_anomaly(self, name: str, help_text: str) -> None:
+        if self.metrics is not None:
+            self.metrics.counter(name, help_text).inc()
 
-        Reference: master/src/connection/mod.rs:240-326.
+    def _is_current_assignment(self, record) -> bool:
+        """Does this worker own the frame's LIVE assignment right now?
+
+        False for events from the past: the worker was evicted (record
+        re-pointed by requeue), the frame was stolen, or it already
+        finished. Events failing this check are accounted, not applied —
+        the exactly-once seam.
+        """
+        return (
+            not self.is_dead
+            and record is not None
+            and record.status
+            in (FrameStatus.QUEUED_ON_WORKER, FrameStatus.RENDERING_ON_WORKER)
+            and record.worker_id == self.worker_id
+        )
+
+    def _apply_rendering_event(
+        self, event: pm.WorkerFrameQueueItemRenderingEvent
+    ) -> None:
+        record = self.state.frames.get(event.frame_index)
+        if not self._is_current_assignment(record):
+            # E.g. the queue-add ack timed out (frame requeued elsewhere)
+            # but the add had landed, and the superseded copy now renders.
+            self._count_anomaly(
+                "master_stale_results_total",
+                "Worker events ignored because the frame's live assignment "
+                "moved on (eviction, steal, requeue, or already finished)",
+            )
+            self.logger.debug(
+                "Stale rendering event for frame %d ignored.", event.frame_index
+            )
+            return
+        self.logger.debug("Frame %d started rendering.", event.frame_index)
+        self._rendering_started_at[event.frame_index] = time.time()
+        self.queue.set_rendering(event.frame_index)
+        self.state.mark_frame_as_rendering(event.frame_index, self.worker_id)
+
+    def _apply_finished_event(
+        self, event: pm.WorkerFrameQueueItemFinishedEvent
+    ) -> None:
+        received_wall = time.time()
+        received_mono = time.perf_counter()
+        record = self.state.frames.get(event.frame_index)
+        frame_on_worker = self.queue.remove(event.frame_index)
+        # Popped unconditionally: the duplicate/late/stale returns below
+        # must not leave a ghost in-flight entry on this handle.
+        started = self._rendering_started_at.pop(event.frame_index, None)
+        self._update_queue_depth_gauge()
+        if self.metrics is not None:
+            self.metrics.counter(
+                "master_frame_results_total",
+                "Frame finished events received from workers, by wire result",
+                labels=("result",),
+            ).inc(result=event.result)
+        finished_already = record is None or record.status is FrameStatus.FINISHED
+        current = self._is_current_assignment(record)
+        # Terminal span of the assignment's causal chain on the master
+        # timeline: the flow arrow from "assign frame" through the
+        # worker's phases ends here. Prefer the trace the event echoed
+        # (exact even across re-queues); fall back to the mirror's record
+        # (a C++ worker echoes nothing). Only the CURRENT assignment gets
+        # the terminal arrowhead: eviction already closed a dead worker's
+        # flows, and a duplicate/late result must not double-terminate the
+        # chain its winning copy closes.
+        trace = event.trace
+        if trace is None and frame_on_worker is not None:
+            trace = frame_on_worker.trace
+        self._complete_frame_flow(
+            "frame result",
+            event.frame_index,
+            trace if current else None,
+            start_wall=received_wall,
+            duration=time.perf_counter() - received_mono,
+            extra_args={"result": event.result},
+        )
+        if event.result == pm.FRAME_QUEUE_ITEM_FINISHED_OK:
+            if finished_already:
+                # The duplicate-result race: a duplicated delivery, or the
+                # re-render of an evicted frame lost to the original's late
+                # result (or vice versa). ``mark_frame_as_finished``'s
+                # idempotence keeps ``_finished_count`` exact; this ledger
+                # proves the collision happened.
+                self._count_anomaly(
+                    "master_duplicate_results_total",
+                    "Ok results received for frames that were already finished",
+                )
+                self.logger.warning(
+                    "Duplicate result for frame %d ignored.", event.frame_index
+                )
+                return
+            if not current:
+                # Late result from a superseded assignment (this worker was
+                # evicted / the frame requeued after a timed-out add RPC):
+                # the render DID happen and the output exists — accept it.
+                # The currently-assigned copy will account as a duplicate.
+                self._count_anomaly(
+                    "master_late_results_total",
+                    "Ok results accepted from superseded assignments",
+                )
+                self.logger.warning(
+                    "Late result for frame %d accepted from a superseded "
+                    "assignment.",
+                    event.frame_index,
+                )
+                self.state.mark_frame_as_finished(event.frame_index)
+                return
+            self.logger.debug("Frame %d finished.", event.frame_index)
+            if started is None and frame_on_worker is not None:
+                started = frame_on_worker.queued_at
+            if started is not None:
+                self._completion_observations.append(
+                    (event.frame_index, max(1e-4, time.time() - started))
+                )
+            self.state.mark_frame_as_finished(event.frame_index)
+        else:
+            if not current:
+                # An errored result for a frame this worker no longer owns
+                # must NOT requeue it: the live assignment is
+                # authoritative, and a second pending entry would render
+                # the frame twice.
+                self._count_anomaly(
+                    "master_stale_results_total",
+                    "Worker events ignored because the frame's live assignment "
+                    "moved on (eviction, steal, requeue, or already finished)",
+                )
+                self.logger.warning(
+                    "Stale errored result for frame %d ignored.",
+                    event.frame_index,
+                )
+                return
+            # Reference workers swallow render errors and the master
+            # hangs (worker/src/rendering/queue.rs:169-174); we
+            # reschedule the frame instead.
+            self.logger.warning(
+                "Frame %d errored on worker (%s); rescheduling.",
+                event.frame_index,
+                event.error_reason,
+            )
+            self.state.return_frame_to_pending(event.frame_index)
+
+    async def _handle_goodbye(self, event: pm.WorkerGoodbyeEvent) -> None:
+        """Graceful drain: requeue the returned frames without an eviction.
+
+        The goodbye's frame list is advisory — anything still mirrored
+        here is swept too — and each frame is requeued only if this worker
+        still owns its live assignment, so a goodbye racing an eviction
+        (or a steal) can never double-pend a frame.
+        """
+        if self.is_dead:
+            return  # eviction won the race; frames are already requeued
+        self.is_dead = True
+        self.drained = True
+        self.cancel_heartbeat()
+        now = time.time()
+        indices = set(event.returned_frames) | {
+            f.frame_index for f in self.queue.all_frames()
+        }
+        requeued = 0
+        for frame_index in sorted(indices):
+            record = self.state.frames.get(frame_index)
+            frame = self.queue.remove(frame_index)
+            if frame is not None:
+                self._complete_frame_flow(
+                    "frame returned",
+                    frame_index,
+                    frame.trace,
+                    start_wall=now,
+                    duration=0.0,
+                    extra_args={"reason": event.reason},
+                )
+            if (
+                record is not None
+                and record.status is not FrameStatus.FINISHED
+                and record.worker_id == self.worker_id
+            ):
+                self.state.return_frame_to_pending(frame_index)
+                requeued += 1
+        self._update_queue_depth_gauge()
+        if self.metrics is not None:
+            self.metrics.counter(
+                "master_worker_drains_total",
+                "Workers that departed gracefully via the goodbye message",
+            ).inc()
+        self.logger.info(
+            "Worker drained gracefully (%s); %d frame(s) requeued.",
+            event.reason,
+            requeued,
+        )
+
+    async def _manage_incoming_events(self) -> None:
+        """Apply rendering/finished/goodbye events to the mirror + state.
+
+        Reference: master/src/connection/mod.rs:240-326 (the goodbye
+        branch is the drain extension).
         """
         rendering_queue = self.router.subscribe(pm.WorkerFrameQueueItemRenderingEvent)
         finished_queue = self.router.subscribe(pm.WorkerFrameQueueItemFinishedEvent)
+        goodbye_queue = self.router.subscribe(pm.WorkerGoodbyeEvent)
 
         async def handle_rendering() -> None:
             while True:
-                event = await rendering_queue.get()
-                self.logger.debug("Frame %d started rendering.", event.frame_index)
-                self._rendering_started_at[event.frame_index] = time.time()
-                self.queue.set_rendering(event.frame_index)
-                self.state.mark_frame_as_rendering(event.frame_index, self.worker_id)
+                self._apply_rendering_event(await rendering_queue.get())
 
         async def handle_finished() -> None:
             while True:
-                event = await finished_queue.get()
-                received_wall = time.time()
-                received_mono = time.perf_counter()
-                frame_on_worker = self.queue.remove(event.frame_index)
-                self._update_queue_depth_gauge()
-                # Terminal span of the assignment's causal chain on the
-                # master timeline: the flow arrow from "assign frame"
-                # through the worker's phases ends here. Prefer the trace
-                # the event echoed (exact even across re-queues); fall back
-                # to the mirror's record (a C++ worker echoes nothing).
-                # After _mark_dead the eviction already terminated every
-                # mirrored flow, so a late in-flight event records its span
-                # WITHOUT a second terminal arrowhead.
-                trace = event.trace
-                if trace is None and frame_on_worker is not None:
-                    trace = frame_on_worker.trace
-                self._complete_frame_flow(
-                    "frame result",
-                    event.frame_index,
-                    None if self.is_dead else trace,
-                    start_wall=received_wall,
-                    duration=time.perf_counter() - received_mono,
-                    extra_args={"result": event.result},
-                )
-                if event.result == pm.FRAME_QUEUE_ITEM_FINISHED_OK:
-                    self.logger.debug("Frame %d finished.", event.frame_index)
-                    started = self._rendering_started_at.pop(event.frame_index, None)
-                    if started is None and frame_on_worker is not None:
-                        started = frame_on_worker.queued_at
-                    if started is not None:
-                        self._completion_observations.append(
-                            (event.frame_index, max(1e-4, time.time() - started))
-                        )
-                    self.state.mark_frame_as_finished(event.frame_index)
-                else:
-                    # Reference workers swallow render errors and the master
-                    # hangs (worker/src/rendering/queue.rs:169-174); we
-                    # reschedule the frame instead.
-                    self.logger.warning(
-                        "Frame %d errored on worker (%s); rescheduling.",
-                        event.frame_index,
-                        event.error_reason,
-                    )
-                    self.state.return_frame_to_pending(event.frame_index)
+                self._apply_finished_event(await finished_queue.get())
+
+        async def handle_goodbye() -> None:
+            while True:
+                await self._handle_goodbye(await goodbye_queue.get())
 
         # gather instead of asyncio.TaskGroup so the master still runs on
         # Python 3.10; first failure cancels the sibling loop the same way.
         tasks = [
             asyncio.ensure_future(handle_rendering()),
             asyncio.ensure_future(handle_finished()),
+            asyncio.ensure_future(handle_goodbye()),
         ]
         try:
             await asyncio.gather(*tasks)
@@ -422,12 +660,17 @@ class WorkerHandle:
             await self._mark_dead(f"event loop failed: {e}")
 
     async def _maintain_heartbeat(self) -> None:
-        """Ping every 10 s; a missed pong (60 s) marks the worker dead.
+        """Ping every 10 s; heartbeat failure marks the worker dead.
 
         Reference: master/src/connection/mod.rs:327-423, except failure
-        triggers eviction instead of only killing the heartbeat task.
+        triggers eviction instead of only killing the heartbeat task, and
+        the two failure modes are separated: a SEND failure (socket gone,
+        reconnect window expired) evicts immediately, while a missed PONG
+        gets ``heartbeat_pong_retries()`` re-pings first — a pong lost to
+        a transient partition that healed must not evict a live worker.
         """
         pong_queue = self.router.subscribe(pm.WorkerHeartbeatResponse)
+        missed = 0
         try:
             while True:
                 # Ping FIRST, then sleep (the reference sleeps first): the
@@ -437,38 +680,69 @@ class WorkerHandle:
                 # drops because the worker subscribes its heartbeat queue
                 # before starting its receive loop.
                 request = pm.MasterHeartbeatRequest.new_now()
+                sent_at = time.perf_counter()
                 try:
-                    sent_at = time.perf_counter()
                     await self.sender.send_message(request)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001 - socket definitively gone
+                    await self._mark_dead(f"heartbeat send failed: {e}")
+                    return
+                try:
+                    # The predicate discards stale pongs (answers to an
+                    # earlier, timed-out ping): matching one to THIS ping
+                    # would feed the clock estimator a sample whose four
+                    # timestamps span two exchanges. Anonymous pongs (C++
+                    # workers echo nothing) always match — they carry no
+                    # clock timestamps, so nothing can be corrupted.
                     pong = await self.router.wait_for_message(
                         pm.WorkerHeartbeatResponse,
+                        predicate=lambda p: p.echo_request_time is None
+                        or p.echo_request_time == request.request_time,
                         timeout=HEARTBEAT_RESPONSE_TIMEOUT,
                         queue=pong_queue,
                     )
-                    pong_wall = time.time()
-                    if self.metrics is not None:
-                        self.metrics.histogram(
-                            "transport_heartbeat_rtt_seconds",
-                            "Heartbeat ping->pong round-trip per worker",
-                            labels=("worker",),
-                        ).observe(
-                            time.perf_counter() - sent_at,
-                            worker=self._worker_label(),
+                except asyncio.CancelledError:
+                    raise
+                except asyncio.TimeoutError:
+                    missed += 1
+                    if missed > heartbeat_pong_retries():
+                        await self._mark_dead(
+                            f"no heartbeat response after {missed} pings"
                         )
-                    if pong.received_at is not None and pong.responded_at is not None:
-                        self._observe_clock_sample(
-                            request.request_time,
-                            pong.received_at,
-                            pong.responded_at,
-                            pong_wall,
-                        )
-                    if pong.metrics is not None:
-                        self.latest_worker_metrics = pong.metrics
-                except (asyncio.TimeoutError, ConnectionError, Exception) as e:
-                    if isinstance(e, asyncio.CancelledError):
-                        raise
+                        return
+                    self.logger.warning(
+                        "Heartbeat pong missed (%d); re-pinging.", missed
+                    )
+                    continue
+                except Exception as e:  # noqa: BLE001
                     await self._mark_dead(f"heartbeat failed: {e}")
                     return
+                correlated = pong.echo_request_time is not None or missed == 0
+                missed = 0
+                pong_wall = time.time()
+                if self.metrics is not None and correlated:
+                    # An ANONYMOUS pong right after a miss may be the
+                    # timed-out ping's late answer (C++ workers echo no
+                    # request time), so its RTT against THIS ping is
+                    # meaningless — skip the observation.
+                    self.metrics.histogram(
+                        "transport_heartbeat_rtt_seconds",
+                        "Heartbeat ping->pong round-trip per worker",
+                        labels=("worker",),
+                    ).observe(
+                        time.perf_counter() - sent_at,
+                        worker=self._worker_label(),
+                    )
+                if pong.received_at is not None and pong.responded_at is not None:
+                    self._observe_clock_sample(
+                        request.request_time,
+                        pong.received_at,
+                        pong.responded_at,
+                        pong_wall,
+                    )
+                if pong.metrics is not None:
+                    self.latest_worker_metrics = pong.metrics
                 await asyncio.sleep(HEARTBEAT_INTERVAL_SECONDS)
         except asyncio.CancelledError:
             raise

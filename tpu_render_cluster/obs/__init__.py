@@ -34,6 +34,7 @@ from tpu_render_cluster.obs.snapshot import SnapshotWriter, write_metrics_snapsh
 from tpu_render_cluster.obs.timeline import (
     TimelineProcess,
     export_cluster_trace,
+    merge_timeline,
     tracer_process,
 )
 from tpu_render_cluster.obs.tracer import Tracer, export_chrome_trace
@@ -57,6 +58,7 @@ __all__ = [
     "get_registry",
     "get_tracer",
     "log_buckets",
+    "merge_timeline",
     "merge_wire",
     "render_fps_gauge",
     "tracer_process",

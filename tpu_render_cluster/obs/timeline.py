@@ -31,6 +31,7 @@ logger = logging.getLogger(__name__)
 __all__ = [
     "TimelineProcess",
     "export_cluster_trace",
+    "merge_timeline",
     "rebase_events",
     "tracer_process",
 ]
@@ -82,13 +83,12 @@ def rebase_events(
     return out
 
 
-def export_cluster_trace(
-    path: str | Path, processes: Iterable[TimelineProcess]
-) -> Path:
-    """Write the merged, offset-corrected cluster timeline.
+def merge_timeline(processes: Iterable[TimelineProcess]) -> dict[str, Any]:
+    """Build the merged, offset-corrected cluster timeline document.
 
     Process order is preserved (callers put the master first so it renders
-    as the top row); pids are reassigned 1..N.
+    as the top row); pids are reassigned 1..N. ``export_cluster_trace``
+    writes this to disk; the chaos harness also validates it in memory.
     """
     events: list[dict[str, Any]] = []
     offsets: dict[str, float] = {}
@@ -115,6 +115,14 @@ def export_cluster_trace(
     }
     if dropped:
         document["otherData"]["dropped_events"] = dropped
+    return document
+
+
+def export_cluster_trace(
+    path: str | Path, processes: Iterable[TimelineProcess]
+) -> Path:
+    """Write the merged cluster timeline (see ``merge_timeline``)."""
+    document = merge_timeline(processes)
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     path.write_text(json.dumps(document), encoding="utf-8")

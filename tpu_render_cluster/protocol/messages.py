@@ -1,4 +1,4 @@
-"""The 14-message job protocol.
+"""The 14-message job protocol (+ the goodbye drain extension).
 
 Wire format is the reference's externally-observable contract: a JSON text
 frame ``{"message_type": "<tag>", "payload": {...}}`` (reference:
@@ -7,6 +7,8 @@ reference's enum (including the asymmetric ``response_frame-queue-add`` tag,
 shared/src/messages/mod.rs:171). Requests carry a random u64
 ``message_request_id``; responses echo it as ``message_request_context_id``
 (shared/src/messages/utilities.rs:5-14, shared/src/messages/queue.rs:13-100).
+``event_worker-goodbye`` is this repo's one NEW message (graceful drain);
+every other extension rides as optional keys inside reference payloads.
 
 Worker IDs are random u32s displayed as 8-hex
 (shared/src/messages/handshake.rs:9-26).
@@ -443,13 +445,20 @@ class WorkerHeartbeatResponse(Message):
       timestamps on the worker's clock. Together with the ping's
       ``request_time`` and the master's receive time they complete the
       NTP four-timestamp exchange the per-worker clock-offset estimator
-      (``obs/clocksync.py``) feeds on.
+      (``obs/clocksync.py``) feeds on;
+    - ``echo_request_time`` — OPTIONAL echo of the ping's
+      ``request_time``, correlating pong to ping. The reference's pongs
+      are anonymous, which was fine while one missed pong evicted the
+      worker; with pong-miss retries a stale pong could otherwise be
+      taken for the retry's answer and feed the clock estimator a sample
+      whose four timestamps span two different exchanges.
     """
 
     type_name: ClassVar[str] = "response_heartbeat"
     metrics: dict[str, Any] | None = None
     received_at: float | None = None
     responded_at: float | None = None
+    echo_request_time: float | None = None
 
     def to_payload(self) -> dict[str, Any]:
         out: dict[str, Any] = {}
@@ -459,6 +468,8 @@ class WorkerHeartbeatResponse(Message):
             out["received_at"] = self.received_at
         if self.responded_at is not None:
             out["responded_at"] = self.responded_at
+        if self.echo_request_time is not None:
+            out["echo_request_time"] = self.echo_request_time
         return out
 
     @classmethod
@@ -468,10 +479,54 @@ class WorkerHeartbeatResponse(Message):
             raise ValueError("heartbeat metrics payload must be an object")
         received_at = payload.get("received_at")
         responded_at = payload.get("responded_at")
+        echo_request_time = payload.get("echo_request_time")
         return cls(
             metrics=metrics,
             received_at=None if received_at is None else float(received_at),
             responded_at=None if responded_at is None else float(responded_at),
+            echo_request_time=(
+                None if echo_request_time is None else float(echo_request_time)
+            ),
+        )
+
+
+@dataclass(frozen=True)
+class WorkerGoodbyeEvent(Message):
+    """W→M: graceful departure (beyond-reference, drain protocol).
+
+    Sent when a worker is asked to drain (SIGTERM, maintenance): it
+    finishes the frame it is rendering, returns every still-queued frame
+    index so the master can requeue them immediately — instead of paying
+    a heartbeat-timeout eviction to discover the departure — and goes
+    away. Reference-compatible by the piggyback rule: a C++ master may
+    ignore the unknown message type (the socket death that follows takes
+    the reference's eviction path instead).
+    """
+
+    type_name: ClassVar[str] = "event_worker-goodbye"
+    reason: str = "drain"
+    job_name: str | None = None
+    returned_frames: tuple[int, ...] = ()
+
+    def to_payload(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "reason": self.reason,
+            "returned_frames": list(self.returned_frames),
+        }
+        if self.job_name is not None:
+            out["job_name"] = self.job_name
+        return out
+
+    @classmethod
+    def from_payload(cls, payload: dict[str, Any]) -> "WorkerGoodbyeEvent":
+        frames = payload.get("returned_frames") or []
+        if not isinstance(frames, list):
+            raise ValueError("returned_frames must be a list")
+        job_name = payload.get("job_name")
+        return cls(
+            reason=str(payload.get("reason", "drain")),
+            job_name=None if job_name is None else str(job_name),
+            returned_frames=tuple(int(f) for f in frames),
         )
 
 
@@ -566,6 +621,7 @@ ALL_MESSAGE_TYPES: tuple[type[Message], ...] = (
     WorkerFrameQueueRemoveResponse,
     WorkerFrameQueueItemRenderingEvent,
     WorkerFrameQueueItemFinishedEvent,
+    WorkerGoodbyeEvent,
     MasterHeartbeatRequest,
     WorkerHeartbeatResponse,
     MasterJobStartedEvent,

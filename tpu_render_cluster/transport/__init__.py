@@ -1,3 +1,8 @@
+from tpu_render_cluster.transport.faults import (
+    FaultController,
+    FaultyConnection,
+    SendDecision,
+)
 from tpu_render_cluster.transport.ws import (
     MAX_FRAME_SIZE,
     MAX_MESSAGE_SIZE,
@@ -9,8 +14,11 @@ from tpu_render_cluster.transport.ws import (
 )
 
 __all__ = [
+    "FaultController",
+    "FaultyConnection",
     "MAX_FRAME_SIZE",
     "MAX_MESSAGE_SIZE",
+    "SendDecision",
     "WebSocketClosed",
     "WebSocketConnection",
     "WebSocketError",

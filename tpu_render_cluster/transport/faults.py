@@ -1,0 +1,108 @@
+"""Fault-injection wrapper for a single WebSocket connection.
+
+``FaultyConnection`` conforms to the ``WebSocketConnection`` surface the
+rest of the transport consumes (``send_text`` / ``receive_text`` /
+``close`` / ``abort`` / ``is_closed`` / ``peer_address``), so
+``ReconnectingClient`` and ``ReconnectableServerConnection`` are exercised
+by chaos runs completely unmodified — faults look exactly like the real
+network events they model. The wrapper itself holds no policy: every
+decision is delegated to a ``FaultController`` (the seeded, plan-driven
+implementation lives in ``chaos/inject.py``), and with no controller
+actions the wrapper is a transparent pass-through.
+
+Fault vocabulary at this seam:
+
+- ``drop``       — the send appears to succeed but nothing hits the wire
+                   (a message lost in flight);
+- ``delay``      — the send completes only after a pause (a wedged socket;
+                   because senders are serial actors, one delayed send
+                   wedges everything queued behind it — by design);
+- ``duplicate``  — the payload is written twice (a retransmit race);
+- ``kill``       — the socket dies *before* the payload is written
+                   (connection reset mid-send);
+- the controller's ``gate`` hook can also refuse service on entry to
+  either direction, which models partitions and permanent death.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Protocol
+
+from tpu_render_cluster.transport.ws import WebSocketClosed, WebSocketConnection
+
+SEND_ACTION_SEND = "send"
+SEND_ACTION_DROP = "drop"
+SEND_ACTION_DUPLICATE = "duplicate"
+SEND_ACTION_KILL = "kill"
+
+
+@dataclass(frozen=True)
+class SendDecision:
+    """What to do with one outgoing message."""
+
+    action: str = SEND_ACTION_SEND
+    delay_seconds: float = 0.0
+
+
+# Shared pass-through instance (the overwhelmingly common decision).
+PASS_DECISION = SendDecision()
+
+
+class FaultController(Protocol):
+    """Policy source for one connection's faults (see chaos/inject.py)."""
+
+    def check_gate(self) -> None:
+        """Raise ``WebSocketClosed`` if the link should refuse service now
+        (partition window open, worker killed). Called on entry to both
+        ``send_text`` and ``receive_text``."""
+
+    def on_send(self, text: str) -> SendDecision:
+        """Decide the fate of one outgoing message."""
+
+    def after_send(self, text: str) -> None:
+        """Called after a successful write — the crash-after-result seam."""
+
+
+class FaultyConnection:
+    """A ``WebSocketConnection`` with a fault controller in the send path."""
+
+    def __init__(self, inner: WebSocketConnection, controller: FaultController) -> None:
+        self._inner = inner
+        self._controller = controller
+
+    @property
+    def is_closed(self) -> bool:
+        return self._inner.is_closed
+
+    def peer_address(self) -> str:
+        return self._inner.peer_address()
+
+    async def send_text(self, text: str) -> None:
+        self._controller.check_gate()
+        decision = self._controller.on_send(text)
+        if decision.delay_seconds > 0.0:
+            await asyncio.sleep(decision.delay_seconds)
+            # The link may have died (or a partition opened) during the
+            # stall — a real wedged socket discovers this on write too.
+            self._controller.check_gate()
+        if decision.action == SEND_ACTION_KILL:
+            self._inner.abort()
+            raise WebSocketClosed("chaos: socket killed before send")
+        if decision.action == SEND_ACTION_DROP:
+            return  # swallowed in flight; the caller believes it was sent
+        await self._inner.send_text(text)
+        if decision.action == SEND_ACTION_DUPLICATE:
+            await self._inner.send_text(text)
+        self._controller.after_send(text)
+
+    async def receive_text(self) -> str:
+        self._controller.check_gate()
+        return await self._inner.receive_text()
+
+    async def close(self) -> None:
+        await self._inner.close()
+
+    def abort(self) -> None:
+        self._inner.abort()

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 from typing import TYPE_CHECKING, Awaitable, Callable
 
 from tpu_render_cluster.transport.ws import (
@@ -20,6 +21,7 @@ from tpu_render_cluster.transport.ws import (
     WebSocketConnection,
     websocket_connect,
 )
+from tpu_render_cluster.utils.env import env_float, env_int
 
 if TYPE_CHECKING:
     from tpu_render_cluster.obs import MetricsRegistry
@@ -70,7 +72,10 @@ class TransportMetrics:
     def connect_attempt(self) -> None:
         self._connect_attempts.inc()
 
-# Reference: worker/src/connection/mod.rs:360-398,475-487.
+# Reference: worker/src/connection/mod.rs:360-398,475-487. All of these are
+# defaults behind TRC_* environment overrides (utils/env.py): deployments
+# with different failure profiles — and the chaos harness, which compresses
+# every timeout — retune them without code changes.
 BACKOFF_BASE = 2.0
 BACKOFF_CAP_SECONDS = 30.0
 MAX_CONNECT_RETRIES = 12
@@ -79,29 +84,67 @@ MAX_RECONNECTS_PER_OP = 2
 OP_DEADLINE_SECONDS = 30.0
 
 
+def backoff_base() -> float:
+    return env_float("TRC_BACKOFF_BASE", BACKOFF_BASE)
+
+
+def backoff_cap_seconds() -> float:
+    return env_float("TRC_BACKOFF_CAP_SECONDS", BACKOFF_CAP_SECONDS)
+
+
+def max_connect_retries() -> int:
+    return env_int("TRC_MAX_CONNECT_RETRIES", MAX_CONNECT_RETRIES)
+
+
+def max_reconnects_per_op() -> int:
+    return env_int("TRC_MAX_RECONNECTS_PER_OP", MAX_RECONNECTS_PER_OP)
+
+
+def op_deadline_seconds() -> float:
+    return env_float("TRC_OP_DEADLINE_SECONDS", OP_DEADLINE_SECONDS)
+
+
 async def connect_with_exponential_backoff(
     host: str,
     port: int,
     *,
-    max_retries: int = MAX_CONNECT_RETRIES,
-    base: float = BACKOFF_BASE,
-    cap_seconds: float = BACKOFF_CAP_SECONDS,
+    max_retries: int | None = None,
+    base: float | None = None,
+    cap_seconds: float | None = None,
     metrics: TransportMetrics | None = None,
+    wrap: Callable[[WebSocketConnection], WebSocketConnection] | None = None,
 ) -> WebSocketConnection:
-    """TCP connect + WS upgrade with exponential backoff."""
+    """TCP connect + WS upgrade with full-jitter exponential backoff.
+
+    Each retry sleeps ``uniform(0, min(cap, base**attempt))`` (AWS
+    "full jitter"): after a master restart every worker of a large cluster
+    retries at an independently random moment instead of reconnecting in
+    lockstep at the same deterministic ``base**attempt`` instants.
+
+    ``wrap`` (when given) intercepts each freshly-upgraded connection
+    before it is returned — the fault-injection seam (transport/faults.py);
+    a wrapper that raises ``WebSocketClosed`` (e.g. a simulated partition)
+    consumes a retry like any other connect failure.
+    """
+    max_retries = max_connect_retries() if max_retries is None else max_retries
+    base = backoff_base() if base is None else base
+    cap_seconds = backoff_cap_seconds() if cap_seconds is None else cap_seconds
     last_error: Exception | None = None
     for attempt in range(max_retries + 1):
         try:
             if metrics is not None:
                 metrics.connect_attempt()
-            return await websocket_connect(host, port)
+            connection = await websocket_connect(host, port)
+            if wrap is not None:
+                connection = wrap(connection)
+            return connection
         except (WebSocketClosed, OSError) as e:
             last_error = e
             if attempt == max_retries:
                 break
-            delay = min(base**attempt, cap_seconds)
+            delay = random.uniform(0.0, min(base**attempt, cap_seconds))
             logger.debug(
-                "Connect attempt %d/%d to %s:%d failed (%s); retrying in %.1f s",
+                "Connect attempt %d/%d to %s:%d failed (%s); retrying in %.2f s",
                 attempt + 1, max_retries, host, port, e, delay,
             )
             await asyncio.sleep(delay)
@@ -144,8 +187,16 @@ class ReconnectingClient:
         self._closed = True
         self._connection.abort()
 
-    async def _reconnect(self, failed_generation: int) -> None:
-        """Re-establish the socket once (deduplicated across concurrent ops)."""
+    async def _reconnect(self, failed_generation: int, lost_at: float) -> None:
+        """Re-establish the socket once (deduplicated across concurrent ops).
+
+        ``lost_at`` is the wall-clock time of the failing op's FIRST
+        exception, stamped by the caller before it contends for the
+        reconnect lock: under concurrent op failures the lock is held for
+        the whole reconnect, and stamping at lock *acquisition* (as this
+        used to) would shorten every recorded outage window by however long
+        the op queued behind its siblings.
+        """
         import time
 
         async with self._reconnect_lock:
@@ -153,7 +204,6 @@ class ReconnectingClient:
                 return  # another task already reconnected
             if self._closed:
                 raise WebSocketClosed("Client is closed.")
-            lost_at = time.time()
             self._connection.abort()
             self._connection = await self._reconnect_fn()
             self._generation += 1
@@ -164,8 +214,11 @@ class ReconnectingClient:
             logger.info("Reconnected to master (generation %d).", self._generation)
 
     async def _with_retries(self, op: Callable[[WebSocketConnection], Awaitable]):
+        import time
+
         loop = asyncio.get_running_loop()
-        deadline = loop.time() + OP_DEADLINE_SECONDS
+        deadline = loop.time() + op_deadline_seconds()
+        reconnect_budget = max_reconnects_per_op()
         reconnects = 0
         while True:
             connection = self._connection
@@ -173,12 +226,13 @@ class ReconnectingClient:
             try:
                 return await op(connection)
             except WebSocketClosed:
+                lost_at = time.time()
                 if self._closed:
                     raise
                 reconnects += 1
-                if reconnects > MAX_RECONNECTS_PER_OP or loop.time() > deadline:
+                if reconnects > reconnect_budget or loop.time() > deadline:
                     raise
-                await self._reconnect(generation)
+                await self._reconnect(generation, lost_at)
 
     async def send_text(self, text: str) -> None:
         await self._with_retries(lambda c: c.send_text(text))

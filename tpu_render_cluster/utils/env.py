@@ -1,0 +1,40 @@
+"""``TRC_*`` environment overrides for runtime tuning knobs.
+
+The transport deadlines, retry caps, and heartbeat tolerances all ship
+reference-derived defaults but are consulted through these helpers so a
+deployment (or the chaos harness, which compresses every timeout to keep
+fault scenarios fast) can retune them without code changes. Values are
+read at *call* time, not import time: long-lived processes and tests that
+monkeypatch ``os.environ`` both see the current value.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+
+def env_float(name: str, default: float) -> float:
+    """``float(os.environ[name])`` with a logged fallback on bad values."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("Ignoring non-numeric %s=%r; using %s", name, raw, default)
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    """``int(os.environ[name])`` with a logged fallback on bad values."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        logger.warning("Ignoring non-integer %s=%r; using %s", name, raw, default)
+        return default

@@ -1,0 +1,63 @@
+"""Fault-aware backend wrapper (the chaos engine's render-layer hook).
+
+``FaultyBackend`` delegates every frame to an inner backend (normally the
+sleep-based mock) and consults a ``WorkerChaosController``
+(chaos/inject.py) at the three points where worker faults bite:
+
+- **before the render** — ``crash_before_result`` kills the worker here,
+  so the frame's work is lost and the master must re-render it elsewhere;
+  ``hang`` parks the backend forever, leaving heartbeats to discover the
+  wedge and evict;
+- **around the render** — ``slow_render`` stretches the measured duration
+  by the plan's multiplier (a straggler);
+- **after the render** — ``crash_after_result`` arms a kill that fires the
+  moment this frame's finished event clears the socket, so the result
+  survives but the worker doesn't.
+
+With a fault-free controller the wrapper is pass-through; production
+backends never import this module.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import replace
+from typing import TYPE_CHECKING
+
+from tpu_render_cluster.jobs.models import BlenderJob
+from tpu_render_cluster.traces.worker_trace import FrameRenderTime
+from tpu_render_cluster.worker.backends.base import RenderBackend
+
+if TYPE_CHECKING:
+    from tpu_render_cluster.chaos.inject import WorkerChaosController
+
+
+class FaultyBackend(RenderBackend):
+    """Wraps a real backend with plan-driven render faults."""
+
+    def __init__(self, inner: RenderBackend, controller: "WorkerChaosController") -> None:
+        self._inner = inner
+        self._controller = controller
+        self._ordinal = 0
+
+    async def render_frame(self, job: BlenderJob, frame_index: int) -> FrameRenderTime:
+        self._ordinal += 1
+        ordinal = self._ordinal
+        controller = self._controller
+        # A crash_before_result trigger cancels the worker task here; the
+        # cancellation lands at the next await point below.
+        controller.note_render_start(frame_index, ordinal)
+        if controller.should_hang(ordinal):
+            await asyncio.Event().wait()  # parked until the run tears down
+        started = time.perf_counter()
+        timing = await self._inner.render_frame(job, frame_index)
+        multiplier = controller.render_multiplier()
+        if multiplier > 1.0:
+            # Stretch the frame's wall time by the straggler factor; only
+            # the exit timestamp moves, preserving the 7-point monotonic
+            # ordering the performance reducer requires.
+            await asyncio.sleep((time.perf_counter() - started) * (multiplier - 1.0))
+            timing = replace(timing, exited_process_at=time.time())
+        controller.note_render_done(frame_index, ordinal)
+        return timing

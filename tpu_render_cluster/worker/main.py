@@ -12,6 +12,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import os
+import signal
 import sys
 from pathlib import Path
 
@@ -143,6 +144,28 @@ def make_backend(args: argparse.Namespace):
     return create_backend("mock")
 
 
+async def _run_worker(worker: Worker):
+    """Run to completion with SIGTERM wired to a graceful drain.
+
+    A terminated worker daemon (node maintenance, preemption) finishes
+    the frame it is rendering, returns its queue to the master via the
+    goodbye message, and exits cleanly — instead of vanishing and making
+    the master pay a heartbeat-timeout eviction to rediscover the frames.
+    """
+    loop = asyncio.get_running_loop()
+    try:
+        loop.add_signal_handler(signal.SIGTERM, worker.request_drain)
+    except (NotImplementedError, RuntimeError):  # non-Unix loop
+        pass
+    try:
+        return await worker.connect_and_run_to_job_completion()
+    finally:
+        try:
+            loop.remove_signal_handler(signal.SIGTERM)
+        except (NotImplementedError, RuntimeError, ValueError):
+            pass
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     initialize_console_and_file_logging(args.log_file_path)
@@ -151,7 +174,7 @@ def main(argv: list[str] | None = None) -> int:
         backend.warm(args.warm_scene)
     worker = Worker(args.master_host, args.master_port, backend)
     try:
-        asyncio.run(worker.connect_and_run_to_job_completion())
+        asyncio.run(_run_worker(worker))
     finally:
         # Export this daemon's obs artifacts even when the run died (the
         # partial timeline matters most in exactly those runs): in

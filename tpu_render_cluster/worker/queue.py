@@ -87,6 +87,7 @@ class WorkerAutomaticQueue:
         self._frames: list[QueuedFrame] = []
         self._finished_indices: set[tuple[str, int]] = set()
         self._task: asyncio.Task | None = None
+        self._draining = False
         # Wakes the render loop as soon as work arrives; the 100 ms sleep
         # remains only as a fallback poll (the reference burns up to a full
         # poll interval of idle time per queue refill — queue.rs:74-96).
@@ -101,6 +102,11 @@ class WorkerAutomaticQueue:
         *,
         trace: pm.TraceContext | None = None,
     ) -> None:
+        if self._draining:
+            # Refuse, don't silently park: the add RPC answers errored and
+            # the master returns the frame to the pending pool — a frame
+            # accepted here after drain() collected the queue would be lost.
+            raise RuntimeError("Worker is draining; not accepting new frames.")
         self._frames.append(QueuedFrame(job, frame_index, trace=trace))
         self._work_available.set()
 
@@ -124,6 +130,28 @@ class WorkerAutomaticQueue:
     def queue_size(self) -> int:
         return len(self._frames)
 
+    async def drain(self) -> list[tuple[str, int]]:
+        """Graceful drain: finish the in-flight frame, hand back the rest.
+
+        Stops the loop from starting new frames, waits for the one
+        currently rendering to complete (its finished event goes out
+        normally), and returns the ``(job_name, frame_index)`` pairs that
+        never started — the payload of the goodbye message the runtime
+        sends so the master can requeue them without waiting for a
+        heartbeat-timeout eviction.
+        """
+        self._draining = True
+        self._work_available.set()  # wake the loop so it parks promptly
+        while any(f.state is FrameState.RENDERING for f in self._frames):
+            await asyncio.sleep(0.01)
+        returned = [
+            (f.job.job_name, f.frame_index)
+            for f in self._frames
+            if f.state is FrameState.QUEUED
+        ]
+        self._frames = [f for f in self._frames if f.state is not FrameState.QUEUED]
+        return returned
+
     # -- render loop ---------------------------------------------------------
 
     def start(self) -> None:
@@ -145,7 +173,7 @@ class WorkerAutomaticQueue:
 
     async def _run(self) -> None:
         while not self._cancellation.is_cancelled():
-            frame = self._next_queued()
+            frame = None if self._draining else self._next_queued()
             if frame is None:
                 self._work_available.clear()
                 try:

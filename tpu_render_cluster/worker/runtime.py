@@ -14,6 +14,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from typing import Callable
 
 from tpu_render_cluster import PROTOCOL_VERSION
 from tpu_render_cluster.obs import MetricsRegistry, Tracer, get_registry
@@ -71,6 +72,8 @@ class Worker:
         tracer: WorkerTraceBuilder | None = None,
         metrics: MetricsRegistry | None = None,
         span_tracer: Tracer | None = None,
+        connection_wrapper: Callable[[WebSocketConnection], WebSocketConnection]
+        | None = None,
     ) -> None:
         self.master_host = master_host
         self.master_port = master_port
@@ -89,8 +92,19 @@ class Worker:
             f"worker-{pm.worker_id_to_string(self.worker_id)}"
         )
         self.cancellation = CancellationToken()
+        # Fault-injection seam: wraps every freshly-upgraded socket
+        # (transport/faults.py FaultyConnection). None in production.
+        self._connection_wrapper = connection_wrapper
+        self._drain_requested = asyncio.Event()
         self._client: ReconnectingClient | None = None
         self._final_trace: WorkerTrace | None = None
+
+    def request_drain(self) -> None:
+        """Ask the worker to drain gracefully: finish the frame being
+        rendered, return the rest of the queue via the goodbye message,
+        and disconnect. Wired to SIGTERM by the CLI; safe to call from
+        any task on the worker's loop, idempotent."""
+        self._drain_requested.set()
 
     async def connect_and_run_to_job_completion(self) -> WorkerTrace:
         """Connect, serve the job protocol until job-finished, return the trace."""
@@ -103,7 +117,10 @@ class Worker:
                 track="connection",
             ):
                 ws = await connect_with_exponential_backoff(
-                    self.master_host, self.master_port, metrics=transport_metrics
+                    self.master_host,
+                    self.master_port,
+                    metrics=transport_metrics,
+                    wrap=self._connection_wrapper,
                 )
                 await asyncio.wait_for(
                     _perform_handshake(ws, self.worker_id, is_reconnect=is_reconnect),
@@ -188,6 +205,10 @@ class Worker:
                     metrics=self.metrics.to_wire(),
                     received_at=received_at,
                     responded_at=time.time(),
+                    # Correlate pong to ping: with pong-miss retries on the
+                    # master, an anonymous late pong could be mistaken for
+                    # the retry's answer.
+                    echo_request_time=request.request_time,
                 )
             )
             ping_counter += 1
@@ -286,11 +307,34 @@ class Worker:
             )
             job_done.set()
 
+        async def handle_drain() -> None:
+            await self._drain_requested.wait()
+            logger.info("Drain requested; finishing the in-flight frame.")
+            returned = await frame_queue.drain()
+            job_name = returned[0][0] if returned else None
+            await sender.send_message(
+                pm.WorkerGoodbyeEvent(
+                    reason="drain",
+                    job_name=job_name,
+                    returned_frames=tuple(index for _, index in returned),
+                )
+            )
+            logger.info(
+                "Goodbye sent (%d frame(s) returned); disconnecting.",
+                len(returned),
+            )
+            # No job-finished request will come for a departed worker:
+            # close out the trace locally so the caller still gets one.
+            self.tracer.set_job_finish_time(time.time())
+            self._final_trace = self.tracer.build()
+            job_done.set()
+
         tasks = [
             asyncio.create_task(handle_adds()),
             asyncio.create_task(handle_removes()),
             asyncio.create_task(handle_job_started()),
             asyncio.create_task(handle_job_finished()),
+            asyncio.create_task(handle_drain()),
         ]
         try:
             await job_done.wait()
