@@ -344,6 +344,101 @@ def wavefront_compare(
     return record
 
 
+def multi_job_bench(
+    jobs: int = 3,
+    frames: int = 8,
+    workers: int = 4,
+    reps: int = 5,
+    render_seconds: float = 0.05,
+) -> dict:
+    """Serial admission vs concurrent fair-share on the sched/ service.
+
+    Runs the SAME workload — ``jobs`` mock-render jobs of ``frames``
+    frames each over ``workers`` in-process workers — through the
+    multi-job scheduler twice per rep: once with
+    ``TRC_SCHED_MAX_ACTIVE_JOBS=1`` (jobs admitted strictly one at a
+    time, the single-job world's best case with zero restart overhead)
+    and once with all jobs concurrent under weighted fair-share. The
+    measured quantity is the service makespan (first admission to last
+    job completion). Jobs are deliberately tail-heavy (few frames per
+    worker), which is where concurrency pays: one job's wind-down tail
+    leaves workers idle that the next job's frames can fill.
+
+    ``reps`` interleaved repetitions, median per mode (the
+    bench-variance protocol: this host measures ±30% run-to-run, so only
+    interleaved median-of-reps A/B timings are meaningful). Mock-render
+    measurement — this benchmarks the SCHEDULER, not the render plane.
+    """
+    import statistics
+
+    from tpu_render_cluster.harness.local import run_local_multi_job
+    from tpu_render_cluster.jobs.models import BlenderJob, DistributionStrategy
+    from tpu_render_cluster.sched.models import JobSpec
+    from tpu_render_cluster.worker.backends.mock import MockBackend
+
+    def make_spec(index: int) -> JobSpec:
+        job = BlenderJob(
+            job_name=f"bench-mj-{index}",
+            job_description="multi-job scheduler bench",
+            project_file_path="%BASE%/p.blend",
+            render_script_path="%BASE%/s.py",
+            frame_range_from=1,
+            frame_range_to=frames,
+            wait_for_number_of_workers=workers,
+            frame_distribution_strategy=DistributionStrategy.naive_fine(),
+            output_directory_path="%BASE%/out",
+            output_file_name_format="rendered-#####",
+            output_file_format="PNG",
+        )
+        return JobSpec(job=job, weight=1.0)
+
+    def run_once(max_active: int) -> float:
+        saved = os.environ.get("TRC_SCHED_MAX_ACTIVE_JOBS")
+        os.environ["TRC_SCHED_MAX_ACTIVE_JOBS"] = str(max_active)
+        try:
+            specs = [make_spec(i) for i in range(jobs)]
+            backends = [
+                MockBackend(render_seconds=render_seconds) for _ in range(workers)
+            ]
+            _traces, job_ids, manager, _workers = run_local_multi_job(
+                specs, backends, timeout=300.0
+            )
+        finally:
+            if saved is None:
+                os.environ.pop("TRC_SCHED_MAX_ACTIVE_JOBS", None)
+            else:
+                os.environ["TRC_SCHED_MAX_ACTIVE_JOBS"] = saved
+        runs = [manager._runs[job_id] for job_id in job_ids]
+        first_admit = min(r.admitted_at for r in runs)
+        last_finish = max(r.finished_at for r in runs)
+        return last_finish - first_admit
+
+    makespans: dict[str, list[float]] = {"serial": [], "concurrent": []}
+    for _rep in range(reps):
+        # Interleaved A/B: machine-load drift cancels across modes.
+        makespans["serial"].append(run_once(1))
+        makespans["concurrent"].append(run_once(jobs))
+    record = {
+        "metric": (
+            f"sched multi-job makespan: {jobs} jobs x {frames} frames, "
+            f"{workers} workers, mock render {render_seconds}s"
+        ),
+        "unit": "seconds (median of interleaved reps)",
+        "jobs": jobs,
+        "frames_per_job": frames,
+        "workers": workers,
+        "reps": reps,
+        "serial_makespan_s": round(statistics.median(makespans["serial"]), 4),
+        "concurrent_makespan_s": round(
+            statistics.median(makespans["concurrent"]), 4
+        ),
+    }
+    record["concurrent_speedup"] = round(
+        record["serial_makespan_s"] / record["concurrent_makespan_s"], 3
+    )
+    return record
+
+
 def cpu_baseline_fps() -> float:
     pinned = os.environ.get("BENCH_CPU_FPS")
     if pinned:
@@ -378,6 +473,33 @@ def main() -> int:
         # Smaller sample for the slow CPU path (~1 fps): one 8-frame
         # dispatch, one window; fps scales linearly in frames.
         print(f"CPU_FPS={measure_fps(reps=1, min_window_s=0.0, chunks=1)}")
+        return 0
+
+    if "--multi-job" in sys.argv:
+
+        def int_flag(name: str, default: int) -> int:
+            if name in sys.argv:
+                return int(sys.argv[sys.argv.index(name) + 1])
+            return default
+
+        jobs = int_flag("--jobs", 3)
+        frames = int_flag("--frames", 8)
+        workers = int_flag("--workers", 4)
+        reps = int_flag("--reps", 5)
+        record = multi_job_bench(jobs=jobs, frames=frames, workers=workers, reps=reps)
+        record["command"] = (
+            f"python bench.py --multi-job --jobs {jobs} --frames {frames} "
+            f"--workers {workers} --reps {reps}"
+        )
+        print(json.dumps(record))
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "results",
+            "SCHED_BENCH.json",
+        )
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
         return 0
 
     if "--wavefront-compare" in sys.argv:

@@ -92,6 +92,32 @@ def test_plan_refuses_unsurvivable_configs():
         FaultPlan.generate(0, 2, kills=1, wedges=1)  # nobody left alive
 
 
+def test_unknown_fault_kind_rejected_with_vocabulary(tmp_path):
+    """A typo'd fault kind in a TOML plan fails at load time with the
+    valid vocabulary in the message — it must never produce a plan whose
+    fault silently never fires."""
+    plan_path = tmp_path / "typo.toml"
+    plan_path.write_text(
+        """
+seed = 1
+workers = 2
+
+[[events]]
+kind = "drop_snd"
+target = 0
+"""
+    )
+    with pytest.raises(ValueError) as excinfo:
+        FaultPlan.from_toml(plan_path)
+    message = str(excinfo.value)
+    assert "drop_snd" in message
+    for kind in ("drop_send", "kill_socket", "slow_render", "drain"):
+        assert kind in message
+    # Same guard on direct construction.
+    with pytest.raises(ValueError, match="Valid kinds"):
+        FaultEvent(kind="partitionn", target=0)
+
+
 def test_plan_toml_roundtrip(tmp_path):
     plan_path = tmp_path / "plan.toml"
     plan_path.write_text(

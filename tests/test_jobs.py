@@ -50,6 +50,89 @@ def test_missing_file_raises(tmp_path):
         BlenderJob.load_from_file(tmp_path / "nope.toml")
 
 
+def _job_kwargs(**overrides):
+    base = dict(
+        job_name="validation-test",
+        job_description=None,
+        project_file_path="%BASE%/p.blend",
+        render_script_path="%BASE%/s.py",
+        frame_range_from=1,
+        frame_range_to=10,
+        wait_for_number_of_workers=2,
+        frame_distribution_strategy=DistributionStrategy.naive_fine(),
+        output_directory_path="%BASE%/out",
+        output_file_name_format="rendered-#####",
+        output_file_format="PNG",
+    )
+    base.update(overrides)
+    return base
+
+
+class TestJobValidation:
+    """Structurally-broken jobs are rejected at construction/load time —
+    with the multi-job scheduler admitting remote submissions, a clear
+    submit-time error is the contract (previously an inverted range
+    silently produced a zero-frame job)."""
+
+    def test_inverted_frame_range(self):
+        with pytest.raises(ValueError, match="frame range is inverted"):
+            BlenderJob(**_job_kwargs(frame_range_from=10, frame_range_to=1))
+
+    def test_single_frame_range_is_valid(self):
+        job = BlenderJob(**_job_kwargs(frame_range_from=5, frame_range_to=5))
+        assert job.frame_count() == 1
+
+    def test_missing_project_path(self):
+        with pytest.raises(ValueError, match="project_file_path"):
+            BlenderJob(**_job_kwargs(project_file_path="   "))
+
+    def test_missing_render_script_path(self):
+        with pytest.raises(ValueError, match="render_script_path"):
+            BlenderJob(**_job_kwargs(render_script_path=""))
+
+    def test_missing_output_directory(self):
+        with pytest.raises(ValueError, match="output_directory_path"):
+            BlenderJob(**_job_kwargs(output_directory_path=""))
+
+    def test_empty_job_name(self):
+        with pytest.raises(ValueError, match="job_name"):
+            BlenderJob(**_job_kwargs(job_name=" "))
+
+    def test_zero_workers(self):
+        with pytest.raises(ValueError, match="wait_for_number_of_workers"):
+            BlenderJob(**_job_kwargs(wait_for_number_of_workers=0))
+
+    def test_multiple_problems_reported_together(self):
+        with pytest.raises(ValueError) as excinfo:
+            BlenderJob(
+                **_job_kwargs(
+                    frame_range_from=9,
+                    frame_range_to=2,
+                    project_file_path="",
+                    wait_for_number_of_workers=-1,
+                )
+            )
+        message = str(excinfo.value)
+        assert "frame range is inverted" in message
+        assert "project_file_path" in message
+        assert "wait_for_number_of_workers" in message
+
+    def test_invalid_toml_rejected_at_load(self, tmp_path):
+        bad = REFERENCE_SHAPED_TOML.replace(
+            "frame_range_to = 14400", "frame_range_to = 0"
+        )
+        path = tmp_path / "bad.toml"
+        path.write_text(bad)
+        with pytest.raises(ValueError, match="frame range is inverted"):
+            BlenderJob.load_from_file(path)
+
+    def test_from_dict_missing_key_raises(self):
+        data = BlenderJob(**_job_kwargs()).to_dict()
+        del data["project_file_path"]
+        with pytest.raises(KeyError):
+            BlenderJob.from_dict(data)
+
+
 def test_tpu_batch_strategy_round_trip():
     strategy = DistributionStrategy.tpu_batch_strategy(
         TpuBatchStrategyOptions(target_queue_size=6)

@@ -278,6 +278,70 @@ def summarize_wavefront(metrics: list[dict[str, Any]]) -> dict[str, Any] | None:
     return out
 
 
+def summarize_sched(metrics: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """Roll the multi-job scheduler's evidence up (sched/ artifacts).
+
+    Metrics snapshots written by a ``sched.JobManager`` carry a top-level
+    ``sched`` section (``scheduler_view()``) whose per-job views hold the
+    lifecycle record: makespan, admission wait, achieved vs. target share
+    over the multi-job overlap window, preemption counts, and the per-job
+    exactly-once ledger. Jobs are keyed ``<job_name>:<job_id>``; when the
+    same key appears in several snapshots (the live 1 Hz file plus the
+    final one) the newest ``written_at`` wins. None when no snapshot came
+    from a scheduler run — single-job runs get no ``sched`` section.
+    """
+    jobs: dict[str, tuple[float, dict[str, Any]]] = {}
+    for snapshot in metrics:
+        sched = snapshot.get("sched")
+        if not isinstance(sched, dict):
+            continue
+        written_at = float(snapshot.get("written_at", 0.0))
+        for job_id, view in (sched.get("jobs") or {}).items():
+            if not isinstance(view, dict):
+                continue
+            key = f"{view.get('job_name', '?')}:{job_id}"
+            share = view.get("share") if isinstance(view.get("share"), dict) else {}
+            entry = {
+                "job_id": job_id,
+                "job_name": view.get("job_name"),
+                "status": view.get("status"),
+                "weight": view.get("weight"),
+                "priority": view.get("priority"),
+                "frames_total": view.get("frames_total"),
+                "admission_wait_seconds": view.get("admission_wait_seconds"),
+                "makespan_seconds": view.get("makespan_seconds"),
+                "preemptions": view.get("preemptions", 0),
+                "share_target": share.get("target"),
+                "share_achieved": share.get("achieved"),
+                "overlap_seconds": share.get("overlap_seconds"),
+                "ledger": view.get("ledger"),
+            }
+            best = jobs.get(key)
+            if best is None or written_at >= best[0]:
+                jobs[key] = (written_at, entry)
+    if not jobs:
+        return None
+    entries = {key: entry for key, (_at, entry) in sorted(jobs.items())}
+    makespans = [
+        e["makespan_seconds"]
+        for e in entries.values()
+        if isinstance(e.get("makespan_seconds"), (int, float))
+    ]
+    out: dict[str, Any] = {
+        "jobs": entries,
+        "jobs_total": len(entries),
+        "finished": sum(1 for e in entries.values() if e["status"] == "finished"),
+        "cancelled": sum(1 for e in entries.values() if e["status"] == "cancelled"),
+        "preemptions_total": sum(
+            int(e.get("preemptions") or 0) for e in entries.values()
+        ),
+    }
+    if makespans:
+        out["makespan_seconds_max"] = max(makespans)
+        out["makespan_seconds_mean"] = sum(makespans) / len(makespans)
+    return out
+
+
 _CHAOS_LEDGER_COUNTERS = (
     "master_frame_results_total",
     "master_duplicate_results_total",
@@ -379,6 +443,9 @@ def summarize_obs(
     chaos = summarize_chaos(metrics)
     if chaos is not None:
         out["chaos"] = chaos
+    sched = summarize_sched(metrics)
+    if sched is not None:
+        out["sched"] = sched
     if cluster_traces:
         from tpu_render_cluster.analysis.critical_path import (
             summarize_critical_path,

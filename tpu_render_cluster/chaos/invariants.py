@@ -28,15 +28,22 @@ human-readable violations (empty = the cluster survived correctly):
 
 from __future__ import annotations
 
-from typing import TYPE_CHECKING, Any
+from typing import TYPE_CHECKING, Any, Iterable
 
 from tpu_render_cluster.chaos.plan import KIND_DUPLICATE_SEND, FaultPlan
-from tpu_render_cluster.master.state import FrameStatus
+from tpu_render_cluster.master.state import ClusterManagerState, FrameStatus
 
 if TYPE_CHECKING:
     from tpu_render_cluster.master.cluster import ClusterManager
+    from tpu_render_cluster.master.worker_handle import WorkerHandle
 
-__all__ = ["check_invariants", "counter_total", "ledger_stats"]
+__all__ = [
+    "check_invariants",
+    "check_job_invariants",
+    "check_multi_job_invariants",
+    "counter_total",
+    "ledger_stats",
+]
 
 
 def counter_total(
@@ -69,6 +76,137 @@ def ledger_stats(snapshot: dict[str, Any]) -> dict[str, float]:
         "evictions": counter_total(snapshot, "master_worker_evictions_total"),
         "drains": counter_total(snapshot, "master_worker_drains_total"),
     }
+
+
+def check_job_invariants(
+    state: ClusterManagerState,
+    workers: "Iterable[WorkerHandle]",
+    *,
+    expect_complete: bool = True,
+) -> list[str]:
+    """The PER-JOB exactly-once audit, over one job's frame table + ledger.
+
+    The multi-job analog of invariants 1-3: with several jobs sharing the
+    pool, the global metrics counters aggregate across jobs, so each
+    ``ClusterManagerState`` carries its own ledger (master/state.py) and
+    is audited here. ``expect_complete=False`` relaxes the completion +
+    exactly-once-count checks for cancelled jobs (which legitimately end
+    with unfinished frames) while still requiring their mirrors swept —
+    cancel must release workers with no ghost assignments.
+    """
+    violations: list[str] = []
+    job_name = state.job.job_name
+    total = len(state.frames)
+    if expect_complete:
+        unfinished = sorted(
+            index
+            for index, record in state.frames.items()
+            if record.status is not FrameStatus.FINISHED
+        )
+        if unfinished:
+            violations.append(
+                f"completion: {len(unfinished)} frame(s) not FINISHED: "
+                f"{unfinished[:10]}"
+            )
+        if state.finished_count() != total:
+            violations.append(
+                f"completion: finished_count {state.finished_count()} != "
+                f"frame table size {total}"
+            )
+        delivered_once = (
+            state.ledger["ok_results"] - state.ledger["duplicate_results"]
+        )
+        if delivered_once != total:
+            violations.append(
+                "exactly-once: ok_results - duplicate_results = "
+                f"{state.ledger['ok_results']} - "
+                f"{state.ledger['duplicate_results']} = {delivered_once}, "
+                f"expected {total} (frame table size)"
+            )
+    for worker in workers:
+        ghosts = sorted(
+            f.frame_index for f in worker.queue.frames_for_job(job_name)
+        )
+        if ghosts:
+            violations.append(
+                f"ghost assignments: worker {worker.worker_id:08x} still "
+                f"mirrors frame(s) {ghosts[:10]} of job {job_name!r}"
+            )
+    return violations
+
+
+def check_multi_job_invariants(
+    manager: "ClusterManager",
+    plan: FaultPlan,
+    *,
+    cluster_trace_document: Any | None = None,
+) -> list[str]:
+    """The fault-run audit for a scheduler (sched.JobManager) cluster.
+
+    Runs ``check_job_invariants`` per submission (completion expected for
+    finished jobs, ghost-sweep only for cancelled ones), plus the global
+    eviction/drain accounting and trace validity of ``check_invariants``
+    (the global ok-dup equation is per-job here: cancelled jobs' stale
+    results make the aggregate equation meaningless by design).
+    """
+    from tpu_render_cluster.sched.models import JOB_CANCELLED, JOB_FINISHED
+
+    violations: list[str] = []
+    runs = getattr(manager, "_runs", {})
+    for job_id, run in runs.items():
+        if run.state is None:
+            continue
+        expect_complete = run.status == JOB_FINISHED
+        if run.status not in (JOB_FINISHED, JOB_CANCELLED):
+            violations.append(
+                f"{job_id}: job ended the run in state {run.status!r}"
+            )
+        for problem in check_job_invariants(
+            run.state, manager.workers.values(), expect_complete=expect_complete
+        ):
+            violations.append(f"{job_id}: {problem}")
+
+    snapshot = manager.metrics.snapshot()
+    ledger = ledger_stats(snapshot)
+    expected_evictions = plan.expected_evictions()
+    if ledger["evictions"] != expected_evictions:
+        violations.append(
+            f"evictions: master_worker_evictions_total = "
+            f"{ledger['evictions']:.0f}, plan injected {expected_evictions} "
+            f"eviction-causing fault(s)"
+        )
+    expected_drains = plan.expected_drains()
+    if ledger["drains"] != expected_drains:
+        violations.append(
+            f"drains: master_worker_drains_total = {ledger['drains']:.0f}, "
+            f"plan injected {expected_drains} drain(s)"
+        )
+    absorbed = (
+        ledger["duplicate_results"]
+        + ledger["late_results"]
+        + ledger["stale_results"]
+    )
+    if KIND_DUPLICATE_SEND in plan.kinds() and absorbed < 1:
+        # Weaker than the single-job check on purpose: with several jobs
+        # sharing fewer slots each, the re-dispatch races shift — a
+        # duplicated result's twin may legally be absorbed as a LATE or
+        # STALE event instead of a duplicate (e.g. the delayed original
+        # lands before the requeued copy ever re-renders). What must
+        # never happen is the twin silently double-counting a finish —
+        # that is what the per-job ok-dup equations above pin down; this
+        # check only proves the dedup seam SAW an out-of-band result.
+        violations.append(
+            "duplicate visibility: plan duplicated a result send but no "
+            "duplicate/late/stale result was ever recorded — the twin was "
+            "never seen (or was double-counted as a fresh finish)"
+        )
+    if cluster_trace_document is not None:
+        from tpu_render_cluster.obs import validate_trace_document
+
+        problems = validate_trace_document(cluster_trace_document)
+        for problem in problems[:10]:
+            violations.append(f"cluster trace: {problem}")
+    return violations
 
 
 def check_invariants(

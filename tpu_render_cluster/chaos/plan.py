@@ -103,7 +103,13 @@ class FaultEvent:
 
     def __post_init__(self) -> None:
         if self.kind not in ALL_KINDS:
-            raise ValueError(f"Unknown fault kind: {self.kind!r}")
+            # List the vocabulary: a typo'd kind in a TOML plan must fail
+            # loudly at load time with the fix in the message, not produce
+            # a plan whose fault silently never fires.
+            raise ValueError(
+                f"Unknown fault kind: {self.kind!r}. "
+                f"Valid kinds: {', '.join(ALL_KINDS)}"
+            )
 
     def to_dict(self) -> dict[str, Any]:
         return asdict(self)

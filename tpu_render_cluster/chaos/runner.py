@@ -41,6 +41,7 @@ from typing import Any
 from tpu_render_cluster.chaos.inject import MasterChaosHooks, WorkerChaosController
 from tpu_render_cluster.chaos.invariants import (
     check_invariants,
+    check_multi_job_invariants,
     counter_total,
     ledger_stats,
 )
@@ -309,6 +310,140 @@ def run_chaos_job(
     )
 
 
+async def _chaos_multi_run(
+    specs,
+    backends: list[FaultyBackend],
+    controllers: list[WorkerChaosController],
+    hooks: MasterChaosHooks,
+    registries: list[MetricsRegistry],
+    master_registry: MetricsRegistry,
+):
+    from tpu_render_cluster.sched.manager import JobManager, SchedulerConfig
+
+    watchdogs: list[asyncio.Task] = []
+
+    async def on_cluster_started(manager, workers, worker_tasks) -> None:
+        for slot, worker in enumerate(workers):
+            hooks.map_worker(worker.worker_id, slot)
+            controllers[slot].attach(worker, worker_tasks[slot].cancel)
+            watchdogs.append(
+                asyncio.create_task(
+                    controllers[slot].run_timed_faults(),
+                    name=f"chaos-watchdog-{slot}",
+                )
+            )
+
+    try:
+        return await local_harness._run_multi_job(
+            specs,
+            backends,
+            manager_factory=lambda: JobManager(
+                "127.0.0.1",
+                0,
+                config=SchedulerConfig.from_env(),
+                metrics=master_registry,
+                dispatch_delay_fn=hooks.dispatch_delay,
+            ),
+            worker_factory=lambda slot, port, backend: Worker(
+                "127.0.0.1",
+                port,
+                backend,
+                metrics=registries[slot],
+                connection_wrapper=controllers[slot].wrap_connection,
+            ),
+            on_cluster_started=on_cluster_started,
+            worker_grace=3.0,
+            allow_worker_failures=True,
+        )
+    finally:
+        for watchdog in watchdogs:
+            watchdog.cancel()
+        await asyncio.gather(*watchdogs, return_exceptions=True)
+
+
+def run_chaos_multi_job(
+    plan: FaultPlan,
+    *,
+    jobs: int = 2,
+    frames: int = DEFAULT_FRAMES,
+    weights: list[float] | None = None,
+    render_seconds: float = DEFAULT_RENDER_SECONDS,
+    timeout: float = 240.0,
+) -> ChaosReport:
+    """Run CONCURRENT jobs through the scheduler under a seeded fault plan.
+
+    The multi-job counterpart of ``run_chaos_job``: the same per-slot
+    fault executors and compressed timeout profile, driving a
+    ``sched.JobManager`` service instead of the single-job master, with
+    ``jobs`` weighted submissions sharing the worker pool. The audit is
+    ``check_multi_job_invariants`` — per-job completion + exactly-once
+    ledgers + ghost sweeps, plus the plan's eviction/drain accounting.
+    """
+    from tpu_render_cluster.sched.models import JobSpec
+
+    weights = weights or [float(2 ** i) for i in range(jobs)]
+    if len(weights) != jobs:
+        raise ValueError(f"need {jobs} weights, got {len(weights)}")
+    specs = []
+    for i in range(jobs):
+        job = _make_job(plan, frames, None)
+        job = BlenderJob.from_dict(
+            {**job.to_dict(), "job_name": f"{job.job_name}-mj{i}"}
+        )
+        specs.append(JobSpec(job=job, weight=weights[i]))
+    registries = [MetricsRegistry() for _ in range(plan.workers)]
+    controllers = [
+        WorkerChaosController(slot, plan.events_for(slot), registry=registries[slot])
+        for slot in range(plan.workers)
+    ]
+    master_registry = MetricsRegistry()
+    hooks = MasterChaosHooks(plan, registry=master_registry)
+    backends = [
+        FaultyBackend(
+            MockBackend(
+                load_seconds=0.004,
+                save_seconds=0.004,
+                render_seconds=render_seconds,
+            ),
+            controllers[slot],
+        )
+        for slot in range(plan.workers)
+    ]
+    started = time.time()
+    with _timing_overrides(plan.timings):
+        worker_traces, job_ids, manager, workers = asyncio.run(
+            asyncio.wait_for(
+                _chaos_multi_run(
+                    specs, backends, controllers, hooks, registries,
+                    master_registry,
+                ),
+                timeout,
+            )
+        )
+
+    from tpu_render_cluster.obs import merge_timeline
+
+    cluster_trace_document = merge_timeline(manager.cluster_timeline_processes())
+    violations = check_multi_job_invariants(
+        manager, plan, cluster_trace_document=cluster_trace_document
+    )
+    master_snapshot = manager.metrics.snapshot()
+    stats: dict[str, Any] = {
+        "jobs": {
+            job_id: manager.job_status(job_id) for job_id in job_ids
+        },
+        "frames_total": frames * jobs,
+        "wall_seconds": time.time() - started,
+        "worker_traces_collected": len(worker_traces),
+        "faults_injected": _aggregate_fault_counts(registries, master_registry),
+        "ledger": ledger_stats(master_snapshot),
+        "reconnects": counter_total(
+            master_snapshot, "master_worker_reconnects_total"
+        ),
+    }
+    return ChaosReport(plan=plan, violations=violations, stats=stats)
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="trc-chaos", description="Seeded fault-injection harness"
@@ -316,6 +451,14 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--workers", type=int, default=3)
     parser.add_argument("--frames", type=int, default=DEFAULT_FRAMES)
+    parser.add_argument(
+        "--jobs",
+        type=int,
+        default=1,
+        help="Run N weighted jobs CONCURRENTLY through the sched.JobManager "
+        "service instead of one job on the single-job master (audited by "
+        "the per-job invariants; obs artifacts are skipped in this mode).",
+    )
     parser.add_argument(
         "--plan",
         default=None,
@@ -336,6 +479,12 @@ def main(argv: list[str] | None = None) -> int:
         plan = FaultPlan.from_toml(args.plan)
     else:
         plan = FaultPlan.generate(args.seed, args.workers)
+    if args.jobs > 1:
+        report = run_chaos_multi_job(
+            plan, jobs=args.jobs, frames=args.frames, timeout=args.timeout
+        )
+        print(json.dumps(report.to_dict(), indent=2))
+        return 0 if report.ok else 1
     results_directory = args.results_directory
     if results_directory is None:
         from tpu_render_cluster.analysis.paths import RESULTS_ROOT

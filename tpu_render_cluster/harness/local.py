@@ -119,6 +119,95 @@ async def _run(
     return master_trace, worker_traces, manager, workers
 
 
+async def _run_multi_job(
+    specs,
+    backends: list[RenderBackend],
+    *,
+    manager_factory=None,
+    worker_factory=None,
+    on_cluster_started=None,
+    driver=None,
+    worker_grace: float | None = None,
+    allow_worker_failures: bool = False,
+):
+    """Run the multi-job scheduler service over an in-process cluster.
+
+    The service analog of ``_run``: one ``sched.JobManager`` accepting
+    real localhost WebSockets, N workers, every ``JobSpec`` in ``specs``
+    submitted up front, then a drain request — ``serve()`` returns once
+    every job finished. The chaos seams match ``_run``'s
+    (``manager_factory()`` / ``worker_factory(slot, port, backend)`` /
+    ``on_cluster_started``); ``driver(manager, workers)`` additionally
+    runs after submission so tests can exercise the lifecycle API
+    (cancel mid-run, late submissions, status polls) against the live
+    service before the drain lands.
+    """
+    from tpu_render_cluster.sched.manager import JobManager
+
+    if manager_factory is not None:
+        manager = manager_factory()
+    else:
+        manager = JobManager("127.0.0.1", 0, metrics=MetricsRegistry())
+    serve_task = asyncio.create_task(manager.serve())
+    while manager._server is None:
+        if serve_task.done():
+            await serve_task
+            raise RuntimeError("scheduler serve task exited before startup")
+        await asyncio.sleep(0.01)
+    if worker_factory is not None:
+        workers = [
+            worker_factory(slot, manager.port, backend)
+            for slot, backend in enumerate(backends)
+        ]
+    else:
+        workers = [
+            Worker("127.0.0.1", manager.port, backend, metrics=MetricsRegistry())
+            for backend in backends
+        ]
+    worker_tasks = [
+        asyncio.create_task(w.connect_and_run_to_job_completion()) for w in workers
+    ]
+    if on_cluster_started is not None:
+        await on_cluster_started(manager, workers, worker_tasks)
+    job_ids = [manager.submit(spec) for spec in specs]
+    if driver is not None:
+        await driver(manager, workers)
+    manager.request_drain()
+    worker_traces = await serve_task
+    if allow_worker_failures and worker_grace is None:
+        worker_grace = 60.0
+    if worker_grace is None and not allow_worker_failures:
+        await asyncio.gather(*worker_tasks)
+    else:
+        _done, pending = await asyncio.wait(worker_tasks, timeout=worker_grace)
+        for task in pending:
+            task.cancel()
+        results = await asyncio.gather(*worker_tasks, return_exceptions=True)
+        if not allow_worker_failures:
+            for result in results:
+                if isinstance(result, Exception):
+                    raise result
+    return worker_traces, job_ids, manager, workers
+
+
+def run_local_multi_job(
+    specs,
+    backends: list[RenderBackend],
+    *,
+    timeout: float = 600.0,
+    driver=None,
+):
+    """Run jobs through the scheduler service on an in-process cluster.
+
+    Returns ``(worker_traces, job_ids, manager, workers)`` — the manager
+    is handed back live (post-shutdown) so callers can audit per-job
+    states, ledgers, and the scheduler view.
+    """
+    return asyncio.run(
+        asyncio.wait_for(_run_multi_job(specs, backends, driver=driver), timeout)
+    )
+
+
 def _run_local_job_full(
     job: BlenderJob, backends: list[RenderBackend], timeout: float
 ) -> tuple[MasterTrace, list[tuple[str, WorkerTrace]], ClusterManager, list[Worker]]:
@@ -174,6 +263,7 @@ def save_obs_artifacts(
     cluster_trace_path = export_cluster_trace(
         prefix_path.with_name(prefix_path.name + "_cluster_trace-events.json"),
         manager.cluster_timeline_processes(),
+        extra_other_data=manager.timeline_other_data(),
     )
     worker_snapshots = {
         worker_id_to_string(w.worker_id): w.metrics.snapshot() for w in workers

@@ -189,6 +189,39 @@ class BlenderJob:
     # New (optional, absent from reference TOMLs): default worker backend hint.
     render_backend: str | None = None
 
+    def __post_init__(self) -> None:
+        """Reject structurally-broken jobs at load time, not mid-dispatch.
+
+        The reference accepts any TOML that parses and fails much later
+        (an inverted frame range yields a job that 'finishes' instantly
+        with zero frames; an empty project path dies inside Blender).
+        With the multi-job scheduler admitting jobs from remote clients,
+        a clear submit-time error is the contract.
+        """
+        problems = []
+        if not self.job_name.strip():
+            problems.append("job_name must be non-empty")
+        if self.frame_range_to < self.frame_range_from:
+            problems.append(
+                f"frame range is inverted: frame_range_from={self.frame_range_from} "
+                f"> frame_range_to={self.frame_range_to}"
+            )
+        if not self.project_file_path.strip():
+            problems.append("project_file_path must be non-empty")
+        if not self.render_script_path.strip():
+            problems.append("render_script_path must be non-empty")
+        if not self.output_directory_path.strip():
+            problems.append("output_directory_path must be non-empty")
+        if self.wait_for_number_of_workers < 1:
+            problems.append(
+                "wait_for_number_of_workers must be >= 1, got "
+                f"{self.wait_for_number_of_workers}"
+            )
+        if problems:
+            raise ValueError(
+                f"Invalid job {self.job_name!r}: " + "; ".join(problems)
+            )
+
     # -- derived -----------------------------------------------------------
 
     def frame_indices(self) -> range:

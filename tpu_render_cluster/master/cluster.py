@@ -57,6 +57,21 @@ HANDSHAKE_TIMEOUT = 30.0
 BARRIER_POLL_SECONDS = 1.0  # reference: master/src/cluster/mod.rs:568-585
 
 
+def job_state_view(state: ClusterManagerState) -> dict:
+    """One job's live frame accounting + exactly-once ledger (the shared
+    shape of the single-job and scheduler ``jobs`` sections)."""
+    total = len(state.frames)
+    finished = state.finished_count()
+    pending = state.pending_count()
+    return {
+        "frames_total": total,
+        "frames_finished": finished,
+        "frames_pending": pending,
+        "frames_in_flight": total - finished - pending,
+        "ledger": dict(state.ledger),
+    }
+
+
 class ClusterManager:
     """Runs one job across a cluster of connected workers."""
 
@@ -64,7 +79,7 @@ class ClusterManager:
         self,
         host: str,
         port: int,
-        job: BlenderJob,
+        job: BlenderJob | None,
         *,
         metrics: MetricsRegistry | None = None,
         span_tracer: Tracer | None = None,
@@ -73,11 +88,17 @@ class ClusterManager:
     ) -> None:
         self.host = host
         self.port = port
+        # ``job=None`` is the SERVICE mode used by the multi-job scheduler
+        # subclass (sched/manager.py JobManager): no frame table exists at
+        # construction; per-job states are created at admission and looked
+        # up through ``_state_for_job``. The single-job contract (one job,
+        # one state, reference wire traffic) is unchanged when a job is
+        # given.
         self.job = job
         # Chaos shim: ``(worker_id, frame_index) -> seconds`` to stall a
         # queue-add dispatch (master/worker_handle.py). None in production.
         self._dispatch_delay_fn = dispatch_delay_fn
-        self.state = ClusterManagerState(job)
+        self.state = ClusterManagerState(job) if job is not None else None
         self.workers: dict[int, WorkerHandle] = {}
         self.cancellation = CancellationToken()
         # Defaults to the process-global registry so process-scoped sources
@@ -100,12 +121,34 @@ class ClusterManager:
         self._job_started = False
         self._server: asyncio.Server | None = None
 
+    # -- multi-job hooks (overridden by sched/manager.py JobManager) --------
+
+    def _state_for_job(self, job_name: str | None) -> ClusterManagerState | None:
+        """Map a worker event's ``job_name`` to the owning frame table.
+
+        Single-job masters own exactly one state and every event belongs
+        to it; the scheduler subclass resolves against its active-job map
+        (returning None for cancelled/finished jobs, whose late events are
+        then accounted as stale instead of applied).
+        """
+        return self.state
+
+    def _active_job_announcements(self) -> list[tuple[int | None, str | None]]:
+        """(trace_id, job_id) per job a late-joining worker must learn of.
+
+        Resolves the inherited reference FIXME (master/src/cluster/mod.rs:
+        616-617): a worker whose handshake completes after job start still
+        receives the job-started event(s) — generalized to *every* active
+        job so it holds with several jobs running concurrently.
+        """
+        if self._job_started and self.state is not None:
+            return [(self.state.trace_id, None)]
+        return []
+
     # -- public ------------------------------------------------------------
 
-    async def initialize_server_and_run_job(
-        self,
-    ) -> tuple[MasterTrace, list[tuple[str, WorkerTrace]]]:
-        """Bind, run the job to completion, and collect all traces."""
+    async def _bind_server(self) -> None:
+        """Bind the accept loop + start the live snapshot writer."""
         self._server = await asyncio.start_server(
             self._on_tcp_connection, self.host, self.port
         )
@@ -114,45 +157,82 @@ class ClusterManager:
         logger.info("Master listening on %s:%d", self.host, actual_port)
         if self._snapshot_writer is not None:
             self._snapshot_writer.start()
+
+    async def _shutdown_server(self) -> None:
+        """Stop the writer, cancel, close worker sockets, close the server."""
+        if self._snapshot_writer is not None:
+            await self._snapshot_writer.stop()
+        self.cancellation.cancel()
+        # Close worker sockets BEFORE wait_closed(): since 3.12,
+        # Server.wait_closed() waits for every live connection handler.
+        for worker in list(self.workers.values()):
+            await worker.shutdown()
+        self._server.close()
+        try:
+            await asyncio.wait_for(self._server.wait_closed(), 5.0)
+        except asyncio.TimeoutError:
+            logger.warning("Server close timed out; continuing shutdown.")
+
+    async def initialize_server_and_run_job(
+        self,
+    ) -> tuple[MasterTrace, list[tuple[str, WorkerTrace]]]:
+        """Bind, run the job to completion, and collect all traces."""
+        await self._bind_server()
         try:
             master_trace = await self._wait_for_workers_and_run_job()
             with self.span_tracer.span("collect traces", cat="master", track="job"):
                 worker_traces = await self._collect_worker_traces()
             return master_trace, worker_traces
         finally:
-            if self._snapshot_writer is not None:
-                await self._snapshot_writer.stop()
-            self.cancellation.cancel()
-            # Close worker sockets BEFORE wait_closed(): since 3.12,
-            # Server.wait_closed() waits for every live connection handler.
-            for worker in list(self.workers.values()):
-                await worker.shutdown()
-            self._server.close()
-            try:
-                await asyncio.wait_for(self._server.wait_closed(), 5.0)
-            except asyncio.TimeoutError:
-                logger.warning("Server close timed out; continuing shutdown.")
+            await self._shutdown_server()
 
     def live_workers(self) -> list[WorkerHandle]:
         return [w for w in self.workers.values() if not w.is_dead]
 
+    def _jobs_view(self) -> dict:
+        """Per-job live view folded into ``cluster_view()['jobs']`` (and
+        with it into ``metrics-live.json``). Single-job masters report
+        their one job with a trivially-full share; the scheduler subclass
+        reports every submission with its fair-share targets."""
+        if self.state is None:
+            return {}
+        return {
+            self.state.job.job_name: {
+                **job_state_view(self.state),
+                "state": (
+                    "finished" if self.state.all_frames_finished()
+                    else ("running" if self._job_started else "waiting")
+                ),
+                "share_target": 1.0,
+                "share_achieved": 1.0,
+            }
+        }
+
     def cluster_view(self) -> dict:
         """Live cluster-wide extras for the metrics snapshot.
 
-        Combines the master's own frame-table view with the most recent
-        compact metrics payload each worker piggybacked on its heartbeat
-        pong, plus their ``merge_wire`` aggregation.
+        Combines the master's own frame-table view (all jobs' frame tables
+        summed) with the most recent compact metrics payload each worker
+        piggybacked on its heartbeat pong, plus their ``merge_wire``
+        aggregation, and a per-job ``jobs`` section.
         """
         worker_payloads = {
             pm.worker_id_to_string(w.worker_id): w.latest_worker_metrics
             for w in self.workers.values()
             if w.latest_worker_metrics is not None
         }
+        jobs_view = self._jobs_view()
         view: dict = {
             "cluster": {
-                "frames_total": len(self.state.frames),
-                "frames_finished": self.state.finished_count(),
-                "frames_pending": self.state.pending_count(),
+                "frames_total": sum(
+                    v["frames_total"] for v in jobs_view.values()
+                ),
+                "frames_finished": sum(
+                    v["frames_finished"] for v in jobs_view.values()
+                ),
+                "frames_pending": sum(
+                    v["frames_pending"] for v in jobs_view.values()
+                ),
                 "workers": {
                     pm.worker_id_to_string(w.worker_id): {
                         "queue_depth": len(w.queue),
@@ -161,7 +241,8 @@ class ClusterManager:
                     }
                     for w in self.workers.values()
                 },
-            }
+            },
+            "jobs": jobs_view,
         }
         if worker_payloads:
             view["worker_metrics"] = worker_payloads
@@ -173,6 +254,12 @@ class ClusterManager:
             except Exception as e:  # noqa: BLE001
                 logger.warning("Worker metrics payloads failed to merge: %s", e)
         return view
+
+    def timeline_other_data(self) -> dict | None:
+        """Extra ``otherData`` for the merged cluster timeline (the
+        scheduler subclass stamps its per-job summary; single-job masters
+        add nothing)."""
+        return None
 
     def cluster_timeline_processes(self) -> list[TimelineProcess]:
         """Everything the merged cluster timeline needs, master row first.
@@ -311,6 +398,7 @@ class ClusterManager:
             metrics=self.metrics,
             span_tracer=self.span_tracer,
             dispatch_delay_fn=dispatch_delay_fn,
+            state_resolver=self._state_for_job,
         )
         self.workers[worker_id] = worker
         worker.start()
@@ -319,20 +407,24 @@ class ClusterManager:
             worker_id,
             ws.peer_address(),
             len(self.workers),
-            self.job.wait_for_number_of_workers,
+            self.job.wait_for_number_of_workers if self.job is not None else 0,
         )
-        # Late joiners still learn the job has started (reference FIXME at
-        # master/src/cluster/mod.rs:616-617).
-        if self._job_started:
-            await worker.send_job_started()
+        # Late joiners still learn which jobs have started (reference FIXME
+        # at master/src/cluster/mod.rs:616-617) — replayed for EVERY active
+        # job, which becomes load-bearing once several run concurrently.
+        for trace_id, job_id in self._active_job_announcements():
+            await worker.send_job_started(trace_id=trace_id, job_id=job_id)
 
     async def _evict_worker(self, worker: WorkerHandle, reason: str) -> None:
-        """Return a dead worker's frames to the pool so the job can finish."""
+        """Return a dead worker's frames to the pool so its jobs can finish."""
         logger.warning("Evicting worker %08x: %s", worker.worker_id, reason)
         for frame in worker.queue.all_frames():
-            record = self.state.frames.get(frame.frame_index)
+            state = self._state_for_job(frame.job_name)
+            if state is None:
+                continue  # the owning job is already gone
+            record = state.frames.get(frame.frame_index)
             if record is not None and record.status is not FrameStatus.FINISHED:
-                self.state.return_frame_to_pending(frame.frame_index)
+                state.return_frame_to_pending(frame.frame_index)
         # No ghost assignments: a dead worker's mirror must not keep
         # offering steal candidates (or claim queue depth) for frames that
         # just went back to the pool.

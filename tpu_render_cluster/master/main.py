@@ -3,13 +3,17 @@
 Flag surface matches the reference's clap parser (reference:
 master/src/cli.rs:5-40, master/src/main.rs:275-338):
 ``master --host H --port P [--logFilePath F] run-job <job.toml>
---resultsDirectory D``.
+--resultsDirectory D`` — plus the NEW ``serve`` subcommand running the
+multi-job scheduler service (sched/manager.py): workers connect on
+``--port`` as usual, jobs arrive over the JSON-lines control plane on
+``--controlPort`` (``python -m tpu_render_cluster.sched.submit``).
 """
 
 from __future__ import annotations
 
 import argparse
 import asyncio
+import json
 import sys
 import time
 from datetime import datetime
@@ -56,7 +60,72 @@ def build_parser() -> argparse.ArgumentParser:
         default=".",
         help="%%BASE%% root used to resolve the output directory for --resume.",
     )
+    serve = subparsers.add_parser(
+        "serve",
+        help="Run the multi-job scheduler service: jobs are submitted over "
+        "the JSON-lines control port (python -m tpu_render_cluster.sched.submit) "
+        "and multiplexed over the shared worker pool with weighted "
+        "fair-share + preemption; the service exits after a drain request "
+        "once every job has finished.",
+    )
+    serve.add_argument(
+        "--controlPort",
+        dest="control_port",
+        type=int,
+        default=9902,
+        help="TCP port of the JSON-lines control plane (submit/status/cancel/drain).",
+    )
+    serve.add_argument(
+        "--resultsDirectory",
+        dest="results_directory",
+        default=None,
+        help="Where the service's obs artifacts + metrics-live.json land "
+        "(defaults to the canonical results/cluster-runs directory).",
+    )
     return parser
+
+
+async def serve_command(args: argparse.Namespace) -> int:
+    from tpu_render_cluster.sched.control import ControlServer
+    from tpu_render_cluster.sched.manager import JobManager
+
+    if args.results_directory is None:
+        from tpu_render_cluster.analysis.paths import DEFAULT_RESULTS_DIR
+
+        args.results_directory = str(DEFAULT_RESULTS_DIR)
+    results_directory = Path(args.results_directory)
+    manager = JobManager(
+        args.host,
+        args.port,
+        metrics_snapshot_path=results_directory / "metrics-live.json",
+    )
+    control = ControlServer(manager, args.host, args.control_port)
+    await control.start()
+    print(
+        f"Scheduler serving: workers on {args.host}:{args.port}, "
+        f"control on {args.host}:{control.port}. Submit with "
+        f"python -m tpu_render_cluster.sched.submit --host {args.host} "
+        f"--controlPort {control.port} submit <job.toml>."
+    )
+    try:
+        await manager.serve()
+    finally:
+        await control.stop()
+    prefix = f"sched-{datetime.now().strftime('%Y-%m-%d_%H-%M-%S')}"
+    manager.span_tracer.export(results_directory / f"{prefix}_trace-events.json")
+    export_cluster_trace(
+        results_directory / f"{prefix}_cluster_trace-events.json",
+        manager.cluster_timeline_processes(),
+        extra_other_data=manager.timeline_other_data(),
+    )
+    write_metrics_snapshot(
+        results_directory / f"{prefix}_metrics.json",
+        manager.metrics,
+        extra=manager.cluster_view(),
+    )
+    view = manager.scheduler_view()
+    print(json.dumps({"jobs": view["jobs"]}, indent=2, default=str))
+    return 0
 
 
 async def run_job_command(args: argparse.Namespace) -> int:
@@ -140,6 +209,8 @@ def main(argv: list[str] | None = None) -> int:
     initialize_console_and_file_logging(args.log_file_path)
     if args.command == "run-job":
         return asyncio.run(run_job_command(args))
+    if args.command == "serve":
+        return asyncio.run(serve_command(args))
     return 2
 
 

@@ -5,6 +5,13 @@ Reference: ``WorkerQueue`` / ``FrameOnWorker``
 workers by load and pick steal candidates without a network round-trip; the
 atomic size counter of the reference collapses to ``len()`` because all
 mutation happens on one event loop.
+
+Multi-job extension: a worker's queue can hold frames from SEVERAL jobs
+(sched/manager.py multiplexes them), and two jobs may legitimately contain
+the same frame index, so entries are keyed by ``(job_name, frame_index)``.
+Callers that don't pass a job name (single-job code paths, older tests)
+fall back to an index-only scan — with one job on the queue that is the
+exact pre-multi-job behavior.
 """
 
 from __future__ import annotations
@@ -26,25 +33,57 @@ class FrameOnWorker:
     # frame's Perfetto flow even when the terminating event (a
     # reference-shaped C++ worker's, a steal, an eviction) doesn't echo it.
     trace: "TraceContext | None" = None
+    # Owning job (multi-job masters; None on the legacy single-job path).
+    job_name: str | None = None
+    job_id: str | None = None
 
 
 class WorkerQueueMirror:
     """Insertion-ordered mirror of a worker's remote queue."""
 
     def __init__(self) -> None:
-        self._frames: dict[int, FrameOnWorker] = {}
+        self._frames: dict[tuple[str | None, int], FrameOnWorker] = {}
 
     def __len__(self) -> int:
         return len(self._frames)
 
     def __contains__(self, frame_index: int) -> bool:
-        return frame_index in self._frames
+        return self._find_key(frame_index) is not None
+
+    def _find_key(
+        self, frame_index: int, job_name: str | None = None
+    ) -> tuple[str | None, int] | None:
+        """Exact ``(job_name, frame_index)`` hit, else a LEGACY-only scan.
+
+        The fallback keeps pre-multi-job callers working (entries added
+        without a job_name, single-job mirrors) but must never cross
+        jobs: a caller that names a job may only fall back to entries
+        that were added WITHOUT one — otherwise a duplicate event for
+        job A's already-popped frame could pop job B's same-index entry.
+        """
+        if (job_name, frame_index) in self._frames:
+            return (job_name, frame_index)
+        for key in self._frames:
+            if key[1] == frame_index and (job_name is None or key[0] is None):
+                return key
+        return None
 
     def add(self, frame: FrameOnWorker) -> None:
-        self._frames[frame.frame_index] = frame
+        self._frames[(frame.job_name, frame.frame_index)] = frame
 
-    def remove(self, frame_index: int) -> FrameOnWorker | None:
-        return self._frames.pop(frame_index, None)
+    def get(
+        self, frame_index: int, job_name: str | None = None
+    ) -> FrameOnWorker | None:
+        key = self._find_key(frame_index, job_name)
+        return self._frames[key] if key is not None else None
+
+    def remove(
+        self, frame_index: int, job_name: str | None = None
+    ) -> FrameOnWorker | None:
+        key = self._find_key(frame_index, job_name)
+        if key is None:
+            return None
+        return self._frames.pop(key)
 
     def clear(self) -> None:
         """Drop every mirrored frame (eviction/drain: the worker is gone
@@ -52,10 +91,10 @@ class WorkerQueueMirror:
         pass could try to act on)."""
         self._frames.clear()
 
-    def set_rendering(self, frame_index: int) -> None:
-        frame = self._frames.get(frame_index)
-        if frame is not None:
-            frame.is_rendering = True
+    def set_rendering(self, frame_index: int, job_name: str | None = None) -> None:
+        key = self._find_key(frame_index, job_name)
+        if key is not None:
+            self._frames[key].is_rendering = True
 
     def queued_frames_in_order(self) -> list[FrameOnWorker]:
         """Frames not yet rendering, oldest first (steal-candidate order)."""
@@ -63,6 +102,10 @@ class WorkerQueueMirror:
 
     def all_frames(self) -> list[FrameOnWorker]:
         return list(self._frames.values())
+
+    def frames_for_job(self, job_name: str) -> list[FrameOnWorker]:
+        """This job's mirrored frames, insertion order (sched/cancel path)."""
+        return [f for f in self._frames.values() if f.job_name == job_name]
 
     def pending_size(self) -> int:
         """Queue entries that have not started rendering."""

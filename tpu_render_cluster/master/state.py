@@ -40,7 +40,13 @@ class FrameRecord:
 
 
 class ClusterManagerState:
-    """Global frame table; single event loop, so no locking is needed."""
+    """Per-job frame table; single event loop, so no locking is needed.
+
+    One instance per RUNNING job: the single-job master owns exactly one,
+    the multi-job scheduler (sched/manager.py) one per admitted job, with
+    WorkerHandle routing worker events to the right instance by the
+    reference ``job_name`` field every event already carries.
+    """
 
     def __init__(self, job: BlenderJob) -> None:
         self.job = job
@@ -48,11 +54,27 @@ class ClusterManagerState:
         # carries it, so artifacts from different runs never alias
         # (protocol/messages.py TraceContext rides on this).
         self.trace_id: int = generate_trace_id()
+        # Scheduler job id (sched/ only; None on the single-job path).
+        # Guards job-name reuse: a late result stamped with a PREVIOUS
+        # submission's job_id must not count against a new job that
+        # happens to share the name.
+        self.sched_job_id: str | None = None
         self.frames: dict[int, FrameRecord] = {
             index: FrameRecord(index) for index in job.frame_indices()
         }
         self._pending: deque[int] = deque(job.frame_indices())
         self._finished_count = 0
+        # Per-job exactly-once ledger, updated by WorkerHandle at the same
+        # points as the global ``master_*_results_total`` counters so the
+        # PR-4 chaos invariant (ok - duplicates == frames_total) can be
+        # audited PER JOB when several share the worker pool.
+        self.ledger: dict[str, int] = {
+            "ok_results": 0,
+            "errored_results": 0,
+            "duplicate_results": 0,
+            "late_results": 0,
+            "stale_results": 0,
+        }
 
     # -- queries -----------------------------------------------------------
 
@@ -74,6 +96,16 @@ class ClusterManagerState:
     def pending_count(self) -> int:
         return sum(
             1 for i in self._pending if self.frames[i].status is FrameStatus.PENDING
+        )
+
+    def in_flight_count(self) -> int:
+        """Frames currently queued-on or rendering-on some worker — the
+        quantity the fair-share scheduler meters per job."""
+        return sum(
+            1
+            for record in self.frames.values()
+            if record.status
+            in (FrameStatus.QUEUED_ON_WORKER, FrameStatus.RENDERING_ON_WORKER)
         )
 
     def pending_frames(self, limit: int | None = None) -> list[int]:

@@ -114,9 +114,21 @@ def find_busiest_worker_and_frame_to_steal(
 # Strategy loops
 
 
-async def _queue_one_pending(
-    worker: "WorkerHandle", job: BlenderJob, state: ClusterManagerState
+async def dispatch_one_pending(
+    worker: "WorkerHandle",
+    job: BlenderJob,
+    state: ClusterManagerState,
+    *,
+    job_id: str | None = None,
 ) -> bool:
+    """Claim + RPC-dispatch one pending frame of ``state`` onto ``worker``.
+
+    The shared dispatch primitive: every single-job strategy and the
+    multi-job fair-share loop (sched/manager.py) go through here, so the
+    claim-before-RPC double-queue guard and the failure-requeue path have
+    exactly one definition. ``job_id`` is the scheduler's submission id,
+    piggybacked on the wire (None on the single-job path).
+    """
     frame_index = state.next_pending_frame()
     if frame_index is None:
         return False
@@ -124,7 +136,7 @@ async def _queue_one_pending(
     # double-queue the frame, then confirm via RPC.
     state.mark_frame_as_queued(frame_index, worker.worker_id, time.time())
     try:
-        await worker.queue_frame(job, frame_index)
+        await worker.queue_frame(job, frame_index, job_id=job_id)
     except Exception as e:  # noqa: BLE001 - worker failure mid-RPC
         logger.warning(
             "Failed to queue frame %d on %08x: %s", frame_index, worker.worker_id, e
@@ -132,6 +144,12 @@ async def _queue_one_pending(
         state.return_frame_to_pending(frame_index)
         return False
     return True
+
+
+async def _queue_one_pending(
+    worker: "WorkerHandle", job: BlenderJob, state: ClusterManagerState
+) -> bool:
+    return await dispatch_one_pending(worker, job, state)
 
 
 async def naive_fine_strategy(
@@ -261,6 +279,51 @@ async def steal_frame(
     logger.debug(
         "Stole frame %d: %08x -> %08x", frame_index, victim.worker_id, thief.worker_id
     )
+    return True
+
+
+async def preempt_frame(
+    job: BlenderJob,
+    state: ClusterManagerState,
+    victim: "WorkerHandle",
+    frame_index: int,
+) -> bool:
+    """Unqueue a not-yet-rendering frame back to its job's pending pool.
+
+    The fair-share scheduler's preemption primitive: the first half of a
+    steal (the same frame-queue-remove RPC with the same race tolerance —
+    ``already-rendering`` / ``already-finished`` silently abort), except
+    the frame returns to ITS OWN job's pending pool instead of moving to a
+    thief, freeing the worker slot for an under-share job's next dispatch.
+    """
+    try:
+        result = await victim.unqueue_frame(job.job_name, frame_index)
+    except Exception as e:  # noqa: BLE001
+        logger.warning(
+            "Preempt unqueue RPC failed on %08x: %s", victim.worker_id, e
+        )
+        return False
+    if result != pm.FRAME_QUEUE_REMOVE_RESULT_REMOVED:
+        return False
+    # Same await-point races as steal_frame: the victim may have died (or
+    # the assignment moved) while the RPC was in flight. Requeue the frame
+    # here exactly when this worker still owns its live assignment —
+    # eviction already requeued it otherwise.
+    record = state.frames.get(frame_index)
+    owned_by_victim = (
+        record is not None
+        and record.status is FrameStatus.QUEUED_ON_WORKER
+        and record.worker_id == victim.worker_id
+    )
+    if not owned_by_victim:
+        logger.warning(
+            "Preemption of frame %d aborted: victim %08x lost the "
+            "assignment mid-RPC.",
+            frame_index,
+            victim.worker_id,
+        )
+        return False
+    state.return_frame_to_pending(frame_index)
     return True
 
 

@@ -84,16 +84,24 @@ class WorkerHandle:
         self,
         worker_id: int,
         connection: ReconnectableServerConnection,
-        state: ClusterManagerState,
+        state: ClusterManagerState | None,
         *,
         on_dead: Callable[["WorkerHandle", str], Awaitable[None]] | None = None,
         metrics: MetricsRegistry | None = None,
         span_tracer: Tracer | None = None,
         dispatch_delay_fn: Callable[[int], float] | None = None,
+        state_resolver: Callable[[str | None], ClusterManagerState | None]
+        | None = None,
     ) -> None:
         self.worker_id = worker_id
         self.connection = connection
+        # Single-job masters pass the one state; the multi-job scheduler
+        # passes ``state=None`` plus a resolver mapping the ``job_name``
+        # every worker event carries to the owning job's state (None for
+        # a job that is no longer active — cancelled or finished — whose
+        # late events are then accounted as stale instead of applied).
         self.state = state
+        self._state_resolver = state_resolver
         self.queue = WorkerQueueMirror()
         self.frames_stolen_count = 0
         self.is_dead = False
@@ -115,8 +123,9 @@ class WorkerHandle:
         # Chrome trace events the worker piggybacked on its job-finished
         # response ({"process_name", "events"}), for the cluster timeline.
         self.collected_span_events: dict | None = None
-        # Observed per-frame render durations (for scheduler cost models).
-        self._rendering_started_at: dict[int, float] = {}
+        # Observed per-frame render durations (for scheduler cost models),
+        # keyed (job_name, frame_index) — frame indices alias across jobs.
+        self._rendering_started_at: dict[tuple[str, int], float] = {}
         self._completion_observations: list[tuple[int, float]] = []
         self._on_dead = on_dead
         self.logger = WorkerLogger(
@@ -207,6 +216,30 @@ class WorkerHandle:
         if self._on_dead is not None:
             await self._on_dead(self, reason)
 
+    # -- state routing --------------------------------------------------------
+
+    def _state_for(self, job_name: str | None) -> ClusterManagerState | None:
+        """The frame table owning ``job_name``'s frames (see __init__)."""
+        if self._state_resolver is not None:
+            return self._state_resolver(job_name)
+        return self.state
+
+    @staticmethod
+    def _job_generation_mismatch(
+        state: ClusterManagerState | None, event_job_id: str | None
+    ) -> bool:
+        """True when an event is stamped with a DIFFERENT submission's
+        job_id than the active job of the same name — i.e. the name was
+        reused after a cancel/finish and this event belongs to the old
+        generation. Anonymous events (C++ workers echo no job_id) always
+        match."""
+        return (
+            state is not None
+            and event_job_id is not None
+            and state.sched_job_id is not None
+            and event_job_id != state.sched_job_id
+        )
+
     # -- observability helpers ----------------------------------------------
 
     def _worker_label(self) -> str:
@@ -265,21 +298,33 @@ class WorkerHandle:
         frame_index: int,
         *,
         stolen_from: int | None = None,
+        job_id: str | None = None,
     ) -> None:
         """RPC a frame onto this worker's queue; sync mirror + global state.
 
-        Reference: master/src/connection/mod.rs:139-168.
+        Reference: master/src/connection/mod.rs:139-168. ``job_id`` is the
+        multi-job scheduler's submission id, piggybacked on the wire and
+        echoed by (Python) workers; single-job dispatch leaves it None and
+        the request encodes byte-identically to before.
         """
         if self.is_dead:
             raise RuntimeError("Worker is dead; refusing dispatch.")
+        state = self._state_for(job.job_name)
+        if state is None:
+            # The dispatch raced a cancel: the job is gone, nothing to queue.
+            raise RuntimeError(
+                f"Job {job.job_name!r} is no longer active; refusing dispatch."
+            )
         if self._dispatch_delay_fn is not None:
             delay = self._dispatch_delay_fn(frame_index)
             if delay > 0.0:
                 await asyncio.sleep(delay)
         # Fresh span per ASSIGNMENT (not per frame): a re-queued or stolen
         # frame starts a new causal chain with its own Perfetto flow.
-        trace = pm.TraceContext.new(self.state.trace_id)
-        request = pm.MasterFrameQueueAddRequest.new(job, frame_index, trace=trace)
+        trace = pm.TraceContext.new(state.trace_id)
+        request = pm.MasterFrameQueueAddRequest.new(
+            job, frame_index, trace=trace, job_id=job_id
+        )
         rpc_started = time.perf_counter()
         rpc_started_wall = time.time()
         response = await request_response(
@@ -298,8 +343,16 @@ class WorkerHandle:
         # frame and swept the mirror, so completing the assignment here
         # would stomp the live record and open a Perfetto flow nothing
         # ever closes. The worker may still render its ghost copy; the
-        # finished-event dedup path absorbs that result.
-        record = self.state.frames.get(frame_index)
+        # finished-event dedup path absorbs that result. A job cancelled
+        # mid-RPC counts as superseded too — compared by state IDENTITY,
+        # so a same-named job resubmitted during the RPC window cannot
+        # adopt (and then wedge on) the old submission's dispatch.
+        if self._state_for(job.job_name) is not state:
+            raise RuntimeError(
+                f"Assignment of frame {frame_index} was superseded "
+                f"mid-dispatch (job {job.job_name!r} was cancelled/replaced)."
+            )
+        record = state.frames.get(frame_index)
         if (
             self.is_dead
             or record is None
@@ -307,11 +360,11 @@ class WorkerHandle:
         ):
             raise RuntimeError(
                 f"Assignment of frame {frame_index} was superseded "
-                f"mid-dispatch ({'worker died' if self.is_dead else 'frame finished'})."
+                f"mid-dispatch ({'worker died' if self.is_dead else 'frame finished or job gone'})."
             )
         rpc_seconds = time.perf_counter() - rpc_started
         if self.metrics is not None:
-            strategy = self.state.job.frame_distribution_strategy.strategy_type
+            strategy = state.job.frame_distribution_strategy.strategy_type
             self.metrics.histogram(
                 "master_assignment_latency_seconds",
                 "queue-add RPC round-trip (request sent to ack received)",
@@ -346,11 +399,16 @@ class WorkerHandle:
         now = time.time()
         self.queue.add(
             FrameOnWorker(
-                frame_index, queued_at=now, stolen_from=stolen_from, trace=trace
+                frame_index,
+                queued_at=now,
+                stolen_from=stolen_from,
+                trace=trace,
+                job_name=job.job_name,
+                job_id=job_id,
             )
         )
         self._update_queue_depth_gauge()
-        self.state.mark_frame_as_queued(
+        state.mark_frame_as_queued(
             frame_index,
             self.worker_id,
             now,
@@ -376,7 +434,7 @@ class WorkerHandle:
             timeout=rpc_deadline_seconds(),
         )
         if response.result == pm.FRAME_QUEUE_REMOVE_RESULT_REMOVED:
-            removed = self.queue.remove(frame_index)
+            removed = self.queue.remove(frame_index, job_name)
             self._update_queue_depth_gauge()
             # A successful steal ends this assignment's causal chain (the
             # thief's queue_frame opens a fresh one) — terminate the flow
@@ -402,9 +460,17 @@ class WorkerHandle:
 
     # -- job lifecycle RPCs --------------------------------------------------
 
-    async def send_job_started(self) -> None:
+    async def send_job_started(
+        self, *, trace_id: int | None = None, job_id: str | None = None
+    ) -> None:
+        """Announce a job start. Single-job callers pass nothing (the one
+        state's trace id is used); the multi-job scheduler passes each
+        admitted job's (trace_id, job_id) — including replays to late
+        joiners, one event per active job."""
+        if trace_id is None and self.state is not None:
+            trace_id = self.state.trace_id
         await self.sender.send_message(
-            pm.MasterJobStartedEvent(trace_id=self.state.trace_id)
+            pm.MasterJobStartedEvent(trace_id=trace_id, job_id=job_id)
         )
 
     async def finish_job_and_get_trace(self):
@@ -424,9 +490,18 @@ class WorkerHandle:
 
     # -- background loops ----------------------------------------------------
 
-    def _count_anomaly(self, name: str, help_text: str) -> None:
+    def _count_anomaly(
+        self,
+        name: str,
+        help_text: str,
+        *,
+        state: ClusterManagerState | None = None,
+        ledger_key: str | None = None,
+    ) -> None:
         if self.metrics is not None:
             self.metrics.counter(name, help_text).inc()
+        if state is not None and ledger_key is not None:
+            state.ledger[ledger_key] += 1
 
     def _is_current_assignment(self, record) -> bool:
         """Does this worker own the frame's LIVE assignment right now?
@@ -444,37 +519,89 @@ class WorkerHandle:
             and record.worker_id == self.worker_id
         )
 
+    def _mirror_entry_for_event(
+        self, frame_index: int, job_name: str, event_job_id: str | None
+    ):
+        """The mirror entry an incoming event may touch, or None.
+
+        Generation guard: after a cancel + same-name resubmit, the mirror
+        key (job_name, frame_index) can be occupied by the NEW
+        submission's dispatch while a late event from the OLD one is
+        still in flight — only an entry whose job_id matches (or where
+        either side is anonymous) belongs to this event.
+        """
+        entry = self.queue.get(frame_index, job_name)
+        if (
+            entry is not None
+            and entry.job_id is not None
+            and event_job_id is not None
+            and entry.job_id != event_job_id
+        ):
+            return None
+        return entry
+
     def _apply_rendering_event(
         self, event: pm.WorkerFrameQueueItemRenderingEvent
     ) -> None:
-        record = self.state.frames.get(event.frame_index)
-        if not self._is_current_assignment(record):
+        state = self._state_for(event.job_name)
+        # Keep the mirror honest even for a defunct job: a frame that
+        # started rendering must stop looking like a steal candidate —
+        # but never touch a same-keyed entry of a NEWER generation.
+        if (
+            self._mirror_entry_for_event(
+                event.frame_index, event.job_name, event.job_id
+            )
+            is not None
+        ):
+            self.queue.set_rendering(event.frame_index, event.job_name)
+        if self._job_generation_mismatch(state, event.job_id):
+            state = None
+        record = state.frames.get(event.frame_index) if state is not None else None
+        if state is None or not self._is_current_assignment(record):
             # E.g. the queue-add ack timed out (frame requeued elsewhere)
-            # but the add had landed, and the superseded copy now renders.
+            # but the add had landed, and the superseded copy now renders;
+            # or the job was cancelled while the frame sat on the worker.
             self._count_anomaly(
                 "master_stale_results_total",
                 "Worker events ignored because the frame's live assignment "
-                "moved on (eviction, steal, requeue, or already finished)",
+                "moved on (eviction, steal, requeue, cancel, or already "
+                "finished)",
+                state=state,
+                ledger_key="stale_results",
             )
             self.logger.debug(
                 "Stale rendering event for frame %d ignored.", event.frame_index
             )
             return
         self.logger.debug("Frame %d started rendering.", event.frame_index)
-        self._rendering_started_at[event.frame_index] = time.time()
-        self.queue.set_rendering(event.frame_index)
-        self.state.mark_frame_as_rendering(event.frame_index, self.worker_id)
+        self._rendering_started_at[(event.job_name, event.frame_index)] = time.time()
+        state.mark_frame_as_rendering(event.frame_index, self.worker_id)
 
     def _apply_finished_event(
         self, event: pm.WorkerFrameQueueItemFinishedEvent
     ) -> None:
         received_wall = time.time()
         received_mono = time.perf_counter()
-        record = self.state.frames.get(event.frame_index)
-        frame_on_worker = self.queue.remove(event.frame_index)
-        # Popped unconditionally: the duplicate/late/stale returns below
-        # must not leave a ghost in-flight entry on this handle.
-        started = self._rendering_started_at.pop(event.frame_index, None)
+        state = self._state_for(event.job_name)
+        if self._job_generation_mismatch(state, event.job_id):
+            state = None
+        record = state.frames.get(event.frame_index) if state is not None else None
+        # Popped unconditionally — the duplicate/late/stale returns below
+        # must not leave a ghost in-flight entry on this handle — EXCEPT
+        # when the same-keyed entry belongs to a newer generation of a
+        # reused job name: that entry is another submission's live
+        # assignment, not this event's.
+        frame_on_worker = None
+        if (
+            self._mirror_entry_for_event(
+                event.frame_index, event.job_name, event.job_id
+            )
+            is not None
+        ):
+            frame_on_worker = self.queue.remove(event.frame_index, event.job_name)
+        started = self._rendering_started_at.pop(
+            (event.job_name, event.frame_index), None
+        )
         self._update_queue_depth_gauge()
         if self.metrics is not None:
             self.metrics.counter(
@@ -482,6 +609,33 @@ class WorkerHandle:
                 "Frame finished events received from workers, by wire result",
                 labels=("result",),
             ).inc(result=event.result)
+        if state is None:
+            # The job is gone (cancelled, or a stale generation of a
+            # reused name): account the event, close the assignment's
+            # Perfetto flow IF this handle still held it open (an earlier
+            # unqueue/evict already terminated it otherwise), apply
+            # nothing. This is how a cancelled job's mid-render frames
+            # release their workers with no ghost assignments.
+            self._count_anomaly(
+                "master_stale_results_total",
+                "Worker events ignored because the frame's live assignment "
+                "moved on (eviction, steal, requeue, cancel, or already "
+                "finished)",
+            )
+            self._complete_frame_flow(
+                "frame result",
+                event.frame_index,
+                frame_on_worker.trace if frame_on_worker is not None else None,
+                start_wall=received_wall,
+                duration=time.perf_counter() - received_mono,
+                extra_args={"result": event.result, "job_gone": True},
+            )
+            self.logger.debug(
+                "Result for frame %d of defunct job %r ignored.",
+                event.frame_index,
+                event.job_name,
+            )
+            return
         finished_already = record is None or record.status is FrameStatus.FINISHED
         current = self._is_current_assignment(record)
         # Terminal span of the assignment's causal chain on the master
@@ -504,6 +658,7 @@ class WorkerHandle:
             extra_args={"result": event.result},
         )
         if event.result == pm.FRAME_QUEUE_ITEM_FINISHED_OK:
+            state.ledger["ok_results"] += 1
             if finished_already:
                 # The duplicate-result race: a duplicated delivery, or the
                 # re-render of an evicted frame lost to the original's late
@@ -513,6 +668,8 @@ class WorkerHandle:
                 self._count_anomaly(
                     "master_duplicate_results_total",
                     "Ok results received for frames that were already finished",
+                    state=state,
+                    ledger_key="duplicate_results",
                 )
                 self.logger.warning(
                     "Duplicate result for frame %d ignored.", event.frame_index
@@ -526,13 +683,15 @@ class WorkerHandle:
                 self._count_anomaly(
                     "master_late_results_total",
                     "Ok results accepted from superseded assignments",
+                    state=state,
+                    ledger_key="late_results",
                 )
                 self.logger.warning(
                     "Late result for frame %d accepted from a superseded "
                     "assignment.",
                     event.frame_index,
                 )
-                self.state.mark_frame_as_finished(event.frame_index)
+                state.mark_frame_as_finished(event.frame_index)
                 return
             self.logger.debug("Frame %d finished.", event.frame_index)
             if started is None and frame_on_worker is not None:
@@ -541,8 +700,9 @@ class WorkerHandle:
                 self._completion_observations.append(
                     (event.frame_index, max(1e-4, time.time() - started))
                 )
-            self.state.mark_frame_as_finished(event.frame_index)
+            state.mark_frame_as_finished(event.frame_index)
         else:
+            state.ledger["errored_results"] += 1
             if not current:
                 # An errored result for a frame this worker no longer owns
                 # must NOT requeue it: the live assignment is
@@ -551,7 +711,10 @@ class WorkerHandle:
                 self._count_anomaly(
                     "master_stale_results_total",
                     "Worker events ignored because the frame's live assignment "
-                    "moved on (eviction, steal, requeue, or already finished)",
+                    "moved on (eviction, steal, requeue, cancel, or already "
+                    "finished)",
+                    state=state,
+                    ledger_key="stale_results",
                 )
                 self.logger.warning(
                     "Stale errored result for frame %d ignored.",
@@ -566,7 +729,7 @@ class WorkerHandle:
                 event.frame_index,
                 event.error_reason,
             )
-            self.state.return_frame_to_pending(event.frame_index)
+            state.return_frame_to_pending(event.frame_index)
 
     async def _handle_goodbye(self, event: pm.WorkerGoodbyeEvent) -> None:
         """Graceful drain: requeue the returned frames without an eviction.
@@ -582,13 +745,21 @@ class WorkerHandle:
         self.drained = True
         self.cancel_heartbeat()
         now = time.time()
-        indices = set(event.returned_frames) | {
-            f.frame_index for f in self.queue.all_frames()
-        }
+        # Mirror entries carry their owning job; the advisory indices the
+        # goodbye shipped are attributed to its (single) job_name — in a
+        # multi-job cluster the mirror sweep is authoritative anyway,
+        # since everything the master credits to this worker is mirrored.
+        items = {(f.job_name, f.frame_index) for f in self.queue.all_frames()}
+        items |= {(event.job_name, index) for index in event.returned_frames}
         requeued = 0
-        for frame_index in sorted(indices):
-            record = self.state.frames.get(frame_index)
-            frame = self.queue.remove(frame_index)
+        for job_name, frame_index in sorted(
+            items, key=lambda item: (item[0] or "", item[1])
+        ):
+            state = self._state_for(job_name)
+            record = (
+                state.frames.get(frame_index) if state is not None else None
+            )
+            frame = self.queue.remove(frame_index, job_name)
             if frame is not None:
                 self._complete_frame_flow(
                     "frame returned",
@@ -603,7 +774,7 @@ class WorkerHandle:
                 and record.status is not FrameStatus.FINISHED
                 and record.worker_id == self.worker_id
             ):
-                self.state.return_frame_to_pending(frame_index)
+                state.return_frame_to_pending(frame_index)
                 requeued += 1
         self._update_queue_depth_gauge()
         if self.metrics is not None:

@@ -83,12 +83,20 @@ def rebase_events(
     return out
 
 
-def merge_timeline(processes: Iterable[TimelineProcess]) -> dict[str, Any]:
+def merge_timeline(
+    processes: Iterable[TimelineProcess],
+    *,
+    extra_other_data: dict[str, Any] | None = None,
+) -> dict[str, Any]:
     """Build the merged, offset-corrected cluster timeline document.
 
     Process order is preserved (callers put the master first so it renders
     as the top row); pids are reassigned 1..N. ``export_cluster_trace``
     writes this to disk; the chaos harness also validates it in memory.
+    ``extra_other_data`` lands under ``otherData`` — the multi-job
+    scheduler stamps its per-job lifecycle summary there (``sched_jobs``),
+    so a reader can map the master row's per-job tracks (``job job-NNNN``)
+    back to names/weights/makespans without a second artifact.
     """
     events: list[dict[str, Any]] = []
     offsets: dict[str, float] = {}
@@ -115,14 +123,19 @@ def merge_timeline(processes: Iterable[TimelineProcess]) -> dict[str, Any]:
     }
     if dropped:
         document["otherData"]["dropped_events"] = dropped
+    if extra_other_data:
+        document["otherData"].update(extra_other_data)
     return document
 
 
 def export_cluster_trace(
-    path: str | Path, processes: Iterable[TimelineProcess]
+    path: str | Path,
+    processes: Iterable[TimelineProcess],
+    *,
+    extra_other_data: dict[str, Any] | None = None,
 ) -> Path:
     """Write the merged cluster timeline (see ``merge_timeline``)."""
-    document = merge_timeline(processes)
+    document = merge_timeline(processes, extra_other_data=extra_other_data)
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     path.write_text(json.dumps(document), encoding="utf-8")

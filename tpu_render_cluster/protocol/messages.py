@@ -8,7 +8,10 @@ shared/src/messages/mod.rs:171). Requests carry a random u64
 ``message_request_id``; responses echo it as ``message_request_context_id``
 (shared/src/messages/utilities.rs:5-14, shared/src/messages/queue.rs:13-100).
 ``event_worker-goodbye`` is this repo's one NEW message (graceful drain);
-every other extension rides as optional keys inside reference payloads.
+every other extension rides as optional keys inside reference payloads —
+``trace`` (causal context), the heartbeat metrics/clock fields, and
+``job_id`` (the multi-job scheduler's submission id, PROTOCOL.md
+§Multi-job scheduling).
 
 Worker IDs are random u32s displayed as 8-hex
 (shared/src/messages/handshake.rs:9-26).
@@ -92,6 +95,22 @@ def _trace_from_payload(payload: dict[str, Any]) -> TraceContext | None:
 def worker_id_to_string(worker_id: int) -> str:
     """Workers display as 8-hex (reference: shared/src/messages/handshake.rs:14-17)."""
     return f"{worker_id:08x}"
+
+
+def _job_id_from_payload(payload: dict[str, Any]) -> str | None:
+    """Decode the optional ``job_id`` key (piggyback idiom: absent -> None).
+
+    Rides queue-add requests and their echo events when the master runs
+    the multi-job scheduler (sched/), uniquely naming the job *submission*
+    even across job-name reuse. Single-job masters never set it, so their
+    wire traffic stays byte-identical to the reference.
+    """
+    job_id = payload.get("job_id")
+    if job_id is None:
+        return None
+    if not isinstance(job_id, str):
+        raise ValueError("job_id must be a string")
+    return job_id
 
 
 # ---------------------------------------------------------------------------
@@ -206,12 +225,19 @@ class MasterFrameQueueAddRequest(Message):
     # Optional causal context (beyond-reference, piggyback idiom): absent
     # on the wire decodes to None; the C++ worker ignores the extra key.
     trace: TraceContext | None = None
+    # Optional scheduler job id (multi-job masters only, same idiom).
+    job_id: str | None = None
 
     @classmethod
     def new(
-        cls, job: BlenderJob, frame_index: int, *, trace: TraceContext | None = None
+        cls,
+        job: BlenderJob,
+        frame_index: int,
+        *,
+        trace: TraceContext | None = None,
+        job_id: str | None = None,
     ) -> "MasterFrameQueueAddRequest":
-        return cls(generate_message_request_id(), job, frame_index, trace)
+        return cls(generate_message_request_id(), job, frame_index, trace, job_id)
 
     def to_payload(self) -> dict[str, Any]:
         out = {
@@ -221,6 +247,8 @@ class MasterFrameQueueAddRequest(Message):
         }
         if self.trace is not None:
             out["trace"] = self.trace.to_dict()
+        if self.job_id is not None:
+            out["job_id"] = self.job_id
         return out
 
     @classmethod
@@ -230,6 +258,7 @@ class MasterFrameQueueAddRequest(Message):
             job=BlenderJob.from_dict(payload["job"]),
             frame_index=int(payload["frame_index"]),
             trace=_trace_from_payload(payload),
+            job_id=_job_id_from_payload(payload),
         )
 
 
@@ -331,6 +360,8 @@ class WorkerFrameQueueItemRenderingEvent(Message):
     frame_index: int
     # Echo of the queue-add request's optional trace context.
     trace: TraceContext | None = None
+    # Echo of the queue-add request's optional scheduler job id.
+    job_id: str | None = None
 
     def to_payload(self) -> dict[str, Any]:
         out: dict[str, Any] = {
@@ -339,6 +370,8 @@ class WorkerFrameQueueItemRenderingEvent(Message):
         }
         if self.trace is not None:
             out["trace"] = self.trace.to_dict()
+        if self.job_id is not None:
+            out["job_id"] = self.job_id
         return out
 
     @classmethod
@@ -347,6 +380,7 @@ class WorkerFrameQueueItemRenderingEvent(Message):
             str(payload["job_name"]),
             int(payload["frame_index"]),
             trace=_trace_from_payload(payload),
+            job_id=_job_id_from_payload(payload),
         )
 
 
@@ -367,12 +401,22 @@ class WorkerFrameQueueItemFinishedEvent(Message):
     # Echo of the queue-add request's optional trace context, so the
     # master can terminate the frame's flow without local bookkeeping.
     trace: TraceContext | None = None
+    # Echo of the queue-add request's optional scheduler job id.
+    job_id: str | None = None
 
     @classmethod
     def new_ok(
-        cls, job_name: str, frame_index: int, *, trace: TraceContext | None = None
+        cls,
+        job_name: str,
+        frame_index: int,
+        *,
+        trace: TraceContext | None = None,
+        job_id: str | None = None,
     ) -> "WorkerFrameQueueItemFinishedEvent":
-        return cls(job_name, frame_index, FRAME_QUEUE_ITEM_FINISHED_OK, trace=trace)
+        return cls(
+            job_name, frame_index, FRAME_QUEUE_ITEM_FINISHED_OK, trace=trace,
+            job_id=job_id,
+        )
 
     @classmethod
     def new_errored(
@@ -382,10 +426,11 @@ class WorkerFrameQueueItemFinishedEvent(Message):
         reason: str,
         *,
         trace: TraceContext | None = None,
+        job_id: str | None = None,
     ) -> "WorkerFrameQueueItemFinishedEvent":
         return cls(
             job_name, frame_index, FRAME_QUEUE_ITEM_FINISHED_ERRORED, reason,
-            trace=trace,
+            trace=trace, job_id=job_id,
         )
 
     def to_payload(self) -> dict[str, Any]:
@@ -396,6 +441,8 @@ class WorkerFrameQueueItemFinishedEvent(Message):
         }
         if self.trace is not None:
             out["trace"] = self.trace.to_dict()
+        if self.job_id is not None:
+            out["job_id"] = self.job_id
         return out
 
     @classmethod
@@ -407,6 +454,7 @@ class WorkerFrameQueueItemFinishedEvent(Message):
             result,
             reason,
             trace=_trace_from_payload(payload),
+            job_id=_job_id_from_payload(payload),
         )
 
 
@@ -540,16 +588,25 @@ class MasterJobStartedEvent(Message):
 
     type_name: ClassVar[str] = "event_job-started"
     trace_id: int | None = None
+    # Optional scheduler job id (multi-job masters announce one event per
+    # ACTIVE job — late joiners get them all replayed at handshake time).
+    job_id: str | None = None
 
     def to_payload(self) -> dict[str, Any]:
-        if self.trace_id is None:
-            return {}
-        return {"trace_id": self.trace_id}
+        out: dict[str, Any] = {}
+        if self.trace_id is not None:
+            out["trace_id"] = self.trace_id
+        if self.job_id is not None:
+            out["job_id"] = self.job_id
+        return out
 
     @classmethod
     def from_payload(cls, payload: dict[str, Any]) -> "MasterJobStartedEvent":
         trace_id = payload.get("trace_id")
-        return cls(trace_id=None if trace_id is None else int(trace_id))
+        return cls(
+            trace_id=None if trace_id is None else int(trace_id),
+            job_id=_job_id_from_payload(payload),
+        )
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,45 @@
+"""Multi-job scheduler: admission queue, weighted fair-share, preemptive
+job lifecycle over one shared worker pool.
+
+Public surface:
+
+- ``JobManager`` / ``SchedulerConfig`` (sched/manager.py) — the service;
+- ``JobSpec`` / ``JobRun`` + job-state constants (sched/models.py);
+- ``fair_share`` (sched/fair_share.py) — the pure scheduling policy;
+- ``ControlServer`` / ``control_request`` (sched/control.py) — the
+  JSON-lines control plane ``python -m tpu_render_cluster.sched.submit``
+  talks to.
+"""
+
+from tpu_render_cluster.sched.control import (
+    ControlServer,
+    control_request,
+    control_request_sync,
+    handle_request,
+)
+from tpu_render_cluster.sched.manager import JobManager, SchedulerConfig
+from tpu_render_cluster.sched.models import (
+    JOB_CANCELLED,
+    JOB_FINISHED,
+    JOB_QUEUED,
+    JOB_RUNNING,
+    JOB_STATES,
+    JobRun,
+    JobSpec,
+)
+
+__all__ = [
+    "ControlServer",
+    "JobManager",
+    "JobRun",
+    "JobSpec",
+    "JOB_CANCELLED",
+    "JOB_FINISHED",
+    "JOB_QUEUED",
+    "JOB_RUNNING",
+    "JOB_STATES",
+    "SchedulerConfig",
+    "control_request",
+    "control_request_sync",
+    "handle_request",
+]

@@ -1,0 +1,607 @@
+"""The multi-job scheduler: admission, fair-share dispatch, preemption.
+
+``JobManager`` turns the master into a long-running service. It reuses
+the whole single-job stack — the accepting server, 3-step handshake,
+heartbeats, worker handles, eviction, drain, the exactly-once result
+ledger — by subclassing ``ClusterManager`` in SERVICE mode (``job=None``)
+and overriding the two multi-job hooks:
+
+- ``_state_for_job``: worker events route to the owning job's frame table
+  by the reference ``job_name`` field every event already carries (so C++
+  workers that echo no ``job_id`` piggyback still route correctly);
+- ``_active_job_announcements``: late-joining workers get one
+  ``event_job-started`` replay per ACTIVE job.
+
+Scheduling model (sched/fair_share.py): jobs are admitted from a queue
+(priority order, capped by ``TRC_SCHED_MAX_ACTIVE_JOBS`` and each job's
+worker barrier), then one dispatch loop multiplexes every running job
+over the shared worker pool — per tick, each worker below its target
+queue size receives the next frame of the runnable job with the smallest
+``in_flight / weight`` (weighted fair queueing), and an over-share job is
+preempted (its newest not-yet-rendering frame unqueued back to its own
+pending pool, via the same frame-queue-remove RPC steals use) when
+another job is starved by at least a whole slot.
+
+Lifecycle API (``submit`` / ``job_status`` / ``cancel_job`` /
+``request_drain``) is exposed over a JSON-lines control socket
+(sched/control.py) consumed by ``python -m tpu_render_cluster.sched.submit``
+and the master CLI's ``serve`` subcommand.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any
+
+from tpu_render_cluster.master.cluster import ClusterManager
+from tpu_render_cluster.master.state import ClusterManagerState
+from tpu_render_cluster.master.strategies import (
+    dispatch_one_pending,
+    preempt_frame,
+)
+from tpu_render_cluster.master.worker_handle import WorkerHandle
+from tpu_render_cluster.obs import MetricsRegistry, Tracer
+from tpu_render_cluster.sched import fair_share
+from tpu_render_cluster.sched.models import (
+    JOB_CANCELLED,
+    JOB_FINISHED,
+    JOB_QUEUED,
+    JOB_RUNNING,
+    JobRun,
+    JobSpec,
+)
+from tpu_render_cluster.traces.worker_trace import WorkerTrace
+from tpu_render_cluster.utils.env import env_float, env_int
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class SchedulerConfig:
+    """Tuning knobs, each with a ``TRC_SCHED_*`` environment override."""
+
+    # Dispatch/admission tick. The single-job strategies tick at 50 ms
+    # (reference: strategies.rs); the service loop matches.
+    tick_seconds: float = 0.05
+    # In-flight frame slots per live worker (the eager-naive-coarse
+    # "target queue size" generalized to the whole service).
+    target_queue_size: int = 2
+    # Concurrently RUNNING jobs; further submissions wait in admission.
+    max_active_jobs: int = 4
+    # Master-side preemption of over-share jobs (fair_share.pick_preemption).
+    preemption: bool = True
+    max_preemptions_per_tick: int = 1
+    # While DRAINING with nothing running, queued jobs whose worker
+    # barrier exceeds the live pool are cancelled after this grace (late
+    # worker connects get that long to satisfy the barrier); without it a
+    # drained service would park forever on an unadmittable job.
+    drain_barrier_grace_seconds: float = 10.0
+
+    @classmethod
+    def from_env(cls) -> "SchedulerConfig":
+        return cls(
+            tick_seconds=env_float("TRC_SCHED_TICK_SECONDS", cls.tick_seconds),
+            target_queue_size=env_int(
+                "TRC_SCHED_TARGET_QUEUE_SIZE", cls.target_queue_size
+            ),
+            max_active_jobs=env_int(
+                "TRC_SCHED_MAX_ACTIVE_JOBS", cls.max_active_jobs
+            ),
+            preemption=env_int("TRC_SCHED_PREEMPTION", 1) != 0,
+            max_preemptions_per_tick=env_int(
+                "TRC_SCHED_MAX_PREEMPTIONS_PER_TICK", cls.max_preemptions_per_tick
+            ),
+            drain_barrier_grace_seconds=env_float(
+                "TRC_SCHED_DRAIN_GRACE_SECONDS", cls.drain_barrier_grace_seconds
+            ),
+        )
+
+
+class JobManager(ClusterManager):
+    """Long-running multi-job master over one shared worker pool."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        config: SchedulerConfig | None = None,
+        metrics: MetricsRegistry | None = None,
+        span_tracer: Tracer | None = None,
+        metrics_snapshot_path: str | Path | None = None,
+        dispatch_delay_fn=None,
+    ) -> None:
+        super().__init__(
+            host,
+            port,
+            None,  # service mode: no single job, per-job states at admission
+            metrics=metrics,
+            span_tracer=span_tracer,
+            metrics_snapshot_path=metrics_snapshot_path,
+            dispatch_delay_fn=dispatch_delay_fn,
+        )
+        self.config = config if config is not None else SchedulerConfig.from_env()
+        self._runs: dict[str, JobRun] = {}  # job_id -> run, submit order
+        self._admission: list[str] = []  # queued job_ids, submit order
+        self._running: list[str] = []  # running job_ids, admission order
+        self._active_by_name: dict[str, JobRun] = {}
+        self._draining = False
+        self._drain_stuck_since: float | None = None
+        self._job_seq = 0
+        self._started_serving = time.time()
+
+    # -- ClusterManager hooks -------------------------------------------------
+
+    def _state_for_job(self, job_name: str | None) -> ClusterManagerState | None:
+        if job_name is None:
+            return None
+        run = self._active_by_name.get(job_name)
+        return run.state if run is not None else None
+
+    def _active_job_announcements(self) -> list[tuple[int | None, str | None]]:
+        out: list[tuple[int | None, str | None]] = []
+        for job_id in self._running:
+            run = self._runs[job_id]
+            if run.state is not None:
+                out.append((run.state.trace_id, run.job_id))
+        return out
+
+    def _jobs_view(self) -> dict:
+        return {job_id: run.view() for job_id, run in self._runs.items()}
+
+    # -- lifecycle API --------------------------------------------------------
+
+    def submit(self, spec: JobSpec) -> str:
+        """Queue one submission; returns its job_id. Raises on duplicate
+        active job names (the wire protocol routes results by job_name,
+        so two live jobs must never share one) and when draining."""
+        if self._draining:
+            raise RuntimeError("Scheduler is draining; not accepting jobs.")
+        name = spec.job.job_name
+        if name in self._active_by_name or any(
+            self._runs[job_id].job_name == name for job_id in self._admission
+        ):
+            raise ValueError(
+                f"A job named {name!r} is already queued or running; "
+                "job names must be unique among active jobs."
+            )
+        self._job_seq += 1
+        job_id = f"job-{self._job_seq:04d}"
+        run = JobRun(job_id=job_id, spec=spec, submitted_at=time.time())
+        self._runs[job_id] = run
+        self._admission.append(job_id)
+        self.metrics.counter(
+            "sched_jobs_submitted_total", "Jobs submitted to the scheduler"
+        ).inc()
+        self.span_tracer.instant(
+            "job submitted",
+            cat="sched",
+            track=f"job {job_id}",
+            args={"job_id": job_id, "job_name": name, "weight": spec.weight,
+                  "priority": spec.priority},
+        )
+        logger.info(
+            "Job %s submitted: %r (weight=%g, priority=%d, %d frames).",
+            job_id, name, spec.weight, spec.priority, spec.job.frame_count(),
+        )
+        return job_id
+
+    def job_status(self, job_id: str) -> dict[str, Any] | None:
+        run = self._runs.get(job_id)
+        return run.view() if run is not None else None
+
+    def scheduler_view(self) -> dict[str, Any]:
+        """The ``sched`` section of the metrics snapshot / control status."""
+        return {
+            "draining": self._draining,
+            "admission_queue": list(self._admission),
+            "running": list(self._running),
+            "total_slots": self._total_slots(),
+            "jobs": {job_id: run.view() for job_id, run in self._runs.items()},
+        }
+
+    def cluster_view(self) -> dict:
+        view = super().cluster_view()
+        view["sched"] = self.scheduler_view()
+        return view
+
+    def timeline_other_data(self) -> dict | None:
+        """Map the Perfetto ``job job-NNNN`` tracks back to submissions."""
+        return {
+            "sched_jobs": {
+                job_id: {
+                    "job_name": run.job_name,
+                    "weight": run.spec.weight,
+                    "priority": run.spec.priority,
+                    "status": run.status,
+                    "makespan_seconds": run.makespan_seconds(),
+                    "preemptions": run.preemptions,
+                }
+                for job_id, run in self._runs.items()
+            }
+        }
+
+    async def cancel_job(self, job_id: str) -> bool:
+        """Cancel a queued or running job.
+
+        A running job's not-yet-rendering frames are unqueued from every
+        worker (the steal RPC's removal half), frames mid-render finish on
+        the worker but their results resolve to a defunct job and are
+        accounted as stale, and the job's name is released — the pool's
+        slots go back to the remaining jobs with no ghost assignments.
+        """
+        run = self._runs.get(job_id)
+        if run is None or run.status in (JOB_FINISHED, JOB_CANCELLED):
+            return False
+        now = time.time()
+        if run.status == JOB_QUEUED:
+            self._admission.remove(job_id)
+            self._finish_run(run, JOB_CANCELLED, now)
+            return True
+        # RUNNING: deactivate FIRST so in-flight events/dispatches resolve
+        # to "defunct job" instead of mutating the frozen frame table.
+        self._running.remove(job_id)
+        self._active_by_name.pop(run.job_name, None)
+        self._finish_run(run, JOB_CANCELLED, now)
+        for worker in self.live_workers():
+            for frame in worker.queue.frames_for_job(run.job_name):
+                if frame.is_rendering:
+                    continue  # its finished event will sweep the mirror
+                try:
+                    await worker.unqueue_frame(run.job_name, frame.frame_index)
+                except Exception as e:  # noqa: BLE001 - worker failure mid-RPC
+                    logger.warning(
+                        "Cancel of %s: unqueue of frame %d on %08x failed: %s",
+                        job_id, frame.frame_index, worker.worker_id, e,
+                    )
+        return True
+
+    def request_drain(self) -> None:
+        """Stop admitting NEW submissions; serve() returns once every
+        already-accepted job has finished (or been cancelled)."""
+        self._draining = True
+
+    # -- service loop ---------------------------------------------------------
+
+    async def serve(self) -> list[tuple[str, WorkerTrace]]:
+        """Bind, run the scheduler until drained, collect worker traces."""
+        await self._bind_server()
+        try:
+            await self._scheduler_loop()
+            with self.span_tracer.span(
+                "collect traces", cat="master", track="job"
+            ):
+                worker_traces = await self._collect_worker_traces()
+            return worker_traces
+        finally:
+            await self._shutdown_server()
+
+    async def _scheduler_loop(self) -> None:
+        last = time.time()
+        while not self.cancellation.is_cancelled():
+            now = time.time()
+            dt, last = now - last, now
+            await self._admit_ready_jobs(now)
+            self._finalize_finished_jobs(now)
+            if self._draining and not self._running and self._admission:
+                # Liveness under drain: a queued job whose worker barrier
+                # exceeds the live pool — with nothing running whose
+                # completion could change the picture — would park the
+                # service forever. Give late-connecting workers a grace
+                # window (the harness submits and drains before its
+                # workers even finish their handshakes), then cancel the
+                # unadmittable leftovers loudly: the operator asked to
+                # wind down.
+                if self._drain_stuck_since is None:
+                    self._drain_stuck_since = now
+                elif (
+                    now - self._drain_stuck_since
+                    >= self.config.drain_barrier_grace_seconds
+                ):
+                    self._cancel_unadmittable_queued_jobs(now)
+            else:
+                self._drain_stuck_since = None
+            if self._draining and not self._admission and not self._running:
+                return
+            if self._running:
+                targets = self._compute_targets()
+                self._account_shares(dt, targets)
+                await self._dispatch_tick()
+                if self.config.preemption:
+                    await self._preempt_tick()
+                self._finalize_finished_jobs(time.time())
+            await asyncio.sleep(self.config.tick_seconds)
+
+    def _cancel_unadmittable_queued_jobs(self, now: float) -> None:
+        live = len(self.live_workers())
+        for job_id in list(self._admission):
+            run = self._runs[job_id]
+            if run.spec.job.wait_for_number_of_workers > live:
+                logger.warning(
+                    "Drain: cancelling queued job %s (%r) — its worker "
+                    "barrier (%d) exceeds the live pool (%d) and nothing "
+                    "is running that could change that.",
+                    job_id,
+                    run.job_name,
+                    run.spec.job.wait_for_number_of_workers,
+                    live,
+                )
+                self._admission.remove(job_id)
+                self._finish_run(run, JOB_CANCELLED, now)
+
+    # -- admission ------------------------------------------------------------
+
+    def _admission_order(self) -> list[str]:
+        """Queued job_ids, highest priority first, submit order within."""
+        return sorted(
+            self._admission,
+            key=lambda job_id: (-self._runs[job_id].spec.priority, job_id),
+        )
+
+    async def _admit_ready_jobs(self, now: float) -> None:
+        live = len(self.live_workers())
+        progressed = True
+        while progressed:
+            progressed = False
+            for job_id in self._admission_order():
+                if len(self._running) >= self.config.max_active_jobs:
+                    return
+                run = self._runs[job_id]
+                if run.spec.job.wait_for_number_of_workers > live:
+                    continue  # its worker barrier is not met yet
+                await self._admit(run, now)
+                progressed = True
+                break
+
+    async def _admit(self, run: JobRun, now: float) -> None:
+        self._admission.remove(run.job_id)
+        run.state = ClusterManagerState(run.spec.job)
+        run.state.sched_job_id = run.job_id
+        run.status = JOB_RUNNING
+        run.admitted_at = now
+        self._running.append(run.job_id)
+        self._active_by_name[run.job_name] = run
+        self.metrics.counter(
+            "sched_jobs_running_total", "Jobs admitted to the running set"
+        ).inc()
+        self.metrics.histogram(
+            "sched_admission_wait_seconds",
+            "Submit-to-admission wait per job",
+        ).observe(max(0.0, now - run.submitted_at))
+        self.span_tracer.instant(
+            "job admitted",
+            cat="sched",
+            track=f"job {run.job_id}",
+            args={"job_id": run.job_id, "job_name": run.job_name,
+                  "wait_s": round(now - run.submitted_at, 6)},
+        )
+        logger.info("Job %s admitted (%r).", run.job_id, run.job_name)
+        for worker in self.live_workers():
+            try:
+                await worker.send_job_started(
+                    trace_id=run.state.trace_id, job_id=run.job_id
+                )
+            except Exception as e:  # noqa: BLE001 - heartbeat will evict it
+                logger.warning(
+                    "job-started announce to %08x failed: %s", worker.worker_id, e
+                )
+
+    # -- completion / cancellation -------------------------------------------
+
+    def _finish_run(self, run: JobRun, status: str, now: float) -> None:
+        run.status = status
+        run.finished_at = now
+        counter = (
+            "sched_jobs_finished_total"
+            if status == JOB_FINISHED
+            else "sched_jobs_cancelled_total"
+        )
+        help_text = (
+            "Jobs that completed every frame"
+            if status == JOB_FINISHED
+            else "Jobs cancelled before completion"
+        )
+        self.metrics.counter(counter, help_text).inc()
+        self.metrics.gauge(
+            "sched_job_share",
+            "Instantaneous in-flight share per job",
+            labels=("job",),
+        ).set(0.0, job=run.job_id)
+        if run.admitted_at is not None:
+            self.span_tracer.complete(
+                "job",
+                cat="sched",
+                start_wall=run.admitted_at,
+                duration=max(0.0, now - run.admitted_at),
+                track=f"job {run.job_id}",
+                args={
+                    "job_id": run.job_id,
+                    "job_name": run.job_name,
+                    "status": status,
+                    "weight": run.spec.weight,
+                    "priority": run.spec.priority,
+                    "preemptions": run.preemptions,
+                },
+            )
+        else:
+            self.span_tracer.instant(
+                "job cancelled before admission",
+                cat="sched",
+                track=f"job {run.job_id}",
+                args={"job_id": run.job_id, "job_name": run.job_name},
+            )
+        logger.info("Job %s %s (%r).", run.job_id, status, run.job_name)
+
+    def _finalize_finished_jobs(self, now: float) -> None:
+        for job_id in list(self._running):
+            run = self._runs[job_id]
+            if run.state is not None and run.state.all_frames_finished():
+                self._running.remove(job_id)
+                self._active_by_name.pop(run.job_name, None)
+                self._finish_run(run, JOB_FINISHED, now)
+
+    # -- fair-share dispatch --------------------------------------------------
+
+    def _total_slots(self) -> int:
+        return self.config.target_queue_size * len(self.live_workers())
+
+    def _share_inputs(self) -> list[fair_share.JobShareInput]:
+        out = []
+        for job_id in self._running:
+            run = self._runs[job_id]
+            assert run.state is not None
+            out.append(
+                fair_share.JobShareInput(
+                    job_id=job_id,
+                    weight=run.spec.weight,
+                    priority=run.spec.priority,
+                    in_flight=run.state.in_flight_count(),
+                    pending=run.state.pending_count(),
+                )
+            )
+        return out
+
+    def _compute_targets(self) -> dict[str, float]:
+        return fair_share.compute_slot_targets(
+            self._share_inputs(), self._total_slots()
+        )
+
+    def _account_shares(self, dt: float, targets: dict[str, float]) -> None:
+        """Fold one tick into the share gauges + overlap-window integrals."""
+        if dt <= 0.0:
+            return
+        inputs = self._share_inputs()
+        total_slots = self._total_slots()
+        total_in_flight = sum(job.in_flight for job in inputs)
+        overlapping = len(inputs) >= 2
+        share_gauge = self.metrics.gauge(
+            "sched_job_share",
+            "Instantaneous in-flight share per job",
+            labels=("job",),
+        )
+        target_gauge = self.metrics.gauge(
+            "sched_job_share_target",
+            "Fair-share target share per job",
+            labels=("job",),
+        )
+        for job in inputs:
+            run = self._runs[job.job_id]
+            target_share = (
+                targets.get(job.job_id, 0.0) / total_slots if total_slots else 0.0
+            )
+            achieved_share = (
+                job.in_flight / total_in_flight if total_in_flight else 0.0
+            )
+            run.last_target_share = target_share
+            share_gauge.set(achieved_share, job=job.job_id)
+            target_gauge.set(target_share, job=job.job_id)
+            if overlapping:
+                run.overlap_in_flight_integral += job.in_flight * dt
+                run.overlap_total_integral += total_in_flight * dt
+                run.overlap_target_integral += target_share * dt
+                run.overlap_seconds += dt
+
+    async def _dispatch_tick(self) -> None:
+        """Fill every under-target worker with the fairest job's frames."""
+        # Local counters adjusted as dispatches land, so one tick's fills
+        # interleave jobs fairly instead of recounting O(frames) per slot.
+        counts: dict[str, list[int]] = {}
+        for job in self._share_inputs():
+            counts[job.job_id] = [job.in_flight, job.pending]
+
+        def inputs_now() -> list[fair_share.JobShareInput]:
+            out = []
+            for job_id in self._running:
+                if job_id not in counts:
+                    continue
+                run = self._runs[job_id]
+                in_flight, pending = counts[job_id]
+                out.append(
+                    fair_share.JobShareInput(
+                        job_id=job_id,
+                        weight=run.spec.weight,
+                        priority=run.spec.priority,
+                        in_flight=in_flight,
+                        pending=pending,
+                    )
+                )
+            return out
+
+        workers = sorted(self.live_workers(), key=lambda w: len(w.queue))
+        for worker in workers:
+            while (
+                not worker.is_dead
+                and len(worker.queue) < self.config.target_queue_size
+            ):
+                job_id = fair_share.pick_job_to_dispatch(inputs_now())
+                if job_id is None:
+                    return  # nothing pending anywhere
+                run = self._runs[job_id]
+                assert run.state is not None
+                if await dispatch_one_pending(
+                    worker, run.spec.job, run.state, job_id=job_id
+                ):
+                    counts[job_id][0] += 1
+                    counts[job_id][1] -= 1
+                else:
+                    # Dispatch failed (worker died mid-RPC, cancel raced,
+                    # or the pending pool emptied under us): stop filling
+                    # this worker; the pending count is refreshed next tick.
+                    counts[job_id][1] = max(0, counts[job_id][1] - 1)
+                    break
+
+    async def _preempt_tick(self) -> None:
+        # 0 legitimately disables per-tick preemption without touching
+        # TRC_SCHED_PREEMPTION.
+        for _ in range(max(0, self.config.max_preemptions_per_tick)):
+            targets = self._compute_targets()
+            decision = fair_share.pick_preemption(self._share_inputs(), targets)
+            if decision is None:
+                return
+            over_id, starved_id = decision
+            run = self._runs[over_id]
+            assert run.state is not None
+            found = self._find_preemptible_frame(run.job_name)
+            if found is None:
+                return  # everything the job holds is already rendering
+            victim, frame = found
+            if not await preempt_frame(
+                run.spec.job, run.state, victim, frame.frame_index
+            ):
+                return
+            run.preemptions += 1
+            self.metrics.counter(
+                "sched_preemptions_total",
+                "Frames unqueued from over-share jobs back to their pool",
+                labels=("job",),
+            ).inc(job=over_id)
+            self.span_tracer.instant(
+                "preempt",
+                cat="sched",
+                track=f"job {over_id}",
+                args={
+                    "job_id": over_id,
+                    "for_job": starved_id,
+                    "frame": frame.frame_index,
+                    "worker": f"{victim.worker_id:08x}",
+                },
+            )
+
+    def _find_preemptible_frame(
+        self, job_name: str
+    ) -> tuple[WorkerHandle, Any] | None:
+        """The job's NEWEST not-yet-rendering mirrored frame (preempting
+        the most recently queued wastes the least accumulated wait and is
+        the frame least likely to be picked up mid-RPC)."""
+        best: tuple[WorkerHandle, Any] | None = None
+        for worker in self.live_workers():
+            for frame in worker.queue.frames_for_job(job_name):
+                if frame.is_rendering:
+                    continue
+                if best is None or frame.queued_at > best[1].queued_at:
+                    best = (worker, frame)
+        return best

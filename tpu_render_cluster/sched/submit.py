@@ -1,0 +1,91 @@
+"""Client CLI for the scheduler control plane.
+
+::
+
+    python -m tpu_render_cluster.sched.submit --host H --controlPort P \\
+        submit job.toml [--weight 3] [--priority 1]
+    python -m tpu_render_cluster.sched.submit ... status [--job JOB_ID]
+    python -m tpu_render_cluster.sched.submit ... cancel JOB_ID
+    python -m tpu_render_cluster.sched.submit ... drain
+
+Prints the control plane's JSON response; exits non-zero when the server
+answers ``ok: false`` (or is unreachable), so scripts can chain on it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tpu_render_cluster.jobs.models import BlenderJob
+from tpu_render_cluster.sched.control import control_request_sync
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="trc-submit", description="Scheduler control-plane client"
+    )
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument(
+        "--controlPort", dest="control_port", type=int, default=9902
+    )
+    parser.add_argument("--timeout", type=float, default=30.0)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    submit = sub.add_parser("submit", help="Submit a job TOML")
+    submit.add_argument("job_file_path")
+    submit.add_argument("--weight", type=float, default=1.0)
+    submit.add_argument("--priority", type=int, default=0)
+
+    status = sub.add_parser("status", help="Scheduler (or one job's) status")
+    status.add_argument("--job", dest="job_id", default=None)
+
+    cancel = sub.add_parser("cancel", help="Cancel a queued/running job")
+    cancel.add_argument("job_id")
+
+    sub.add_parser("drain", help="Stop admitting; exit when idle")
+    return parser
+
+
+def _build_request(args: argparse.Namespace) -> dict:
+    if args.command == "submit":
+        job = BlenderJob.load_from_file(args.job_file_path)
+        return {
+            "op": "submit",
+            "spec": {
+                "job": job.to_dict(),
+                "weight": args.weight,
+                "priority": args.priority,
+            },
+        }
+    if args.command == "status":
+        request: dict = {"op": "status"}
+        if args.job_id is not None:
+            request["job_id"] = args.job_id
+        return request
+    if args.command == "cancel":
+        return {"op": "cancel", "job_id": args.job_id}
+    return {"op": "drain"}
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        request = _build_request(args)
+    except (OSError, ValueError) as e:
+        print(json.dumps({"ok": False, "error": str(e)}))
+        return 2
+    try:
+        response = control_request_sync(
+            args.host, args.control_port, request, timeout=args.timeout
+        )
+    except (OSError, ValueError, ConnectionError) as e:
+        print(json.dumps({"ok": False, "error": f"control plane unreachable: {e}"}))
+        return 2
+    print(json.dumps(response, indent=2))
+    return 0 if response.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,6 +54,9 @@ class QueuedFrame:
     # reference-shaped master); echoed on rendering/finished events and
     # routed through the phase spans as a Perfetto flow.
     trace: pm.TraceContext | None = None
+    # Scheduler job id from the queue-add request (None from single-job
+    # masters); echoed on rendering/finished events.
+    job_id: str | None = None
 
 
 class WorkerAutomaticQueue:
@@ -101,13 +104,16 @@ class WorkerAutomaticQueue:
         frame_index: int,
         *,
         trace: pm.TraceContext | None = None,
+        job_id: str | None = None,
     ) -> None:
         if self._draining:
             # Refuse, don't silently park: the add RPC answers errored and
             # the master returns the frame to the pending pool — a frame
             # accepted here after drain() collected the queue would be lost.
             raise RuntimeError("Worker is draining; not accepting new frames.")
-        self._frames.append(QueuedFrame(job, frame_index, trace=trace))
+        self._frames.append(
+            QueuedFrame(job, frame_index, trace=trace, job_id=job_id)
+        )
         self._work_available.set()
 
     def unqueue_frame(self, job_name: str, frame_index: int) -> str:
@@ -190,7 +196,8 @@ class WorkerAutomaticQueue:
         job_name = frame.job.job_name
         await self._sender.send_message(
             pm.WorkerFrameQueueItemRenderingEvent(
-                job_name, frame.frame_index, trace=frame.trace
+                job_name, frame.frame_index, trace=frame.trace,
+                job_id=frame.job_id,
             )
         )
         try:
@@ -207,7 +214,8 @@ class WorkerAutomaticQueue:
             self._remove(frame)
             await self._sender.send_message(
                 pm.WorkerFrameQueueItemFinishedEvent.new_errored(
-                    job_name, frame.frame_index, str(e), trace=frame.trace
+                    job_name, frame.frame_index, str(e), trace=frame.trace,
+                    job_id=frame.job_id,
                 )
             )
             return
@@ -217,7 +225,8 @@ class WorkerAutomaticQueue:
         self._finished_indices.add((job_name, frame.frame_index))
         await self._sender.send_message(
             pm.WorkerFrameQueueItemFinishedEvent.new_ok(
-                job_name, frame.frame_index, trace=frame.trace
+                job_name, frame.frame_index, trace=frame.trace,
+                job_id=frame.job_id,
             )
         )
 

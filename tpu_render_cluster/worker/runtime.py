@@ -236,7 +236,8 @@ class Worker:
                 request = await add_queue.get()
                 try:
                     frame_queue.queue_frame(
-                        request.job, request.frame_index, trace=request.trace
+                        request.job, request.frame_index, trace=request.trace,
+                        job_id=request.job_id,
                     )
                     self.tracer.increment_total_queued_frames()
                     response = pm.WorkerFrameQueueAddResponse.new_ok(
@@ -265,20 +266,22 @@ class Worker:
         async def handle_job_started() -> None:
             while True:
                 event = await started_queue.get()
-                logger.info("Job started.")
+                logger.info(
+                    "Job started%s.",
+                    f" ({event.job_id})" if event.job_id is not None else "",
+                )
                 self.tracer.set_job_start_time(time.time())
                 # Stamp the span timeline with the job's trace id (when the
                 # master piggybacked one) so multi-job worker artifacts can
-                # be partitioned by run.
+                # be partitioned by run; under the scheduler each announced
+                # job also carries its submission id.
+                args: dict | None = None
+                if event.trace_id is not None:
+                    args = {"trace_id": f"{event.trace_id:016x}"}
+                if event.job_id is not None:
+                    args = {**(args or {}), "job_id": event.job_id}
                 self.span_tracer.instant(
-                    "job started",
-                    cat="worker",
-                    track="job",
-                    args=(
-                        {"trace_id": f"{event.trace_id:016x}"}
-                        if event.trace_id is not None
-                        else None
-                    ),
+                    "job started", cat="worker", track="job", args=args
                 )
 
         async def handle_job_finished() -> None:
