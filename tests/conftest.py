@@ -41,7 +41,26 @@ except Exception:  # noqa: BLE001 - registry moved: config override suffices
 import sys
 from pathlib import Path
 
+import pytest
+
 # Make the repo root importable regardless of pytest invocation directory.
 REPO_ROOT = Path(__file__).resolve().parent.parent
 if str(REPO_ROOT) not in sys.path:
     sys.path.insert(0, str(REPO_ROOT))
+
+
+@pytest.fixture(autouse=True)
+def _isolated_render_compile_tracking():
+    """Reset the render drivers' compile first-sighting tracker per test.
+
+    render/compaction._seen_shapes is process-global (it mirrors the
+    process-lifetime jit cache the ``render_compiles_total`` counter
+    describes), so without this reset a test's compile-delta assertions
+    would depend on which shapes EARLIER tests happened to launch. The
+    obs counter itself stays monotonic — only the dedup memory is
+    cleared, so each test observes fresh first-sightings.
+    """
+    compaction = sys.modules.get("tpu_render_cluster.render.compaction")
+    if compaction is not None:
+        compaction.reset_compile_tracking()
+    yield

@@ -165,6 +165,52 @@ def _percentile(sorted_values: list[float], q: float) -> float:
     return sorted_values[index]
 
 
+def _consume_metric_snapshots(
+    metrics: list[dict[str, Any]], take_registry, take_wire
+) -> None:
+    """Walk metric snapshots, consuming every series exactly once.
+
+    ``take_registry(names) -> bool`` is fed registry-snapshot forms (a
+    snapshot's own ``metrics``, the harness's per-worker ``workers``,
+    and process_metrics — see below), returning whether it consumed
+    anything; ``take_wire(wire)`` gets the compact heartbeat wire form
+    (``cluster_metrics``), consumed only when no registry snapshot
+    covered that file, so nothing is double-counted.
+
+    The harness's process-global snapshots are CUMULATIVE per process
+    (every job a harness process runs re-exports the same counters):
+    only the NEWEST snapshot per pid is consumed, once — summing every
+    file's copy would multiply counters by the job count and re-weight
+    histogram means toward earlier jobs.
+    """
+    newest_per_pid: dict[Any, tuple[float, dict[str, Any]]] = {}
+    snapshots_with_process_metrics: set[int] = set()
+    for snapshot_index, snapshot in enumerate(metrics):
+        process_entry = snapshot.get("process_metrics")
+        if isinstance(process_entry, dict) and isinstance(
+            process_entry.get("metrics"), dict
+        ):
+            snapshots_with_process_metrics.add(snapshot_index)
+            pid = process_entry.get("pid")
+            written_at = float(snapshot.get("written_at", 0.0))
+            best = newest_per_pid.get(pid)
+            if best is None or written_at >= best[0]:
+                newest_per_pid[pid] = (written_at, process_entry["metrics"])
+
+    for snapshot_index, snapshot in enumerate(metrics):
+        took_registries = snapshot_index in snapshots_with_process_metrics
+        take_registry(snapshot.get("metrics", {}))
+        for worker_registry in (snapshot.get("workers") or {}).values():
+            if isinstance(worker_registry, dict) and take_registry(worker_registry):
+                took_registries = True
+        if not took_registries:
+            wire = snapshot.get("cluster_metrics")
+            if isinstance(wire, dict):
+                take_wire(wire)
+    for _written_at, registry in newest_per_pid.values():
+        take_registry(registry)
+
+
 def summarize_wavefront(metrics: list[dict[str, Any]]) -> dict[str, Any] | None:
     """Roll the wavefront occupancy series (render/compaction.py) up.
 
@@ -232,37 +278,7 @@ def summarize_wavefront(metrics: list[dict[str, Any]]) -> dict[str, Any] | None:
                 found = True
                 compiles += float(value)
 
-    # The harness's process-global snapshots are CUMULATIVE per process
-    # (every job a harness process runs re-exports the same counters):
-    # keep only the NEWEST snapshot per pid, then consume those once —
-    # summing every file's copy would multiply compiles_total by the job
-    # count and re-weight the survival means toward earlier jobs.
-    newest_per_pid: dict[Any, tuple[float, dict[str, Any]]] = {}
-    snapshots_with_process_metrics: set[int] = set()
-    for snapshot_index, snapshot in enumerate(metrics):
-        process_entry = snapshot.get("process_metrics")
-        if isinstance(process_entry, dict) and isinstance(
-            process_entry.get("metrics"), dict
-        ):
-            snapshots_with_process_metrics.add(snapshot_index)
-            pid = process_entry.get("pid")
-            written_at = float(snapshot.get("written_at", 0.0))
-            best = newest_per_pid.get(pid)
-            if best is None or written_at >= best[0]:
-                newest_per_pid[pid] = (written_at, process_entry["metrics"])
-
-    for snapshot_index, snapshot in enumerate(metrics):
-        took_registries = snapshot_index in snapshots_with_process_metrics
-        take_registry(snapshot.get("metrics", {}))
-        for worker_registry in (snapshot.get("workers") or {}).values():
-            if isinstance(worker_registry, dict) and take_registry(worker_registry):
-                took_registries = True
-        if not took_registries:
-            wire = snapshot.get("cluster_metrics")
-            if isinstance(wire, dict):
-                take_wire(wire)
-    for _written_at, registry in newest_per_pid.values():
-        take_registry(registry)
+    _consume_metric_snapshots(metrics, take_registry, take_wire)
     if not found:
         return None
     out: dict[str, Any] = {"compiles_total": compiles}
@@ -275,6 +291,94 @@ def summarize_wavefront(metrics: list[dict[str, Any]]) -> dict[str, Any] | None:
             for label, entry in sorted(by_bounce.items())
             if entry["count"]
         }
+    return out
+
+
+def summarize_raypool(metrics: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """Roll the device ray-pool series (render/raypool.py) up.
+
+    Extracts ``render_pool_live_fraction`` (per-iteration pool
+    occupancy histogram — its complement is the raypool
+    wasted_lane_fraction), ``render_pool_occupancy`` (last batch's mean
+    gauge), the refill/iteration counters, and the worker backend's
+    rendered-ahead ``render_raypool_cache_hits_total``. Same snapshot-
+    family handling as summarize_wavefront: registry-snapshot form
+    first (newest per pid for the cumulative process_metrics), compact
+    wire form only when no registry snapshot covered that file. None
+    when no snapshot carries the series (job never used the pool).
+    """
+    found = False
+    live_count = 0
+    live_sum = 0.0
+    occupancy: float | None = None
+    counters = {
+        "render_pool_refill_rays_total": 0.0,
+        "render_pool_iterations_total": 0.0,
+        "render_raypool_cache_hits_total": 0.0,
+        "render_pool_launched_lanes_total": 0.0,
+        "render_pool_live_lanes_total": 0.0,
+    }
+
+    def take_registry(names: dict[str, Any]) -> bool:
+        nonlocal found, live_count, live_sum, occupancy
+        took = False
+        histogram = names.get("render_pool_live_fraction")
+        if histogram:
+            found = took = True
+            for series in histogram.get("series", {}).values():
+                live_count += int(series.get("count", 0))
+                live_sum += float(series.get("sum", 0.0))
+        gauge = names.get("render_pool_occupancy")
+        if gauge and gauge.get("series"):
+            found = took = True
+            occupancy = float(list(gauge["series"].values())[-1])
+        for name in counters:
+            counter = names.get(name)
+            if counter:
+                found = took = True
+                counters[name] += sum(
+                    float(v) for v in counter.get("series", {}).values()
+                )
+        return took
+
+    def take_wire(wire: dict[str, Any]) -> None:
+        nonlocal found, live_count, live_sum, occupancy
+        for key, entry in (wire.get("h") or {}).items():
+            if key.partition("|")[0] == "render_pool_live_fraction":
+                found = True
+                live_count += int(entry.get("n", 0))
+                live_sum += float(entry.get("s", 0.0))
+        for key, value in (wire.get("g") or {}).items():
+            if key.partition("|")[0] == "render_pool_occupancy":
+                found = True
+                occupancy = float(value)
+        for key, value in (wire.get("c") or {}).items():
+            name = key.partition("|")[0]
+            if name in counters:
+                found = True
+                counters[name] += float(value)
+
+    _consume_metric_snapshots(metrics, take_registry, take_wire)
+    if not found:
+        return None
+    out: dict[str, Any] = {
+        "refill_rays_total": counters["render_pool_refill_rays_total"],
+        "iterations_total": counters["render_pool_iterations_total"],
+        "cache_hits_total": counters["render_raypool_cache_hits_total"],
+    }
+    if occupancy is not None:
+        out["pool_occupancy_last_batch"] = occupancy
+    launched = counters["render_pool_launched_lanes_total"]
+    if launched > 0:
+        # Lane-weighted (the true launched-lane fraction; the per-
+        # iteration histogram below would overweight the drain tail's
+        # tiny launches).
+        live_lanes = counters["render_pool_live_lanes_total"]
+        out["wasted_lane_fraction"] = 1.0 - live_lanes / launched
+        out["pool_occupancy_mean"] = live_lanes / launched
+    elif live_count:
+        out["wasted_lane_fraction"] = 1.0 - live_sum / live_count
+        out["pool_occupancy_mean"] = live_sum / live_count
     return out
 
 
@@ -440,6 +544,9 @@ def summarize_obs(
     wavefront = summarize_wavefront(metrics)
     if wavefront is not None:
         out["wavefront"] = wavefront
+    raypool = summarize_raypool(metrics)
+    if raypool is not None:
+        out["raypool"] = raypool
     chaos = summarize_chaos(metrics)
     if chaos is not None:
         out["chaos"] = chaos

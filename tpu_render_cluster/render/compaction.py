@@ -78,6 +78,28 @@ def alive_fraction_histogram(registry=None):
     )
 
 
+def launch_occupancy_histogram(registry=None):
+    """Per-relaunch live fraction of the LAUNCHED bucket (live / bucket).
+
+    The survival histogram above measures the scene (live / original
+    wavefront — what a full-width masked loop wastes); this one measures
+    the DRIVER (how much of what it actually launched was live), which
+    is what the bucketed reclaim improves and what the ray pool's
+    render_pool_live_fraction is compared against in bench.py's
+    three-way record.
+    """
+    from tpu_render_cluster.obs import get_registry
+
+    registry = registry if registry is not None else get_registry()
+    return registry.histogram(
+        "render_launch_occupancy",
+        "Per-bounce live fraction of the launched wavefront bucket "
+        "(1 - this, averaged, is the wavefront driver's own "
+        "wasted_lane_fraction)",
+        buckets=ALIVE_FRACTION_BUCKETS,
+    )
+
+
 def compile_counter(registry=None):
     from tpu_render_cluster.obs import get_registry
 
@@ -90,15 +112,40 @@ def compile_counter(registry=None):
 
 
 # First-sighting tracker behind render_compiles_total. Python-level on
-# purpose: it counts the shapes THIS driver has launched (the quantity
-# the bucket ladder bounds), independent of jax cache internals.
-_seen_shapes: set[tuple] = set()
+# purpose: it counts the shapes the drivers have launched (the quantity
+# the bucket ladder / fixed pool width bounds), independent of jax cache
+# internals. Keyed per DRIVER KIND (wavefront vs raypool) so the two
+# drivers' key namespaces can't collide, and resettable so tests can
+# assert on compile-count deltas without inheriting another test's
+# sightings (tests/conftest.py resets it around every test).
+_seen_shapes: dict[str, set[tuple]] = {}
+
+
+def note_compile(driver: str, *key) -> None:
+    """Count a first-sighting of ``key`` for ``driver`` into
+    render_compiles_total (idempotent per (driver, key))."""
+    seen = _seen_shapes.setdefault(driver, set())
+    if key not in seen:
+        seen.add(key)
+        compile_counter().inc()
+
+
+def reset_compile_tracking(driver: str | None = None) -> None:
+    """Forget first-sightings (one driver kind, or all).
+
+    Test isolation only: the obs counter itself keeps its process-wide
+    value (counters are monotonic); resetting merely makes the next
+    sighting of a shape count again, so per-test DELTA assertions are
+    independent of which shapes earlier tests visited.
+    """
+    if driver is None:
+        _seen_shapes.clear()
+    else:
+        _seen_shapes.pop(driver, None)
 
 
 def _count_compile(*key) -> None:
-    if key not in _seen_shapes:
-        _seen_shapes.add(key)
-        compile_counter().inc()
+    note_compile("wavefront", *key)
 
 
 def bucket_for(live: int, cap: int, block: int) -> int:
@@ -223,6 +270,7 @@ def trace_paths_wavefront(
     tracer = get_tracer()
     occupancy = lane_occupancy_gauge()
     survival = alive_fraction_histogram()
+    launched = launch_occupancy_histogram()
 
     radiance_total = jnp.zeros((n0, 3), jnp.float32)
     throughput = jnp.ones((n0, 3), jnp.float32)
@@ -263,6 +311,7 @@ def trace_paths_wavefront(
             alive = alive[:bucket]
             lane = lane[:bucket]
         occupancy.set(live / bucket)
+        launched.observe(live / bucket)
         _count_compile(kind, "bounce", bucket, max_bounces)
         if mesh is not None:
             origins, directions, throughput, alive, radiance_total = (
@@ -296,24 +345,16 @@ def trace_paths_wavefront(
 def _frame_rays(camera, frame, *, width: int, height: int, samples: int):
     """Primary rays for a full frame, samples flattened onto the ray axis.
 
-    Built from render_tile's OWN helpers (integrator.tile_base_key /
-    flat_sample_rays / tile_trace_key / trace_seed), so a wavefront
-    frame and a masked frame provably trace the same physical rays with
-    the same per-lane RNG streams — the derivation cannot drift.
+    Built from render_tile's OWN helper (integrator.frame_rays_and_seed,
+    also the ray-pool driver's source), so a wavefront frame and a
+    masked frame provably trace the same physical rays with the same
+    per-lane RNG streams — the derivation cannot drift.
     """
-    from tpu_render_cluster.render.integrator import (
-        flat_sample_rays,
-        tile_base_key,
-        tile_trace_key,
-        trace_seed,
-    )
+    from tpu_render_cluster.render.integrator import frame_rays_and_seed
 
-    base_key = tile_base_key(frame, 0, 0)
-    origins, directions = flat_sample_rays(
-        camera, base_key, width=width, height=height, y0=0, x0=0,
-        tile_height=height, tile_width=width, samples=samples,
+    return frame_rays_and_seed(
+        camera, frame, width=width, height=height, samples=samples
     )
-    return origins, directions, trace_seed(tile_trace_key(base_key))
 
 
 @functools.partial(jax.jit, static_argnames=("samples", "height", "width"))
@@ -383,14 +424,7 @@ def wavefront_active(
     return pk.wavefront_eligible(scene_mesh_set(scene_name, frame))
 
 
-def wasted_lane_fraction(registry=None) -> float | None:
-    """1 - mean(alive fraction) over every recorded wavefront bounce.
-
-    The average fraction of the ORIGINAL wavefront that is dead at each
-    bounce launch — what a masked full-width bounce loop wastes, and
-    what compaction reclaims. None before any wavefront render ran.
-    """
-    histogram = alive_fraction_histogram(registry)
+def _mean_complement(histogram) -> float | None:
     count = 0
     total = 0.0
     for _key, series in histogram._series_items():
@@ -399,3 +433,21 @@ def wasted_lane_fraction(registry=None) -> float | None:
     if count == 0:
         return None
     return 1.0 - total / count
+
+
+def wasted_lane_fraction(registry=None) -> float | None:
+    """1 - mean(alive fraction) over every recorded wavefront bounce.
+
+    The average fraction of the ORIGINAL wavefront that is dead at each
+    bounce launch — what a masked full-width bounce loop wastes, and
+    what compaction reclaims. None before any wavefront render ran.
+    """
+    return _mean_complement(alive_fraction_histogram(registry))
+
+
+def launched_wasted_lane_fraction(registry=None) -> float | None:
+    """1 - mean(live / launched bucket) over every wavefront relaunch —
+    the waste the wavefront driver itself still pays after the bucketed
+    reclaim (block-quantized launches + the unfillable first bounce).
+    None before any wavefront render ran."""
+    return _mean_complement(launch_occupancy_histogram(registry))

@@ -283,6 +283,24 @@ def flat_sample_rays(
     return origins.reshape(samples * n, 3), directions.reshape(samples * n, 3)
 
 
+def frame_rays_and_seed(camera: Camera, frame, *, width, height, samples):
+    """A full frame's flattened primary rays + its kernel trace seed.
+
+    ONE definition (built on tile_base_key / flat_sample_rays /
+    tile_trace_key / trace_seed) shared by the masked renderer's
+    full-frame tile, the wavefront driver (compaction._frame_rays), and
+    the ray-pool driver's vmapped multi-frame batch — all three provably
+    trace the same physical rays with the same RNG derivation, so the
+    cross-mode equivalence contracts cannot drift.
+    """
+    base_key = tile_base_key(frame, 0, 0)
+    origins, directions = flat_sample_rays(
+        camera, base_key, width=width, height=height, y0=0, x0=0,
+        tile_height=height, tile_width=width, samples=samples,
+    )
+    return origins, directions, trace_seed(tile_trace_key(base_key))
+
+
 def trace_paths(
     scene: Scene, origins, directions, key, *, max_bounces: int = 4, mesh=None
 ) -> jnp.ndarray:

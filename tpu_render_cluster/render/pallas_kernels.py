@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import functools
 import os
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -318,8 +319,11 @@ def _uniform_from_hash(h):
     return word.astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
 
 
-def _trace_kernel_factory(max_bounces: int, n_padded: int, state_io: bool = False):
-    """Sphere path-trace kernel. Two shapes share one bounce_step (same
+def _trace_kernel_factory(
+    max_bounces: int, n_padded: int, state_io: bool = False,
+    pool_io: bool = False,
+):
+    """Sphere path-trace kernel. Three shapes share one bounce_step (same
     split as _mesh_trace_kernel_factory):
 
     - state_io=False: the whole-bounce-loop MEGAKERNEL (state
@@ -330,11 +334,28 @@ def _trace_kernel_factory(max_bounces: int, n_padded: int, state_io: bool = Fals
       (blocks whose first lane is past it — all dead by the compaction
       contract — skip the bounce entirely). ``max_bounces`` still names
       the TOTAL bounce count so RNG counters match the megakernel.
+    - pool_io=True: the device-resident ray-pool shape
+      (render/raypool.py). Like state_io but lanes from DIFFERENT
+      frames share one launch, so the scalar seed/bounce become
+      per-lane rows (seed = the lane's frame seed, bounce = the lane's
+      own depth — together with the original lane id they reproduce the
+      masked loop's (frame, lane, bounce) RNG stream exactly), the
+      sphere arrays are a multi-frame STACK with a per-sphere frame-id
+      column, and every intersection (nearest + shadow) is masked to
+      spheres whose frame id matches the lane's carried frame id — a
+      lane only ever sees its own frame's geometry.
     """
     contract_first = (((0,), (0,)), ((), ()))
 
     def kernel(*refs):
-        if state_io:
+        if pool_io:
+            (live_ref, o_ref, d_ref, thr_ref, alive_ref, lane_ref,
+             seed_row_ref, bounce_row_ref, fid_row_ref,
+             c_ref, r2_ref, csq_ref, rad_ref,
+             albedo_ref, emission_ref, dcsun_ref, sfid_ref, params_ref,
+             out_ref, o_out_ref, d_out_ref, thr_out_ref,
+             alive_out_ref) = refs
+        elif state_io:
             (seed_ref, bounce_ref, live_ref, o_ref, d_ref, thr_ref,
              alive_ref, lane_ref, c_ref, r2_ref, csq_ref, rad_ref,
              albedo_ref, emission_ref, dcsun_ref, params_ref,
@@ -364,17 +385,31 @@ def _trace_kernel_factory(max_bounces: int, n_padded: int, state_io: bool = Fals
         plane_b = params[5:6, :].T
 
         block = o.shape[1]
-        seed = seed_ref[0, 0].astype(jnp.uint32)
-        if state_io:
-            # RNG counters follow the ORIGINAL lane id the caller threads
-            # through compaction/re-sorts, not the current position: a
-            # ray keeps its stream wherever compaction lands it.
+        if pool_io:
+            # Per-lane seed: lanes carry their FRAME's trace seed, so a
+            # ray's stream matches the masked single-frame loop bit for
+            # bit wherever the pool's permutation/refill lands it.
+            seed = seed_row_ref[:, :].astype(jnp.uint32)  # [1, BR]
             ray_index = lane_ref[:, :].astype(jnp.uint32)
+            # Frame mask: a lane only intersects spheres whose stacked
+            # frame id matches its own ([N, 1] == [1, BR] -> [N, BR]).
+            fid_match = sfid_ref[:, :] == fid_row_ref[:, :]
         else:
-            ray_index = (
-                jax.lax.broadcasted_iota(jnp.int32, (1, block), 1).astype(jnp.uint32)
-                + jnp.uint32(pl.program_id(0) * block)
-            )
+            seed = seed_ref[0, 0].astype(jnp.uint32)
+            fid_match = None
+            if state_io:
+                # RNG counters follow the ORIGINAL lane id the caller
+                # threads through compaction/re-sorts, not the current
+                # position: a ray keeps its stream wherever compaction
+                # lands it.
+                ray_index = lane_ref[:, :].astype(jnp.uint32)
+            else:
+                ray_index = (
+                    jax.lax.broadcasted_iota(
+                        jnp.int32, (1, block), 1
+                    ).astype(jnp.uint32)
+                    + jnp.uint32(pl.program_id(0) * block)
+                )
         sphere_iota = jax.lax.broadcasted_iota(jnp.int32, (n_padded, block), 0)
 
         throughput = jnp.ones((3, block), jnp.float32)
@@ -396,6 +431,8 @@ def _trace_kernel_factory(max_bounces: int, n_padded: int, state_io: bool = Fals
             oc_sq = o_sq - 2.0 * oc + csq
             disc = oc_dot_d * oc_dot_d - (oc_sq - r2)
             valid = (disc > 0.0) & (r2 > 0.0)
+            if fid_match is not None:
+                valid = valid & fid_match
             sqrt_disc = jnp.sqrt(jnp.maximum(disc, 0.0))
             t0 = oc_dot_d - sqrt_disc
             t1 = oc_dot_d + sqrt_disc
@@ -479,6 +516,8 @@ def _trace_kernel_factory(max_bounces: int, n_padded: int, state_io: bool = Fals
             ocsq_s = osq_s - 2.0 * oc_s + csq
             disc_s = ocd_s * ocd_s - (ocsq_s - r2)
             valid_s = (disc_s > 0.0) & (r2 > 0.0)
+            if fid_match is not None:
+                valid_s = valid_s & fid_match
             t1_s = ocd_s + jnp.sqrt(jnp.maximum(disc_s, 0.0))
             shadowed = jnp.max(
                 jnp.where(valid_s & (t1_s > EPS), 1.0, 0.0),
@@ -526,19 +565,24 @@ def _trace_kernel_factory(max_bounces: int, n_padded: int, state_io: bool = Fals
             d = jnp.where(live, new_d, d)
             return (o, d, throughput, radiance, alive)
 
-        if state_io:
+        if state_io or pool_io:
             # ONE bounce with streamed state. Blocks entirely past the
             # live count are all-dead (the compaction contract sorts dead
             # lanes to the tail) and pass their state through untouched —
             # exactly what the masked bounce computes for dead lanes, for
-            # free.
+            # free. In pool mode the bounce index is a per-lane row (the
+            # pool mixes depths); it only feeds the RNG counter, which is
+            # per-lane arithmetic either way.
             throughput = thr_ref[:, :]
             alive = alive_ref[:, :]
+            bounce_value = (
+                bounce_row_ref[:, :] if pool_io else bounce_ref[0, 0]
+            )
             block_start = pl.program_id(0) * block
             o, d, throughput, radiance, alive = jax.lax.cond(
                 block_start < live_ref[0, 0],
                 lambda: bounce_step(
-                    bounce_ref[0, 0], (o, d, throughput, radiance, alive)
+                    bounce_value, (o, d, throughput, radiance, alive)
                 ),
                 lambda: (o, d, throughput, radiance, alive),
             )
@@ -1633,9 +1677,10 @@ def _bvh_anyhit_instanced(
 
 def _mesh_trace_kernel_factory(
     max_bounces: int, n_padded: int, n_nodes: int, leaf_size: int,
-    k_count: int, state_io: bool = False,
+    k_count: int, state_io: bool = False, pool_io: bool = False,
+    k_per_frame: int = 0,
 ):
-    """Mesh path-trace kernel. Two shapes share one bounce_step:
+    """Mesh path-trace kernel. Three shapes share one bounce_step:
 
     - state_io=False: the whole-bounce-loop MEGAKERNEL (state VMEM-resident
       across all bounces, radiance out) — shallow-walk scenes.
@@ -1646,11 +1691,31 @@ def _mesh_trace_kernel_factory(
       any-hits, shading, in-kernel PCG resample) stays fused — deep-walk
       scenes. ``max_bounces`` still names the TOTAL bounce count so the
       per-(ray, bounce) RNG counters match the megakernel's stream layout.
+    - pool_io=True: the device-resident ray-pool shape
+      (render/raypool.py): per-lane seed/bounce rows (lanes from
+      different frames at different depths share one launch; the
+      carried (frame seed, original lane, bounce) triple reproduces the
+      masked loop's RNG streams), a multi-frame sphere STACK with a
+      per-sphere frame-id column, and a 23rd instance-table column
+      carrying each instance's frame id — lanes whose frame id doesn't
+      match an instance are packet-culled from its walk (their slab
+      limit is -INF) and can neither update best-t nor be shadowed by
+      it, so every lane sees exactly its own frame's geometry.
     """
     contract_first = (((0,), (0,)), ((), ()))
 
     def kernel(*refs):
-        if state_io:
+        if pool_io:
+            (live_ref, o_ref, d_ref, thr_ref, alive_ref, lane_ref,
+             seed_row_ref, bounce_row_ref, fid_row_ref,
+             fid_lo_ref, fid_hi_ref,
+             c_ref, r2_ref, csq_ref, rad_ref, albedo_ref, emission_ref,
+             dcsun_ref, sfid_ref, params_ref, sunsm_ref, inst_ref,
+             v0_ref, e1_ref, e2_ref, nrm_ref, bmin_ref, bmax_ref,
+             skip_ref, first_ref, count_ref,
+             out_ref, o_out_ref, d_out_ref, thr_out_ref,
+             alive_out_ref) = refs
+        elif state_io:
             (seed_ref, bounce_ref, live_ref, o_ref, d_ref, thr_ref,
              alive_ref, lane_ref,
              c_ref, r2_ref, csq_ref, rad_ref, albedo_ref, emission_ref,
@@ -1681,19 +1746,44 @@ def _mesh_trace_kernel_factory(
         plane_b = params[5:6, :].T
 
         block = o.shape[1]
-        seed = seed_ref[0, 0].astype(jnp.uint32)
-        if state_io:
-            # RNG counters follow the ORIGINAL lane id the integrator /
-            # wavefront driver threads through its re-sorts and
-            # compaction — a ray keeps its stream wherever the
-            # permutation lands it (the megakernel's positional index IS
-            # the original lane there, since it never reorders).
+        if pool_io:
+            # Per-lane frame seed + frame-id row (see the factory doc).
+            seed = seed_row_ref[:, :].astype(jnp.uint32)  # [1, BR]
             ray_index = lane_ref[:, :].astype(jnp.uint32)
-        else:
-            ray_index = (
-                jax.lax.broadcasted_iota(jnp.int32, (1, block), 1).astype(jnp.uint32)
-                + jnp.uint32(pl.program_id(0) * block)
+            fid_row = fid_row_ref[:, :]  # [1, BR] float32 frame ids
+            fid_match = sfid_ref[:, :] == fid_row  # [N, BR]
+            # This block's frame-id RANGE (true scalars, SMEM): the
+            # instance table is FID-MAJOR with exactly k_per_frame rows
+            # per frame, so the in-kernel sweeps iterate only the
+            # contiguous [fid_lo * K, (fid_hi + 1) * K) slice — the
+            # fid-major pool sort makes blocks frame-pure, and the
+            # stacked multi-frame sweep then costs exactly one frame's
+            # instances. Conservative by construction (the range covers
+            # ALL lanes, stale dead ones included): a too-wide window
+            # only walks instances whose matching lanes are dead, and
+            # their -INF limits exit those walks at the first node.
+            k_sweep_lo = fid_lo_ref[0, 0] * k_per_frame
+            k_sweep_hi = jnp.minimum(
+                (fid_hi_ref[0, 0] + 1) * k_per_frame, k_count
             )
+        else:
+            seed = seed_ref[0, 0].astype(jnp.uint32)
+            fid_row = None
+            fid_match = None
+            if state_io:
+                # RNG counters follow the ORIGINAL lane id the integrator
+                # / wavefront driver threads through its re-sorts and
+                # compaction — a ray keeps its stream wherever the
+                # permutation lands it (the megakernel's positional index
+                # IS the original lane there, since it never reorders).
+                ray_index = lane_ref[:, :].astype(jnp.uint32)
+            else:
+                ray_index = (
+                    jax.lax.broadcasted_iota(
+                        jnp.int32, (1, block), 1
+                    ).astype(jnp.uint32)
+                    + jnp.uint32(pl.program_id(0) * block)
+                )
         sphere_iota = jax.lax.broadcasted_iota(jnp.int32, (n_padded, block), 0)
         lanes = jax.lax.broadcasted_iota(jnp.int32, (leaf_size, block), 0)
 
@@ -1812,6 +1902,13 @@ def _mesh_trace_kernel_factory(
             wix, wiy, wiz = winv(wdx), winv(wdy), winv(wdz)
 
             def per_instance(k, carry):
+                # Pool mode: the sweep bounds below already restrict k to
+                # the block's frame window (the table is fid-major), so
+                # only window instances get here; lanes from the OTHER
+                # frame of a mixed window are packet-culled from this
+                # instance's walk (slab limit -INF, like dead lanes) and
+                # barred from the best-t update.
+                match = (fid_row == inst_ref[k, 22]) if pool_io else None
                 best_t, bnx, bny, bnz, bar, bag, bab = carry
                 r00, r01, r02 = inst_ref[k, 0], inst_ref[k, 1], inst_ref[k, 2]
                 r10, r11, r12 = inst_ref[k, 3], inst_ref[k, 4], inst_ref[k, 5]
@@ -1819,7 +1916,13 @@ def _mesh_trace_kernel_factory(
                 tx, ty, tz = inst_ref[k, 9], inst_ref[k, 10], inst_ref[k, 11]
                 inv_s = inst_ref[k, 12]
                 ar, ag, ab = inst_ref[k, 19], inst_ref[k, 20], inst_ref[k, 21]
-                touch = world_cull(k, wox, woy, woz, wix, wiy, wiz, best_t)
+                limit0 = (
+                    jnp.where(match, best_t, -INF)
+                    if pool_io else best_t
+                )
+                touch = world_cull(
+                    k, wox, woy, woz, wix, wiy, wiz, limit0
+                )
 
                 sx, sy, sz = wox - tx, woy - ty, woz - tz
                 ox = (sx * r00 + sy * r10 + sz * r20) * inv_s
@@ -1835,9 +1938,13 @@ def _mesh_trace_kernel_factory(
 
                 def body(walk):
                     node, best_t, bnx, bny, bnz, bar_, bag_, bab_ = walk
+                    walk_limit = (
+                        jnp.where(match, best_t, -INF)
+                        if match is not None else best_t
+                    )
                     next_node, start, count, do_leaf = walk_step(
                         node, ox, oy, oz, dx, dy, dz, invx, invy, invz,
-                        best_t,
+                        walk_limit,
                     )
 
                     def leaf_pass():
@@ -1876,6 +1983,11 @@ def _mesh_trace_kernel_factory(
                         do_leaf, leaf_pass, leaf_skip
                     )
                     closer = t_leaf < best_t
+                    if match is not None:
+                        # leaf_tcand is limit-agnostic, so a mismatched
+                        # lane can produce a finite t_leaf off another
+                        # frame's geometry — bar it here.
+                        closer = closer & match
                     # Object -> world (rigid): w_i = sum_j R[i][j] n_j.
                     wnx = r00 * nox + r01 * noy + r02 * noz
                     wny = r10 * nox + r11 * noy + r12 * noz
@@ -1908,7 +2020,9 @@ def _mesh_trace_kernel_factory(
                 jnp.zeros((1, block), jnp.float32),
             )
             best_t, bnx, bny, bnz, bar, bag, bab = jax.lax.fori_loop(
-                0, k_count, per_instance, init
+                k_sweep_lo if pool_io else 0,
+                k_sweep_hi if pool_io else k_count,
+                per_instance, init,
             )
             # Flip toward the incoming ray (matches mesh.intersect_instances).
             facing = (
@@ -1936,14 +2050,30 @@ def _mesh_trace_kernel_factory(
             wix, wiy, wiz = winv(sunx), winv(suny), winv(sunz)
 
             def per_instance(k, occluded):
+                # Pool mode: the sweep bounds restrict k to the block's
+                # frame window; a mixed window's other-frame lanes behave
+                # like already-occluded ones for the WALK (limit -INF:
+                # they never drive a packet) and their spurious leaf hits
+                # are masked out of the occlusion result.
+                if pool_io:
+                    match_f = (fid_row == inst_ref[k, 22]).astype(
+                        jnp.float32
+                    )
+                else:
+                    match_f = None
                 r00, r01, r02 = inst_ref[k, 0], inst_ref[k, 1], inst_ref[k, 2]
                 r10, r11, r12 = inst_ref[k, 3], inst_ref[k, 4], inst_ref[k, 5]
                 r20, r21, r22 = inst_ref[k, 6], inst_ref[k, 7], inst_ref[k, 8]
                 tx, ty, tz = inst_ref[k, 9], inst_ref[k, 10], inst_ref[k, 11]
                 inv_s = inst_ref[k, 12]
-                limit = jnp.where(occluded > 0.0, -INF, INF)
-                touch = world_cull(k, wox, woy, woz, wix, wiy, wiz, limit)
-
+                blocked = (
+                    jnp.maximum(occluded, 1.0 - match_f)
+                    if pool_io else occluded
+                )
+                limit = jnp.where(blocked > 0.0, -INF, INF)
+                touch = world_cull(
+                    k, wox, woy, woz, wix, wiy, wiz, limit
+                )
                 sx, sy, sz = wox - tx, woy - ty, woz - tz
                 ox = (sx * r00 + sy * r10 + sz * r20) * inv_s
                 oy = (sx * r01 + sy * r11 + sz * r21) * inv_s
@@ -1962,7 +2092,11 @@ def _mesh_trace_kernel_factory(
                     node, occluded = walk
                     # Occluded lanes stop driving the walk: their packet
                     # limit is -INF so no node can pass their slab test.
-                    limit = jnp.where(occluded > 0.0, -INF, INF)
+                    walk_blocked = (
+                        jnp.maximum(occluded, 1.0 - match_f)
+                        if match_f is not None else occluded
+                    )
+                    limit = jnp.where(walk_blocked > 0.0, -INF, INF)
                     next_node, start, count, do_leaf = walk_step(
                         node, ox, oy, oz, dx, dy, dz, invx, invy, invz,
                         limit,
@@ -1982,16 +2116,22 @@ def _mesh_trace_kernel_factory(
                         ),
                         lambda: jnp.zeros((1, block), jnp.float32),
                     )
+                    if match_f is not None:
+                        occ_add = occ_add * match_f
                     occluded = jnp.maximum(occluded, occ_add)
                     return next_node, occluded
 
                 node0 = jnp.where(touch, jnp.int32(0), jnp.int32(n_nodes))
-                _, occluded = jax.lax.while_loop(
+                _, walked_occluded = jax.lax.while_loop(
                     cond, body, (node0, occluded)
                 )
-                return occluded
+                return walked_occluded
 
-            return jax.lax.fori_loop(0, k_count, per_instance, occluded0)
+            return jax.lax.fori_loop(
+                k_sweep_lo if pool_io else 0,
+                k_sweep_hi if pool_io else k_count,
+                per_instance, occluded0,
+            )
 
         throughput = jnp.ones((3, block), jnp.float32)
         radiance = jnp.zeros((3, block), jnp.float32)
@@ -2012,6 +2152,8 @@ def _mesh_trace_kernel_factory(
             oc_sq = o_sq - 2.0 * oc + csq
             disc = oc_dot_d * oc_dot_d - (oc_sq - r2)
             valid = (disc > 0.0) & (r2 > 0.0)
+            if fid_match is not None:
+                valid = valid & fid_match
             sqrt_disc = jnp.sqrt(jnp.maximum(disc, 0.0))
             t0 = oc_dot_d - sqrt_disc
             t1 = oc_dot_d + sqrt_disc
@@ -2119,6 +2261,8 @@ def _mesh_trace_kernel_factory(
             ocsq_s = osq_s - 2.0 * oc_s + csq
             disc_s = ocd_s * ocd_s - (ocsq_s - r2)
             valid_s = (disc_s > 0.0) & (r2 > 0.0)
+            if fid_match is not None:
+                valid_s = valid_s & fid_match
             t1_s = ocd_s + jnp.sqrt(jnp.maximum(disc_s, 0.0))
             shadowed = jnp.max(
                 jnp.where(valid_s & (t1_s > EPS), 1.0, 0.0),
@@ -2175,7 +2319,7 @@ def _mesh_trace_kernel_factory(
             d = jnp.where(live, new_d, d)
             return (o, d, throughput, radiance, alive)
 
-        if state_io:
+        if state_io or pool_io:
             # ONE bounce with streamed state: overwrite the in-kernel
             # initial state with the caller's, run bounce_step once at the
             # caller's bounce index, stream everything back out. Blocks
@@ -2183,10 +2327,13 @@ def _mesh_trace_kernel_factory(
             # Morton sort / compaction puts dead lanes at the tail) and
             # pass state through untouched — bit-identical to what the
             # masked bounce computes for dead lanes, without paying for
-            # the walks.
+            # the walks. Pool mode: the bounce index is a per-lane row
+            # (mixed depths), consumed only by the RNG counter.
             throughput = thr_ref[:, :]
             alive = alive_ref[:, :]
-            bounce_index = bounce_ref[0, 0]
+            bounce_index = (
+                bounce_row_ref[:, :] if pool_io else bounce_ref[0, 0]
+            )
             block_start = pl.program_id(0) * block
             o, d, throughput, radiance, alive = jax.lax.cond(
                 block_start < live_ref[0, 0],
@@ -2540,3 +2687,314 @@ def occluded_instances_pallas(bvh, instances, origins, directions, already):
         bvh.skip, bvh.first, bvh.count,
         interpret=_interpret(),
     )
+
+
+# ---------------------------------------------------------------------------
+# Device-resident ray-pool (render/raypool.py) kernel plumbing.
+#
+# The pool driver runs the whole multi-frame batch inside ONE jitted
+# lax.while_loop, so these wrappers are NOT jitted themselves: operand prep
+# that is loop-invariant (the stacked multi-frame scene) is hoisted into
+# PoolSphereOperands / PoolMeshOperands built once before the loop, and the
+# per-iteration bounce call only transposes the pool state and launches the
+# pool_io kernel. Pool width must be a multiple of the kernel block — the
+# driver rounds up, so no per-call ray padding exists on this path.
+
+
+class PoolSphereOperands(NamedTuple):
+    """Loop-invariant kernel operands for a stacked multi-frame sphere
+    scene (frames on a per-sphere ``fid`` column; padded slots fid=-1)."""
+
+    c_t: jnp.ndarray  # [3, Np]
+    r2: jnp.ndarray  # [Np, 1]
+    csq: jnp.ndarray  # [Np, 1]
+    rad: jnp.ndarray  # [Np, 1]
+    albedo_t: jnp.ndarray  # [3, Np]
+    emission_t: jnp.ndarray  # [3, Np]
+    dc_sun: jnp.ndarray  # [Np, 1]
+    sfid: jnp.ndarray  # [Np, 1] float32 frame ids (-1 = padding)
+    params: jnp.ndarray  # [8, 3]
+
+
+def pool_sphere_operands(
+    centers, radii, albedo, emission, sphere_fid,
+    sun_direction, sun_color, sky_horizon, sky_zenith,
+    plane_albedo_a, plane_albedo_b,
+) -> PoolSphereOperands:
+    """Stack-prep for the pool sphere kernel. ``centers``/... are the
+    multi-frame concatenation [F*N, ...]; ``sphere_fid`` [F*N] int."""
+    n = centers.shape[0]
+    padded_n = -(-n // _SUBLANE) * _SUBLANE
+    pad = padded_n - n
+    c_t = jnp.pad(centers, ((0, pad), (0, 0))).T
+    radii_p = jnp.pad(radii, (0, pad))
+    albedo_t = jnp.pad(albedo, ((0, pad), (0, 0))).T
+    emission_t = jnp.pad(emission, ((0, pad), (0, 0))).T
+    sfid = jnp.pad(
+        sphere_fid.astype(jnp.float32), (0, pad), constant_values=-1.0
+    )[:, None]
+    params = jnp.zeros((8, 3), jnp.float32)
+    params = params.at[0].set(sun_direction)
+    params = params.at[1].set(sun_color)
+    params = params.at[2].set(sky_horizon)
+    params = params.at[3].set(sky_zenith)
+    params = params.at[4].set(plane_albedo_a)
+    params = params.at[5].set(plane_albedo_b)
+    return PoolSphereOperands(
+        c_t=c_t,
+        r2=(radii_p * radii_p)[:, None],
+        csq=jnp.sum(c_t * c_t, axis=0)[:, None],
+        rad=radii_p[:, None],
+        albedo_t=albedo_t,
+        emission_t=emission_t,
+        dc_sun=(c_t.T @ sun_direction)[:, None],
+        sfid=sfid,
+        params=params,
+    )
+
+
+class PoolMeshOperands(NamedTuple):
+    """PoolSphereOperands plus the shared BVH and the stacked (multi-
+    frame) instance transforms; ``ifid`` [F*K] marks each instance's
+    frame. ``sun_direction`` rides along for the kernel's SMEM scalars."""
+
+    spheres: PoolSphereOperands
+    sun_direction: jnp.ndarray  # [3]
+    # FID-MAJOR stacking contract: frame f's instances occupy rows
+    # [f*K, (f+1)*K) — the kernel's per-block frame-window sweep indexes
+    # the table by that arithmetic.
+    rotation: jnp.ndarray  # [F*K, 3, 3]
+    translation: jnp.ndarray  # [F*K, 3]
+    scale: jnp.ndarray  # [F*K]
+    inst_albedo: jnp.ndarray  # [F*K, 3]
+    ifid: jnp.ndarray  # [F*K] int32
+    k_per_frame: int  # K (static Python int; ops are closed over, not traced)
+    v0: jnp.ndarray
+    e1: jnp.ndarray
+    e2: jnp.ndarray
+    normal: jnp.ndarray
+    bounds_min: jnp.ndarray
+    bounds_max: jnp.ndarray
+    skip: jnp.ndarray
+    first: jnp.ndarray
+    count: jnp.ndarray
+
+
+def pool_instance_aabbs(ops: PoolMeshOperands):
+    """World AABBs (lo, hi) of the stacked instances — the broadphase
+    input for the pool's coherence-sort candidate key."""
+    table = _instance_table(
+        ops.rotation, ops.translation, ops.scale,
+        ops.bounds_min, ops.bounds_max,
+    )
+    return table[:, 13:16], table[:, 16:19]
+
+
+def pool_sphere_bounce(
+    ops: PoolSphereOperands, origins, directions, throughput, alive,
+    lane, fid, seed_row, bounce_row, live_count, *, total_bounces: int,
+):
+    """One pool bounce over a sphere-only stacked scene.
+
+    Pool width must be a multiple of SPHERE_BOUNCE_BLOCK_R. Returns
+    (contribution [P, 3], origins, directions, throughput, alive).
+    """
+    rays = origins.shape[0]
+    block = SPHERE_BOUNCE_BLOCK_R
+    if rays % block:
+        raise ValueError(f"pool width {rays} not a multiple of {block}")
+    padded_n = ops.c_t.shape[1]
+    o_t = origins.T
+    d_t = directions.T
+    thr_t = throughput.T
+    alive_t = alive.astype(jnp.float32)[None, :]
+    lane_t = lane.astype(jnp.int32)[None, :]
+    seed_t = seed_row.astype(jnp.int32)[None, :]
+    bounce_t = bounce_row.astype(jnp.int32)[None, :]
+    fid_t = fid.astype(jnp.float32)[None, :]
+    live_arr = jnp.asarray(live_count, jnp.int32).reshape(1, 1)
+
+    grid = (rays // block,)
+    whole = lambda i: (0, 0)  # noqa: E731
+    ray_block = pl.BlockSpec(
+        (3, block), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+    row_block = pl.BlockSpec(
+        (1, block), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+    contrib, o2, d2, thr2, alive2 = pl.pallas_call(
+        _trace_kernel_factory(total_bounces, padded_n, pool_io=True),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), whole, memory_space=pltpu.SMEM),
+            ray_block,
+            ray_block,
+            ray_block,
+            row_block,
+            row_block,
+            row_block,
+            row_block,
+            row_block,
+            pl.BlockSpec((3, padded_n), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, padded_n), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, padded_n), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, 3), whole, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[ray_block, ray_block, ray_block, ray_block, row_block],
+        out_shape=[
+            jax.ShapeDtypeStruct((3, rays), jnp.float32),
+            jax.ShapeDtypeStruct((3, rays), jnp.float32),
+            jax.ShapeDtypeStruct((3, rays), jnp.float32),
+            jax.ShapeDtypeStruct((3, rays), jnp.float32),
+            jax.ShapeDtypeStruct((1, rays), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(live_arr, o_t, d_t, thr_t, alive_t, lane_t, seed_t, bounce_t, fid_t,
+      ops.c_t, ops.r2, ops.csq, ops.rad, ops.albedo_t, ops.emission_t,
+      ops.dc_sun, ops.sfid, ops.params)
+    return contrib.T, o2.T, d2.T, thr2.T, alive2[0] > 0.5
+
+
+def pool_mesh_bounce(
+    ops: PoolMeshOperands, origins, directions, throughput, alive,
+    lane, fid, seed_row, bounce_row, live_count, *, total_bounces: int,
+):
+    """One pool bounce over a stacked multi-frame mesh scene.
+
+    Pool width must be a multiple of BVH_BLOCK_R. The front-to-back
+    instance ordering is recomputed per call (ray origins move every
+    iteration); results are instance-order invariant, as in
+    _mesh_bounce_io. Returns (contribution, origins, directions,
+    throughput, alive).
+    """
+    from tpu_render_cluster.render.mesh import LEAF_SIZE
+
+    rays = origins.shape[0]
+    if rays % BVH_BLOCK_R:
+        raise ValueError(
+            f"pool width {rays} not a multiple of {BVH_BLOCK_R}"
+        )
+    sp = ops.spheres
+    padded_n = sp.c_t.shape[1]
+    o_t = origins.T
+    d_t = directions.T
+    thr_t = throughput.T
+    alive_t = alive.astype(jnp.float32)[None, :]
+    lane_t = lane.astype(jnp.int32)[None, :]
+    seed_t = seed_row.astype(jnp.int32)[None, :]
+    bounce_t = bounce_row.astype(jnp.int32)[None, :]
+    fid_t = fid.astype(jnp.float32)[None, :]
+    live_arr = jnp.asarray(live_count, jnp.int32).reshape(1, 1)
+    # Per-block frame-id windows: the kernel sweeps only the table's
+    # contiguous [fid_lo*K, (fid_hi+1)*K) slice for each block
+    # (conservative: computed over every lane incl. the stale dead tail).
+    fid_blocks = fid.astype(jnp.int32).reshape(
+        rays // BVH_BLOCK_R, BVH_BLOCK_R
+    )
+    fid_lo = fid_blocks.min(axis=1)[None, :]  # [1, n_blocks]
+    fid_hi = fid_blocks.max(axis=1)[None, :]
+
+    # Front-to-back instance order WITHIN each frame's segment, from the
+    # mean live origin (dead lanes parked far away must not drag the
+    # anchor): the stacking stays fid-major — the kernel's window sweep
+    # depends on frame f owning rows [f*K, (f+1)*K) — while near
+    # instances still seed tight best-t early within each frame. Results
+    # are instance-order invariant, as in _mesh_bounce_io.
+    k_per_frame = ops.k_per_frame
+    n_frames = ops.rotation.shape[0] // k_per_frame
+    valid = (jnp.abs(origins) < 1e6).all(axis=1) & alive
+    anchor = jnp.sum(
+        jnp.where(valid[:, None], origins, 0.0), axis=0
+    ) / jnp.maximum(jnp.sum(valid), 1)
+    d2 = jnp.sum(
+        (ops.translation - anchor[None, :]) ** 2, axis=1
+    ).reshape(n_frames, k_per_frame)
+    within = jnp.argsort(d2, axis=1)  # [F, K]
+    near_first = (
+        within + (jnp.arange(n_frames, dtype=within.dtype) * k_per_frame)[:, None]
+    ).reshape(-1)
+    inst_table = _instance_table(
+        ops.rotation[near_first], ops.translation[near_first],
+        ops.scale[near_first],
+        ops.bounds_min, ops.bounds_max, ops.inst_albedo[near_first],
+    )
+    inst_table = jnp.concatenate(
+        [inst_table, ops.ifid[near_first].astype(jnp.float32)[:, None]],
+        axis=1,
+    )  # [F*K, 23]: column 22 is the instance's frame id
+    n_nodes = ops.skip.shape[0]
+    k_count = ops.rotation.shape[0]
+
+    grid = (rays // BVH_BLOCK_R,)
+    whole = lambda i: (0, 0)  # noqa: E731
+    flat = lambda i: (0,)  # noqa: E731
+    ray_block = pl.BlockSpec(
+        (3, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+    row_block = pl.BlockSpec(
+        (1, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+    contrib, o2, d2, thr2, alive2 = pl.pallas_call(
+        _mesh_trace_kernel_factory(
+            total_bounces, padded_n, n_nodes, LEAF_SIZE, k_count,
+            pool_io=True, k_per_frame=k_per_frame,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), whole, memory_space=pltpu.SMEM),
+            ray_block,
+            ray_block,
+            ray_block,
+            row_block,
+            row_block,
+            row_block,
+            row_block,
+            row_block,
+            pl.BlockSpec((1, 1), lambda i: (0, i), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, i), memory_space=pltpu.SMEM),
+            pl.BlockSpec((3, padded_n), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, padded_n), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, padded_n), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, 3), whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec((3,), flat, memory_space=pltpu.SMEM),
+            pl.BlockSpec(inst_table.shape, whole, memory_space=pltpu.SMEM),
+            pl.BlockSpec(ops.v0.shape, whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec(ops.e1.shape, whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec(ops.e2.shape, whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec(ops.normal.shape, whole, memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                ops.bounds_min.shape, whole, memory_space=pltpu.SMEM
+            ),
+            pl.BlockSpec(
+                ops.bounds_max.shape, whole, memory_space=pltpu.SMEM
+            ),
+            pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
+            pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
+            pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
+        ],
+        out_specs=[ray_block, ray_block, ray_block, ray_block, row_block],
+        out_shape=[
+            jax.ShapeDtypeStruct((3, rays), jnp.float32),
+            jax.ShapeDtypeStruct((3, rays), jnp.float32),
+            jax.ShapeDtypeStruct((3, rays), jnp.float32),
+            jax.ShapeDtypeStruct((3, rays), jnp.float32),
+            jax.ShapeDtypeStruct((1, rays), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(live_arr, o_t, d_t, thr_t, alive_t, lane_t, seed_t, bounce_t, fid_t,
+      fid_lo, fid_hi,
+      sp.c_t, sp.r2, sp.csq, sp.rad, sp.albedo_t, sp.emission_t,
+      sp.dc_sun, sp.sfid, sp.params, ops.sun_direction, inst_table,
+      ops.v0, ops.e1, ops.e2, ops.normal, ops.bounds_min, ops.bounds_max,
+      ops.skip, ops.first, ops.count)
+    return contrib.T, o2.T, d2.T, thr2.T, alive2[0] > 0.5

@@ -15,6 +15,17 @@ class RenderBackend(abc.ABC):
     directory and return a ``FrameRenderTime`` whose phases satisfy the
     performance reducer's monotonicity requirements
     (tpu_render_cluster/traces/performance.py).
+
+    Optional hint protocol: a backend may additionally define
+    ``note_upcoming_frames(job, frame_indices)``. Before each
+    ``render_frame`` the worker queue calls it (when present) with the
+    OTHER frames of the same job still queued locally — the honest
+    work-ahead visible to this worker. Backends that batch internally
+    (the tpu-raytrace ray-pool mode renders several queued frames in
+    one device program and serves later requests from its cache) key
+    off this hint; the one-frame-per-request wire contract is
+    unchanged, so masters and peers cannot tell a batching worker from
+    a serial one.
     """
 
     @abc.abstractmethod

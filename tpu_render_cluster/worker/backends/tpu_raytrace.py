@@ -38,6 +38,7 @@ class TpuRaytraceBackend(RenderBackend):
         tile_size: int | None = None,
         sharding: str | None = None,
         wavefront: str | None = None,
+        raypool: str | None = None,
     ) -> None:
         self.base_directory = Path(base_directory) if base_directory else None
         self.width = width
@@ -55,6 +56,39 @@ class TpuRaytraceBackend(RenderBackend):
         # compaction (live-count tail skip) instead, which composes with
         # shard_map.
         self.wavefront = wavefront
+        # Device-resident ray pool (render/raypool.py): None defers to the
+        # TRC_RAYPOOL env tier; "off"/"auto"/"force" override per backend.
+        # Auto fires for multi-frame deep-walk jobs — the queue's
+        # note_upcoming_frames hint supplies the work-ahead — and the
+        # backend then renders several of ITS OWN queued frames in one
+        # pool batch, serving later requests from the cache below.
+        # Worker-internal only: one frame per request on the wire.
+        self.raypool = raypool
+        self._upcoming: dict[str, tuple[int, ...]] = {}
+        # (job_name, frame_index) -> linear image rendered ahead by a pool
+        # batch. Bounded BY BYTES: stale entries (stolen/removed frames we
+        # rendered ahead of) are evicted oldest-first.
+        self._raypool_cache: dict[tuple[str, int], object] = {}
+
+    # Staleness backstop, not a working-set budget: live entries drain
+    # within one pool window of requests, so anything pushing the cache
+    # past this is stolen/removed frames.
+    _RAYPOOL_CACHE_MAX_BYTES = 64 * 1024 * 1024
+
+    def note_upcoming_frames(
+        self, job: BlenderJob, frame_indices: tuple[int, ...]
+    ) -> None:
+        """Queue hint (RenderBackend hint protocol): same-job frames still
+        queued on this worker, i.e. what a pool batch may render ahead.
+
+        An empty hint drops the job's entry — the map tracks only jobs
+        with outstanding local work, so a long-lived worker's job history
+        doesn't accumulate here.
+        """
+        if frame_indices:
+            self._upcoming[job.job_name] = tuple(frame_indices)
+        else:
+            self._upcoming.pop(job.job_name, None)
 
     def _use_wavefront(self, scene_name: str) -> bool:
         if self.sharding in ("tile", "spp"):
@@ -62,6 +96,17 @@ class TpuRaytraceBackend(RenderBackend):
         from tpu_render_cluster.render.compaction import wavefront_active
 
         return wavefront_active(scene_name, backend_flag=self.wavefront)
+
+    def _use_raypool(self, scene_name: str, frames_ahead: int) -> bool:
+        if self.sharding in ("tile", "spp"):
+            return False
+        from tpu_render_cluster.render.raypool import raypool_active
+
+        return raypool_active(
+            scene_name,
+            backend_flag=self.raypool,
+            frames_ahead=frames_ahead,
+        )
 
     def warm(self, scene_name: str) -> None:
         """Compile + execute the renderer once, outside any job window.
@@ -93,7 +138,26 @@ class TpuRaytraceBackend(RenderBackend):
                     mode=self.sharding,
                 )
             )
-        elif self._use_wavefront(scene_name):
+            return
+        if self._use_raypool(scene_name, frames_ahead=1):
+            # The pool program is one compile per pool config, batch size
+            # independent — a single-frame batch warms it completely. The
+            # per-frame fallback below is ALSO warmed: the job's tail
+            # frame (nothing queued behind it) renders through it, and
+            # its compile must not land inside a frame trace either.
+            from tpu_render_cluster.render.raypool import render_batch_raypool
+
+            np.asarray(
+                render_batch_raypool(
+                    scene_name,
+                    [1],
+                    width=self.width,
+                    height=self.height,
+                    samples=self.samples,
+                    max_bounces=self.max_bounces,
+                )[0]
+            )
+        if self._use_wavefront(scene_name):
             # One full wavefront frame: compiles the compaction +
             # bounce programs for the buckets this workload actually
             # visits (render_compiles_total then stays flat over the
@@ -126,8 +190,27 @@ class TpuRaytraceBackend(RenderBackend):
     async def render_frame(self, job: BlenderJob, frame_index: int) -> FrameRenderTime:
         return await asyncio.to_thread(self._render_sync, job, frame_index)
 
+    def _trim_raypool_cache(self) -> None:
+        """Evict oldest rendered-ahead frames past the byte cap (stale
+        entries accumulate when frames we batched ahead get stolen or
+        removed; at production resolution each image is megabytes, so the
+        bound must be bytes, not entries)."""
+        excess = (
+            sum(
+                getattr(image, "nbytes", 0)
+                for image in self._raypool_cache.values()
+            )
+            - self._RAYPOOL_CACHE_MAX_BYTES
+        )
+        while self._raypool_cache and excess > 0:
+            victim = self._raypool_cache.pop(next(iter(self._raypool_cache)))
+            excess -= getattr(victim, "nbytes", 0)
+
     @staticmethod
-    def _observe_render_obs(*, compile_seconds: float, execute_seconds: float) -> None:
+    def _observe_render_obs(
+        *, compile_seconds: float, execute_seconds: float,
+        from_cache: bool = False,
+    ) -> None:
         """Feed the process-global obs registry (one TPU per process).
 
         ``render_compile_seconds`` is the loading phase (fetching — or
@@ -144,6 +227,17 @@ class TpuRaytraceBackend(RenderBackend):
             "render_compile_seconds",
             "Per-frame compiled-renderer fetch/build (the 'loading' phase)",
         ).observe(max(0.0, compile_seconds))
+        if from_cache:
+            # A ray-pool cache hit: this frame's device time was amortized
+            # into the batch that rendered it ahead — its ~tonemap-only
+            # execute time belongs in neither the per-frame execute
+            # histogram nor the fps gauge (both would report fantasy
+            # per-frame device rates under batching).
+            registry.counter(
+                "render_raypool_cache_hits_total",
+                "Frames served from the ray-pool rendered-ahead cache",
+            ).inc()
+            return
         registry.histogram(
             "render_execute_seconds",
             "Per-frame device render + readback (block-until-ready fenced)",
@@ -168,9 +262,30 @@ class TpuRaytraceBackend(RenderBackend):
         # (which cost ~2 s/frame over a tunneled device).
         # Wavefront mode has no single cached renderer (its per-bucket
         # programs compile lazily inside the render — warm() pre-visits
-        # them), so its loading phase is just scene-name resolution.
-        use_wavefront = self._use_wavefront(scene_name)
-        if self.sharding not in ("tile", "spp") and not use_wavefront:
+        # them), so its loading phase is just scene-name resolution; same
+        # for the ray-pool path (one pool program per config, warmed).
+        cache_key = (job.job_name, frame_index)
+        cached_linear = self._raypool_cache.pop(cache_key, None)
+        upcoming = [
+            f
+            for f in self._upcoming.get(job.job_name, ())
+            if f != frame_index
+            and (job.job_name, f) not in self._raypool_cache
+        ]
+        use_raypool = cached_linear is None and self._use_raypool(
+            scene_name, frames_ahead=len(upcoming)
+        )
+        use_wavefront = (
+            cached_linear is None
+            and not use_raypool
+            and self._use_wavefront(scene_name)
+        )
+        if (
+            self.sharding not in ("tile", "spp")
+            and cached_linear is None
+            and not use_wavefront
+            and not use_raypool
+        ):
             renderer = fused_frame_renderer(
                 scene_name,
                 self.width,
@@ -181,7 +296,13 @@ class TpuRaytraceBackend(RenderBackend):
         finished_loading_at = time.time()
 
         started_rendering_at = time.time()
-        if self.sharding in ("tile", "spp"):
+        if cached_linear is not None:
+            # Rendered ahead by an earlier pool batch of this job: only
+            # the tonemap + readback run now. The batch's device time was
+            # carried by the frame that triggered it — per-frame phase
+            # timings under batching reflect that amortization.
+            display = tonemap(cached_linear)
+        elif self.sharding in ("tile", "spp"):
             from tpu_render_cluster.parallel.sharded_render import render_frame_sharded
 
             linear = render_frame_sharded(
@@ -194,6 +315,30 @@ class TpuRaytraceBackend(RenderBackend):
                 mode=self.sharding,
             )
             display = tonemap(linear)
+        elif use_raypool:
+            from tpu_render_cluster.render.raypool import (
+                raypool_frame_cap,
+                render_batch_raypool,
+            )
+
+            # One pool window: this frame plus the next queued frames of
+            # the same job (the queue's hint — all assigned to THIS
+            # worker, so nothing is rendered speculatively). Frames
+            # rendered ahead are served from the cache on their own
+            # requests.
+            batch = [frame_index] + upcoming[: raypool_frame_cap() - 1]
+            images = render_batch_raypool(
+                scene_name,
+                batch,
+                width=self.width,
+                height=self.height,
+                samples=self.samples,
+                max_bounces=self.max_bounces,
+            )
+            for ahead_frame, image in zip(batch[1:], images[1:]):
+                self._raypool_cache[(job.job_name, ahead_frame)] = image
+            self._trim_raypool_cache()
+            display = tonemap(images[0])
         elif use_wavefront:
             from tpu_render_cluster.render.compaction import render_frame_wavefront
 
@@ -232,6 +377,7 @@ class TpuRaytraceBackend(RenderBackend):
         self._observe_render_obs(
             compile_seconds=finished_loading_at - started_process_at,
             execute_seconds=finished_rendering_at - started_rendering_at,
+            from_cache=cached_linear is not None,
         )
         return FrameRenderTime(
             started_process_at=started_process_at,

@@ -89,6 +89,18 @@ def build_parser() -> argparse.ArgumentParser:
         "deep-walk mesh scenes where it measured faster.",
     )
     parser.add_argument(
+        "--raypool",
+        choices=["auto", "off", "force"],
+        default=None,
+        help="tpu-raytrace only: device-resident ray-pool execution "
+        "(cross-frame wavefront batching with in-jit compaction; "
+        "render/raypool.py). Default defers to the TRC_RAYPOOL env tier; "
+        "auto enables it for multi-frame deep-walk mesh jobs, where the "
+        "worker batches its queued frames into one pool internally (wire "
+        "format unchanged). Takes precedence over --wavefront when both "
+        "would fire.",
+    )
+    parser.add_argument(
         "--warmScene",
         dest="warm_scene",
         default=None,
@@ -140,6 +152,7 @@ def make_backend(args: argparse.Namespace):
             samples=args.render_samples,
             sharding=None if args.sharding == "none" else args.sharding,
             wavefront=args.wavefront,
+            raypool=args.raypool,
         )
     return create_backend("mock")
 

@@ -194,6 +194,21 @@ class WorkerAutomaticQueue:
     async def _render_frame_and_report(self, frame: QueuedFrame) -> None:
         frame.state = FrameState.RENDERING
         job_name = frame.job.job_name
+        # Backends that batch internally (ray-pool mode) get the same-job
+        # frames still queued HERE — real assigned work, so batching ahead
+        # never renders a frame this worker doesn't own (see
+        # RenderBackend's hint protocol).
+        note_upcoming = getattr(self._backend, "note_upcoming_frames", None)
+        if note_upcoming is not None:
+            note_upcoming(
+                frame.job,
+                tuple(
+                    f.frame_index
+                    for f in self._frames
+                    if f.state is FrameState.QUEUED
+                    and f.job.job_name == job_name
+                ),
+            )
         await self._sender.send_message(
             pm.WorkerFrameQueueItemRenderingEvent(
                 job_name, frame.frame_index, trace=frame.trace,
