@@ -30,6 +30,7 @@ from tpu_render_cluster.chaos.plan import (
 from tpu_render_cluster.jobs.models import BlenderJob, DistributionStrategy
 from tpu_render_cluster.master.cluster import ClusterManager
 from tpu_render_cluster.master.queue_mirror import FrameOnWorker, WorkerQueueMirror
+from tpu_render_cluster.jobs.tiles import WorkUnit
 from tpu_render_cluster.master.state import ClusterManagerState, FrameStatus
 from tpu_render_cluster.master.strategies import steal_frame
 from tpu_render_cluster.master.worker_handle import WorkerHandle
@@ -313,7 +314,7 @@ def test_duplicate_and_late_results_keep_ledger_exact():
     a._apply_rendering_event(pm.WorkerFrameQueueItemRenderingEvent("j", 1))
     ok_1 = pm.WorkerFrameQueueItemFinishedEvent.new_ok("j", 1)
     a._apply_finished_event(ok_1)
-    assert state.frames[1].status is FrameStatus.FINISHED
+    assert state.frames[WorkUnit(1)].status is FrameStatus.FINISHED
     assert state.finished_count() == 1
     a._apply_finished_event(ok_1)  # duplicated send
     assert state.finished_count() == 1  # no double-count
@@ -328,7 +329,7 @@ def test_duplicate_and_late_results_keep_ledger_exact():
     state.mark_frame_as_queued(2, b.worker_id, now)
     b.queue.add(FrameOnWorker(2, queued_at=now))
     a._apply_finished_event(pm.WorkerFrameQueueItemFinishedEvent.new_ok("j", 2))
-    assert state.frames[2].status is FrameStatus.FINISHED  # late ok accepted
+    assert state.frames[WorkUnit(2)].status is FrameStatus.FINISHED  # late ok accepted
     assert state.finished_count() == 2
     b._apply_finished_event(pm.WorkerFrameQueueItemFinishedEvent.new_ok("j", 2))
     assert state.finished_count() == 2  # B's copy absorbed as duplicate
@@ -340,8 +341,8 @@ def test_duplicate_and_late_results_keep_ledger_exact():
     a._apply_finished_event(
         pm.WorkerFrameQueueItemFinishedEvent.new_errored("j", 3, "boom")
     )
-    assert state.frames[3].status is FrameStatus.QUEUED_ON_WORKER
-    assert state.frames[3].worker_id == b.worker_id
+    assert state.frames[WorkUnit(3)].status is FrameStatus.QUEUED_ON_WORKER
+    assert state.frames[WorkUnit(3)].worker_id == b.worker_id
     assert state.pending_count() == 0
 
     # The exactly-once ledger: ok_results - duplicates == frames finished.
@@ -367,18 +368,18 @@ class _FakeWorker:
         self.queued_calls = []
         self._unqueue_hook = unqueue_hook
 
-    async def unqueue_frame(self, job_name, frame_index):
+    async def unqueue_frame(self, job_name, unit):
         if self._unqueue_hook is not None:
-            await self._unqueue_hook(self, frame_index)
-        self.queue.remove(frame_index)
+            await self._unqueue_hook(self, unit.frame_index)
+        self.queue.remove(unit.frame_index, tile=unit.tile)
         return pm.FRAME_QUEUE_REMOVE_RESULT_REMOVED
 
-    async def queue_frame(self, job, frame_index, *, stolen_from=None):
-        self.queued_calls.append(frame_index)
+    async def queue_frame(self, job, unit, *, stolen_from=None):
+        self.queued_calls.append(unit.frame_index)
         now = time.time()
-        self.queue.add(FrameOnWorker(frame_index, queued_at=now))
+        self.queue.add(FrameOnWorker(unit.frame_index, queued_at=now, tile=unit.tile))
         self.state.mark_frame_as_queued(
-            frame_index, self.worker_id, now, stolen_from=stolen_from
+            unit, self.worker_id, now, stolen_from=stolen_from
         )
 
 
@@ -391,9 +392,9 @@ def _steal_setup():
     # Assign in deque order like the strategy loop does (each assignment
     # pops its pending entry): 1-4 to the thief, 5 to the victim.
     for index in (1, 2, 3, 4):
-        assert state.next_pending_frame() == index
+        assert state.next_pending_unit() == WorkUnit(index)
         state.mark_frame_as_queued(index, thief.worker_id, now)
-    assert state.next_pending_frame() == 5
+    assert state.next_pending_unit() == WorkUnit(5)
     state.mark_frame_as_queued(5, victim.worker_id, now)
     victim.queue.add(FrameOnWorker(5, queued_at=now))
     return job, state, thief, victim
@@ -413,8 +414,8 @@ def test_steal_aborts_when_eviction_already_requeued():
         victim._unqueue_hook = evict_during_rpc
         assert await steal_frame(job, state, thief, victim, 5) is False
         assert thief.queued_calls == []
-        assert state.frames[5].status is FrameStatus.PENDING
-        assert list(state._pending).count(5) == 1
+        assert state.frames[WorkUnit(5)].status is FrameStatus.PENDING
+        assert list(state._pending).count(WorkUnit(5)) == 1
 
     asyncio.run(scenario())
 
@@ -431,8 +432,8 @@ def test_steal_requeues_when_eviction_cannot_see_the_frame():
         victim._unqueue_hook = die_without_evicting
         assert await steal_frame(job, state, thief, victim, 5) is False
         assert thief.queued_calls == []
-        assert state.frames[5].status is FrameStatus.PENDING
-        assert list(state._pending).count(5) == 1
+        assert state.frames[WorkUnit(5)].status is FrameStatus.PENDING
+        assert list(state._pending).count(WorkUnit(5)) == 1
 
     asyncio.run(scenario())
 
@@ -442,8 +443,8 @@ def test_steal_proceeds_when_victim_alive():
         job, state, thief, victim = _steal_setup()
         assert await steal_frame(job, state, thief, victim, 5) is True
         assert thief.queued_calls == [5]
-        assert state.frames[5].status is FrameStatus.QUEUED_ON_WORKER
-        assert state.frames[5].worker_id == thief.worker_id
+        assert state.frames[WorkUnit(5)].status is FrameStatus.QUEUED_ON_WORKER
+        assert state.frames[WorkUnit(5)].worker_id == thief.worker_id
 
     asyncio.run(scenario())
 

@@ -300,7 +300,7 @@ def test_worker_backend_batches_queue_and_serves_cache(monkeypatch, tmp_path):
     before = hits.value()
     asyncio.run(backend.render_frame(job, 1))
     assert set(backend._raypool_cache) == {
-        (job.job_name, 2), (job.job_name, 3)
+        (job.job_name, 2, None), (job.job_name, 3, None)
     }
     backend.note_upcoming_frames(job, (3,))
     asyncio.run(backend.render_frame(job, 2))

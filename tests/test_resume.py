@@ -97,10 +97,12 @@ def test_apply_resume_marks_finished_and_strategy_skips(tmp_path):
     state = ClusterManagerState(job)
     skipped = apply_resume(state, job)
     assert skipped == 3
-    assert state.pending_frames() == [3, 4, 6]
+    from tpu_render_cluster.jobs.tiles import WorkUnit
+
+    assert state.pending_units() == [WorkUnit(3), WorkUnit(4), WorkUnit(6)]
     assert not state.all_frames_finished()
     for i in (3, 4, 6):
-        state.mark_frame_as_finished(i)
+        state.mark_frame_as_finished(WorkUnit(i))
     assert state.all_frames_finished()
 
 

@@ -578,9 +578,19 @@ class TestMirrorJobIsolation:
         # Duplicate event for job a: its entry is gone — job b's must stay.
         assert mirror.remove(5, "a") is None
         assert mirror.get(5, "b").job_name == "b"
-        # Legacy unkeyed entries are still reachable by a named remove.
+        # The index-only legacy fallback is gone (PR 7): a lookup only
+        # ever matches its exact (job_name, frame_index, tile) key, so a
+        # named remove can never pop an anonymous entry (or vice versa).
         mirror.add(FrameOnWorker(7, queued_at=1.0))
-        assert mirror.remove(7, "whatever") is not None
+        assert mirror.remove(7, "whatever") is None
+        assert mirror.remove(7) is not None
+        # Tiles are part of the key: two tiles of one frame coexist and
+        # remove by tile pops exactly one.
+        mirror.add(FrameOnWorker(9, queued_at=1.0, job_name="a", tile=0))
+        mirror.add(FrameOnWorker(9, queued_at=1.0, job_name="a", tile=1))
+        assert mirror.remove(9, "a") is None  # whole-frame key: no match
+        assert mirror.remove(9, "a", 1).tile == 1
+        assert mirror.get(9, "a", 0).tile == 0
 
     def test_stale_generation_event_leaves_new_mirror_entry(self):
         """After a cancel + same-name resubmit, a late finished event from
@@ -611,6 +621,7 @@ class TestMirrorJobIsolation:
         handle.queue = WorkerQueueMirror()
         handle._rendering_started_at = {}
         handle._completion_observations = []
+        handle._on_frame_complete = None
         handle.logger = WorkerLogger(
             _logging.getLogger("test"), "000000ab", "test"
         )

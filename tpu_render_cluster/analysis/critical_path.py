@@ -37,6 +37,7 @@ __all__ = [
     "compute_critical_path",
     "worker_utilization",
     "straggler_scores",
+    "tile_statistics",
     "summarize_critical_path",
 ]
 
@@ -51,10 +52,13 @@ CHAIN_GAP_SECONDS = 0.050
 
 @dataclass
 class FrameLifecycle:
-    """One frame ASSIGNMENT's reconstructed spans (seconds, master clock)."""
+    """One work-unit ASSIGNMENT's reconstructed spans (seconds, master
+    clock). ``tile`` is None for whole-frame units; tiled jobs yield one
+    lifecycle per (frame, tile) assignment."""
 
     frame: int
     flow: str | None
+    tile: int | None = None
     worker: str | None = None
     assign: tuple[float, float] | None = None
     phases: dict[str, tuple[float, float]] = field(default_factory=dict)
@@ -110,10 +114,14 @@ def extract_lifecycles(events: list[dict[str, Any]]) -> list[FrameLifecycle]:
         if frame is None:
             return None
         flow = args.get("flow")
-        key = flow if flow is not None else ("frame", frame)
+        tile = args.get("tile")
+        tile = None if tile is None else int(tile)
+        key = flow if flow is not None else ("frame", frame, tile)
         lc = lifecycles.get(key)
         if lc is None:
-            lc = lifecycles[key] = FrameLifecycle(frame=int(frame), flow=flow)
+            lc = lifecycles[key] = FrameLifecycle(
+                frame=int(frame), flow=flow, tile=tile
+            )
         return lc
 
     for event in events:
@@ -321,6 +329,90 @@ def straggler_scores(
     return out
 
 
+def tile_statistics(
+    lifecycles: list[FrameLifecycle],
+) -> dict[str, Any] | None:
+    """Per-tile lifecycles rolled up: tile straggler scores + the
+    per-frame ASSEMBLY WAIT the master pays holding a frame's finished
+    tiles until its straggler tile lands.
+
+    - ``per_tile``: each tile index's median processing time against the
+      cluster median over all tiled units (score > 1 = that grid cell is
+      systematically slower — e.g. the scene's geometry concentrates
+      there), plus its assignment count.
+    - ``assembly``: per frame, wait = last tile end - first tile end
+      (what completed tiles waited on the straggler). The TERMINAL
+      frame's wait sits on the makespan-gating chain by construction —
+      reported as ``terminal_frame_wait_s``.
+
+    None when the timeline carries no tiled units.
+    """
+    tiled = [lc for lc in lifecycles if lc.tile is not None]
+    if not tiled:
+        return None
+    per_tile_processing: dict[int, list[float]] = {}
+    cluster: list[float] = []
+    for lc in tiled:
+        seconds = lc.processing_seconds
+        if seconds is None:
+            continue
+        per_tile_processing.setdefault(lc.tile, []).append(seconds)
+        cluster.append(seconds)
+    cluster.sort()
+    cluster_p50 = _percentile(cluster, 0.50) if cluster else 0.0
+    per_tile: dict[str, dict[str, Any]] = {}
+    for tile, values in sorted(per_tile_processing.items()):
+        values.sort()
+        p50 = _percentile(values, 0.50)
+        per_tile[str(tile)] = {
+            "units": len(values),
+            "processing_p50_s": p50,
+            "straggler_score": (p50 / cluster_p50) if cluster_p50 > 0 else 1.0,
+        }
+    # Assembly wait per frame: the spread of the frame's tile end times.
+    ends_by_frame: dict[int, list[float]] = {}
+    for lc in tiled:
+        end = lc.end
+        if end is not None:
+            ends_by_frame.setdefault(lc.frame, []).append(end)
+    waits = {
+        frame: max(ends) - min(ends)
+        for frame, ends in ends_by_frame.items()
+        if len(ends) > 1
+    }
+    sorted_waits = sorted(waits.values())
+    terminal_frame = (
+        max(ends_by_frame, key=lambda f: max(ends_by_frame[f]))
+        if ends_by_frame
+        else None
+    )
+    return {
+        "units": len(tiled),
+        "tiles_seen": len(per_tile_processing),
+        "per_tile": per_tile,
+        "tile_stragglers": sorted(
+            per_tile, key=lambda t: per_tile[t]["straggler_score"], reverse=True
+        ),
+        "assembly": {
+            "frames": len(waits),
+            "wait_mean_s": (
+                sum(sorted_waits) / len(sorted_waits) if sorted_waits else 0.0
+            ),
+            "wait_p95_s": _percentile(sorted_waits, 0.95) if sorted_waits else 0.0,
+            "wait_max_s": sorted_waits[-1] if sorted_waits else 0.0,
+            # The last-finishing frame's wait gates the makespan: its
+            # earlier tiles were DONE while the chain walked through the
+            # straggler tile.
+            "terminal_frame": terminal_frame,
+            "terminal_frame_wait_s": (
+                waits.get(terminal_frame, 0.0)
+                if terminal_frame is not None
+                else 0.0
+            ),
+        },
+    }
+
+
 def summarize_critical_path(events: list[dict[str, Any]]) -> dict[str, Any] | None:
     """The ``statistics.json`` roll-up for one merged cluster timeline.
 
@@ -350,6 +442,7 @@ def summarize_critical_path(events: list[dict[str, Any]]) -> dict[str, Any] | No
             by_worker[segment["worker"]] = (
                 by_worker.get(segment["worker"], 0.0) + segment["duration_s"]
             )
+    tiles = tile_statistics(lifecycles)
     out: dict[str, Any] = {
         "frames": len([lc for lc in lifecycles if lc.phases]),
         "assignments": len(lifecycles),
@@ -365,4 +458,6 @@ def summarize_critical_path(events: list[dict[str, Any]]) -> dict[str, Any] | No
             scores, key=lambda w: scores[w]["straggler_score"], reverse=True
         ),
     }
+    if tiles is not None:
+        out["tiles"] = tiles
     return out

@@ -446,6 +446,51 @@ def summarize_sched(metrics: list[dict[str, Any]]) -> dict[str, Any] | None:
     return out
 
 
+def summarize_tiles(metrics: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """Roll the master's tile-assembly evidence up (tiled jobs, PR 7).
+
+    Aggregates ``master_frames_assembled_total`` (by stitch outcome) and
+    the ``master_frame_assembly_seconds`` histogram from the master's
+    registry snapshots, plus each job view's ``assembly`` section when
+    present. None when no snapshot shows an assembled frame — untiled
+    runs get no ``tiles`` section. The per-tile straggler scores and
+    assembly-wait attribution live under ``critical_path.*.tiles``
+    (analysis/critical_path.tile_statistics), derived from the merged
+    cluster timeline's per-unit lifecycles.
+    """
+    assembled: dict[str, float] = {}
+    stitch_count = 0
+    stitch_sum = 0.0
+    jobs: dict[str, Any] = {}
+    for snapshot in metrics:
+        names = snapshot.get("metrics", {})
+        counter = names.get("master_frames_assembled_total")
+        if counter:
+            for label, value in counter.get("series", {}).items():
+                key = label.partition("=")[2] or label or "total"
+                assembled[key] = assembled.get(key, 0.0) + float(value)
+        histogram = names.get("master_frame_assembly_seconds")
+        if histogram:
+            for series in histogram.get("series", {}).values():
+                stitch_count += int(series.get("count", 0))
+                stitch_sum += float(series.get("sum", 0.0))
+        for job_name, view in (snapshot.get("jobs") or {}).items():
+            if isinstance(view, dict) and isinstance(view.get("assembly"), dict):
+                jobs[job_name] = view["assembly"]
+    if not assembled:
+        return None
+    out: dict[str, Any] = {
+        "frames_assembled": assembled,
+        "stitch_count": stitch_count,
+        "stitch_seconds_total": stitch_sum,
+    }
+    if stitch_count:
+        out["stitch_seconds_mean"] = stitch_sum / stitch_count
+    if jobs:
+        out["jobs"] = jobs
+    return out
+
+
 _CHAOS_LEDGER_COUNTERS = (
     "master_frame_results_total",
     "master_duplicate_results_total",
@@ -550,6 +595,9 @@ def summarize_obs(
     chaos = summarize_chaos(metrics)
     if chaos is not None:
         out["chaos"] = chaos
+    tiles = summarize_tiles(metrics)
+    if tiles is not None:
+        out["tiles"] = tiles
     sched = summarize_sched(metrics)
     if sched is not None:
         out["sched"] = sched

@@ -41,6 +41,7 @@ __all__ = [
     "check_invariants",
     "check_job_invariants",
     "check_multi_job_invariants",
+    "check_tile_invariants",
     "counter_total",
     "ledger_stats",
 ]
@@ -78,6 +79,47 @@ def ledger_stats(snapshot: dict[str, Any]) -> dict[str, float]:
     }
 
 
+def check_tile_invariants(
+    state: ClusterManagerState, *, expect_complete: bool = True
+) -> list[str]:
+    """The tile-grain exactly-once audit of one job's assembly ledger.
+
+    For a tiled job the unit equation (ok - duplicates == units_total,
+    checked by the callers) already proves each TILE landed exactly once;
+    this adds the FRAME-level shape on top:
+
+    - a completed job assembled every frame exactly once
+      (``frames_assembled == frame_count``);
+    - no frame is left PARTIALLY assembled — some tiles landed, some
+      not — after a completed run (cancel legitimately strands partial
+      frames mid-flight, so only the assembled-count monotone bound is
+      checked there): the no-ghost-frame guarantee.
+    """
+    if state.job.tiles_per_frame() == 1:
+        return []
+    violations: list[str] = []
+    frame_count = state.job.frame_count()
+    if expect_complete:
+        partial = state.partially_assembled_frames()
+        if partial:
+            violations.append(
+                f"tiles: {len(partial)} frame(s) partially assembled after "
+                f"a completed run: {partial[:10]}"
+            )
+        if state.frames_assembled != frame_count:
+            violations.append(
+                f"tiles: frames_assembled {state.frames_assembled} != "
+                f"frame count {frame_count} — a frame assembled twice or "
+                "never"
+            )
+    elif state.frames_assembled > frame_count:
+        violations.append(
+            f"tiles: frames_assembled {state.frames_assembled} exceeds the "
+            f"frame count {frame_count}"
+        )
+    return violations
+
+
 def check_job_invariants(
     state: ClusterManagerState,
     workers: "Iterable[WorkerHandle]",
@@ -99,14 +141,14 @@ def check_job_invariants(
     total = len(state.frames)
     if expect_complete:
         unfinished = sorted(
-            index
-            for index, record in state.frames.items()
-            if record.status is not FrameStatus.FINISHED
+            (unit for unit, record in state.frames.items()
+             if record.status is not FrameStatus.FINISHED),
+            key=lambda u: u.sort_key,
         )
         if unfinished:
             violations.append(
-                f"completion: {len(unfinished)} frame(s) not FINISHED: "
-                f"{unfinished[:10]}"
+                f"completion: {len(unfinished)} unit(s) not FINISHED: "
+                f"{[u.label for u in unfinished[:10]]}"
             )
         if state.finished_count() != total:
             violations.append(
@@ -123,14 +165,19 @@ def check_job_invariants(
                 f"{state.ledger['duplicate_results']} = {delivered_once}, "
                 f"expected {total} (frame table size)"
             )
+    violations.extend(
+        check_tile_invariants(state, expect_complete=expect_complete)
+    )
     for worker in workers:
         ghosts = sorted(
-            f.frame_index for f in worker.queue.frames_for_job(job_name)
+            (f.unit for f in worker.queue.frames_for_job(job_name)),
+            key=lambda u: u.sort_key,
         )
         if ghosts:
             violations.append(
                 f"ghost assignments: worker {worker.worker_id:08x} still "
-                f"mirrors frame(s) {ghosts[:10]} of job {job_name!r}"
+                f"mirrors unit(s) {[u.label for u in ghosts[:10]]} of job "
+                f"{job_name!r}"
             )
     return violations
 
@@ -220,19 +267,19 @@ def check_invariants(
     total = len(state.frames)
 
     unfinished = sorted(
-        index
-        for index, record in state.frames.items()
-        if record.status is not FrameStatus.FINISHED
+        (unit for unit, record in state.frames.items()
+         if record.status is not FrameStatus.FINISHED),
+        key=lambda u: u.sort_key,
     )
     if unfinished:
         violations.append(
-            f"completion: {len(unfinished)} frame(s) not FINISHED: "
-            f"{unfinished[:10]}"
+            f"completion: {len(unfinished)} unit(s) not FINISHED: "
+            f"{[u.label for u in unfinished[:10]]}"
         )
     if state.finished_count() != total:
         violations.append(
             f"completion: finished_count {state.finished_count()} != "
-            f"frame table size {total}"
+            f"unit table size {total}"
         )
 
     snapshot = manager.metrics.snapshot()
@@ -245,13 +292,18 @@ def check_invariants(
             f"= {delivered_once:.0f}, expected {total} (frame table size)"
         )
 
+    violations.extend(check_tile_invariants(state))
+
     for worker in manager.workers.values():
         if len(worker.queue) > 0:
-            ghosts = sorted(f.frame_index for f in worker.queue.all_frames())
+            ghosts = sorted(
+                (f.unit for f in worker.queue.all_frames()),
+                key=lambda u: u.sort_key,
+            )
             violations.append(
                 f"ghost assignments: worker {worker.worker_id:08x} "
                 f"({'dead' if worker.is_dead else 'alive'}) still mirrors "
-                f"frame(s) {ghosts[:10]}"
+                f"unit(s) {[u.label for u in ghosts[:10]]}"
             )
 
     expected_evictions = plan.expected_evictions()

@@ -86,7 +86,9 @@ class ChaosReport:
         }
 
 
-def _make_job(plan: FaultPlan, frames: int, strategy) -> BlenderJob:
+def _make_job(
+    plan: FaultPlan, frames: int, strategy, tile_grid=None
+) -> BlenderJob:
     if strategy is None:
         # Dynamic (work-stealing) by default: the strategy with the most
         # fault-sensitive moving parts — steals race evictions, queue
@@ -111,6 +113,7 @@ def _make_job(plan: FaultPlan, frames: int, strategy) -> BlenderJob:
         output_directory_path="%BASE%/out",
         output_file_name_format="rendered-#####",
         output_file_format="PNG",
+        tile_grid=tile_grid,
     )
 
 
@@ -232,9 +235,17 @@ def run_chaos_job(
     results_directory: str | Path | None = None,
     render_seconds: float = DEFAULT_RENDER_SECONDS,
     timeout: float = 180.0,
+    tile_grid: tuple[int, int] | None = None,
 ) -> ChaosReport:
-    """Run one seeded chaos job end to end and audit the invariants."""
-    job = _make_job(plan, frames, strategy)
+    """Run one seeded chaos job end to end and audit the invariants.
+
+    ``tile_grid`` torments the TILED pipeline: every frame splits into
+    grid tiles, so the same fault schedule now races evictions, steals,
+    duplicates, and drains against sub-frame units and the master's
+    per-frame assembly ledger — audited at tile granularity
+    (``invariants.check_tile_invariants``).
+    """
+    job = _make_job(plan, frames, strategy, tile_grid)
     registries = [MetricsRegistry() for _ in range(plan.workers)]
     controllers = [
         WorkerChaosController(slot, plan.events_for(slot), registry=registries[slot])
@@ -296,6 +307,8 @@ def run_chaos_job(
     master_snapshot = manager.metrics.snapshot()
     stats: dict[str, Any] = {
         "frames_total": len(manager.state.frames),
+        "tiles_per_frame": job.tiles_per_frame(),
+        "frames_assembled": manager.state.frames_assembled,
         "job_seconds": master_trace.job_finish_time - master_trace.job_start_time,
         "wall_seconds": time.time() - started,
         "worker_traces_collected": len(worker_traces),
@@ -470,6 +483,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="Where to write the run's obs artifacts (default: results/chaos-runs)",
     )
     parser.add_argument("--timeout", type=float, default=180.0)
+    parser.add_argument(
+        "--tiles",
+        default=None,
+        help="Tile grid ROWSxCOLS (e.g. 2x2): torment the tile-sharded "
+        "pipeline — sub-frame work units + the master's assembly ledger "
+        "(single-job mode only).",
+    )
     return parser
 
 
@@ -490,11 +510,17 @@ def main(argv: list[str] | None = None) -> int:
         from tpu_render_cluster.analysis.paths import RESULTS_ROOT
 
         results_directory = RESULTS_ROOT / "chaos-runs"
+    tile_grid = None
+    if args.tiles:
+        from tpu_render_cluster.jobs.tiles import parse_tile_grid
+
+        tile_grid = parse_tile_grid(args.tiles)
     report = run_chaos_job(
         plan,
         frames=args.frames,
         results_directory=results_directory,
         timeout=args.timeout,
+        tile_grid=tile_grid,
     )
     print(json.dumps(report.to_dict(), indent=2))
     return 0 if report.ok else 1

@@ -188,6 +188,12 @@ class BlenderJob:
     output_file_format: str
     # New (optional, absent from reference TOMLs): default worker backend hint.
     render_backend: str | None = None
+    # New (optional): sub-frame tile grid ``(rows, cols)``. When set, the
+    # unit of distribution becomes ``(frame, tile)`` — every frame splits
+    # into rows*cols independently schedulable tiles that the master
+    # re-assembles (master/assembly.py). None (the reference contract)
+    # keeps whole-frame units and byte-identical wire traffic.
+    tile_grid: tuple[int, int] | None = None
 
     def __post_init__(self) -> None:
         """Reject structurally-broken jobs at load time, not mid-dispatch.
@@ -217,6 +223,31 @@ class BlenderJob:
                 "wait_for_number_of_workers must be >= 1, got "
                 f"{self.wait_for_number_of_workers}"
             )
+        if self.tile_grid is not None:
+            from tpu_render_cluster.jobs.tiles import validate_tile_grid
+
+            # Normalize to the canonical int tuple before validating
+            # (frozen dataclass: go through __setattr__ like __post_init__
+            # frameworks do). Anything non-[rows, cols]-shaped — a string,
+            # mixed types, wrong arity — lands in the aggregated
+            # 'Invalid job' report like every other field.
+            if isinstance(self.tile_grid, (str, bytes)):
+                grid = None  # "22" must not silently iterate into (2, 2)
+            else:
+                try:
+                    grid = tuple(int(v) for v in self.tile_grid)
+                except (TypeError, ValueError):
+                    grid = None
+            if grid is None or len(grid) != 2:
+                problems.append(
+                    f"tiles must be [rows, cols], got {self.tile_grid!r}"
+                )
+            else:
+                object.__setattr__(self, "tile_grid", grid)
+                try:
+                    validate_tile_grid(grid)
+                except ValueError as e:
+                    problems.append(str(e))
         if problems:
             raise ValueError(
                 f"Invalid job {self.job_name!r}: " + "; ".join(problems)
@@ -229,6 +260,26 @@ class BlenderJob:
 
     def frame_count(self) -> int:
         return self.frame_range_to - self.frame_range_from + 1
+
+    def tiles_per_frame(self) -> int:
+        if self.tile_grid is None:
+            return 1
+        return self.tile_grid[0] * self.tile_grid[1]
+
+    def work_units(self):
+        """Every schedulable unit: frames, or (frame, tile) pairs, in
+        frame-major tile-minor order."""
+        from tpu_render_cluster.jobs.tiles import WorkUnit
+
+        for frame_index in self.frame_indices():
+            if self.tile_grid is None:
+                yield WorkUnit(frame_index)
+            else:
+                for tile in range(self.tiles_per_frame()):
+                    yield WorkUnit(frame_index, tile)
+
+    def unit_count(self) -> int:
+        return self.frame_count() * self.tiles_per_frame()
 
     # -- serde -------------------------------------------------------------
 
@@ -248,6 +299,8 @@ class BlenderJob:
         }
         if self.render_backend is not None:
             out["render_backend"] = self.render_backend
+        if self.tile_grid is not None:
+            out["tiles"] = list(self.tile_grid)
         return out
 
     @classmethod
@@ -267,6 +320,10 @@ class BlenderJob:
             output_file_name_format=str(data["output_file_name_format"]),
             output_file_format=str(data["output_file_format"]),
             render_backend=data.get("render_backend"),
+            # Raw value through to __post_init__'s normalization, so a
+            # malformed tiles key gets the aggregated 'Invalid job' error
+            # instead of a bare int() traceback here.
+            tile_grid=data.get("tiles"),
         )
 
     @classmethod
@@ -278,4 +335,14 @@ class BlenderJob:
             raise FileNotFoundError(f"No such job file: {path}")
         with path.open("rb") as f:
             data = tomllib.load(f)
-        return cls.from_dict(data)
+        job = cls.from_dict(data)
+        if job.tile_grid is None:
+            # TRC_TILE_GRID supplies a default grid at LOAD time only:
+            # wire decoding must never consult the environment, or a
+            # worker could reinterpret a job the master defined.
+            from tpu_render_cluster.jobs.tiles import env_tile_grid
+
+            grid = env_tile_grid()
+            if grid is not None:
+                job = cls.from_dict({**data, "tiles": list(grid)})
+        return job

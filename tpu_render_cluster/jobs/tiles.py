@@ -1,0 +1,119 @@
+"""The sub-frame work unit: ``(frame_index, tile)``.
+
+PR 7 extends the cluster's atom of distribution from a whole frame to an
+image tile, so one large frame can spread across every idle worker and
+per-frame LATENCY (not just throughput) scales with cluster size. This
+module is the single definition site for the unit key and the tile
+geometry — master state, the queue mirrors, the worker queue, and the
+renderer's region path all normalize through here so frame-keyed callers
+cannot drift from tile-keyed ones.
+
+Conventions:
+
+- ``tile is None`` means the whole frame — the pre-tiling work unit. All
+  wire traffic for whole-frame jobs omits the tile key entirely and stays
+  byte-identical to the reference protocol (C++ workers interoperate
+  unmodified on whole-frame jobs).
+- A tiled job carries a grid ``(rows, cols)``; tiles are indexed row-major
+  ``0 .. rows*cols - 1``. Tile PIXEL bounds are derived from the grid and
+  the render resolution by ``tile_bounds`` (the renderer's resolution is
+  backend configuration, so the wire carries only the grid + index).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import NamedTuple
+
+# Grid ceiling: the unit tables, mirrors, and the assembly ledger are all
+# O(tiles) per frame, and a 16x16 grid already turns one frame into 256
+# schedulable units — far past the point where per-unit RPC overhead
+# dominates. Guarded at job validation time.
+MAX_TILE_GRID_DIM = 16
+
+
+class WorkUnit(NamedTuple):
+    """One schedulable unit of work: a frame, or one tile of a frame."""
+
+    frame_index: int
+    tile: int | None = None  # None = whole frame (reference behavior)
+
+    @property
+    def is_tiled(self) -> bool:
+        return self.tile is not None
+
+    @property
+    def sort_key(self) -> tuple[int, int]:
+        """Total order that never compares ``None`` to an int (a job's
+        units are uniformly tiled or uniformly whole-frame, but cross-job
+        collections — goodbye sweeps, ghost listings — mix both)."""
+        return (self.frame_index, -1 if self.tile is None else self.tile)
+
+    @property
+    def label(self) -> str:
+        """Log/span label: ``"12"`` for a frame, ``"12/t03"`` for a tile."""
+        if self.tile is None:
+            return str(self.frame_index)
+        return f"{self.frame_index}/t{self.tile:02d}"
+
+
+def parse_tile_grid(text: str) -> tuple[int, int]:
+    """Parse ``TRC_TILE_GRID``: ``"2x2"``, ``"2,3"``, or ``"4"`` (square)."""
+    cleaned = text.strip().lower().replace("x", ",")
+    parts = [p for p in cleaned.split(",") if p.strip()]
+    if len(parts) == 1:
+        rows = cols = int(parts[0])
+    elif len(parts) == 2:
+        rows, cols = int(parts[0]), int(parts[1])
+    else:
+        raise ValueError(f"Unparseable tile grid: {text!r} (want ROWSxCOLS)")
+    validate_tile_grid((rows, cols))
+    return rows, cols
+
+
+def env_tile_grid() -> tuple[int, int] | None:
+    """The ``TRC_TILE_GRID`` default grid for jobs loaded from TOML files
+    that don't specify one. Read at job LOAD time only — never while
+    decoding wire payloads, so a worker's environment cannot reinterpret
+    a job the master defined."""
+    value = os.environ.get("TRC_TILE_GRID", "").strip()
+    if not value or value in ("0", "off", "none", "1", "1x1"):
+        return None
+    return parse_tile_grid(value)
+
+
+def validate_tile_grid(grid: tuple[int, int]) -> None:
+    rows, cols = grid
+    if rows < 1 or cols < 1:
+        raise ValueError(f"tile grid dimensions must be >= 1, got {rows}x{cols}")
+    if rows > MAX_TILE_GRID_DIM or cols > MAX_TILE_GRID_DIM:
+        raise ValueError(
+            f"tile grid {rows}x{cols} exceeds the {MAX_TILE_GRID_DIM}x"
+            f"{MAX_TILE_GRID_DIM} ceiling"
+        )
+
+
+def tile_rc(tile: int, grid: tuple[int, int]) -> tuple[int, int]:
+    """Row-major (row, col) of a tile index within the grid."""
+    rows, cols = grid
+    if not (0 <= tile < rows * cols):
+        raise ValueError(f"tile {tile} outside the {rows}x{cols} grid")
+    return tile // cols, tile % cols
+
+
+def tile_bounds(
+    tile: int, grid: tuple[int, int], *, width: int, height: int
+) -> tuple[int, int, int, int]:
+    """Pixel bounds ``(y0, x0, tile_height, tile_width)`` of a tile.
+
+    Even split with the remainder spread over the leading rows/cols
+    (``floor(i*H/rows)`` boundaries), so tiles differ by at most one
+    pixel per axis and the union over the grid is exactly the frame.
+    """
+    row, col = tile_rc(tile, grid)
+    rows, cols = grid
+    y0 = row * height // rows
+    y1 = (row + 1) * height // rows
+    x0 = col * width // cols
+    x1 = (col + 1) * width // cols
+    return y0, x0, y1 - y0, x1 - x0

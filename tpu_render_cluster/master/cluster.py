@@ -25,6 +25,7 @@ from pathlib import Path
 
 from tpu_render_cluster import PROTOCOL_VERSION
 from tpu_render_cluster.jobs.models import BlenderJob
+from tpu_render_cluster.master.assembly import FrameAssemblyService
 from tpu_render_cluster.master.state import ClusterManagerState, FrameStatus
 from tpu_render_cluster.master.strategies import run_strategy
 from tpu_render_cluster.master.worker_handle import WorkerHandle
@@ -58,18 +59,24 @@ BARRIER_POLL_SECONDS = 1.0  # reference: master/src/cluster/mod.rs:568-585
 
 
 def job_state_view(state: ClusterManagerState) -> dict:
-    """One job's live frame accounting + exactly-once ledger (the shared
-    shape of the single-job and scheduler ``jobs`` sections)."""
+    """One job's live work-unit accounting + exactly-once ledger (the
+    shared shape of the single-job and scheduler ``jobs`` sections). The
+    ``frames_*`` keys count UNITS (tiles under a tile grid) — the quantity
+    the dispatch/dedup machinery meters; the ``assembly`` section carries
+    the frame-level view for tiled jobs."""
     total = len(state.frames)
     finished = state.finished_count()
     pending = state.pending_count()
-    return {
+    view = {
         "frames_total": total,
         "frames_finished": finished,
         "frames_pending": pending,
         "frames_in_flight": total - finished - pending,
         "ledger": dict(state.ledger),
     }
+    if state.job.tile_grid is not None:
+        view["assembly"] = state.assembly_view()
+    return view
 
 
 class ClusterManager:
@@ -85,6 +92,7 @@ class ClusterManager:
         span_tracer: Tracer | None = None,
         metrics_snapshot_path: str | Path | None = None,
         dispatch_delay_fn=None,
+        output_base_directory: str | Path | None = None,
     ) -> None:
         self.host = host
         self.port = port
@@ -107,6 +115,16 @@ class ClusterManager:
         self.metrics = metrics if metrics is not None else get_registry()
         self.span_tracer = span_tracer or Tracer("master")
         self._transport_metrics = TransportMetrics(self.metrics)
+        # Tiled frames: when the last tile of a frame lands, the assembly
+        # service stitches the tile files into the frame's final image
+        # (master/assembly.py). ``output_base_directory`` resolves a job's
+        # %BASE% output prefix on the master's filesystem (None = the
+        # job's paths are usable as-is, e.g. the in-process harness).
+        self.assembly = FrameAssemblyService(
+            metrics=self.metrics,
+            span_tracer=self.span_tracer,
+            base_directory=output_base_directory,
+        )
         # When set, a 1 Hz SnapshotWriter keeps this file fresh while the
         # job runs (live inspection), with a final write at shutdown.
         self._snapshot_writer = (
@@ -399,6 +417,7 @@ class ClusterManager:
             span_tracer=self.span_tracer,
             dispatch_delay_fn=dispatch_delay_fn,
             state_resolver=self._state_for_job,
+            on_frame_complete=self.assembly.schedule,
         )
         self.workers[worker_id] = worker
         worker.start()
@@ -416,15 +435,15 @@ class ClusterManager:
             await worker.send_job_started(trace_id=trace_id, job_id=job_id)
 
     async def _evict_worker(self, worker: WorkerHandle, reason: str) -> None:
-        """Return a dead worker's frames to the pool so its jobs can finish."""
+        """Return a dead worker's units to the pool so its jobs can finish."""
         logger.warning("Evicting worker %08x: %s", worker.worker_id, reason)
         for frame in worker.queue.all_frames():
             state = self._state_for_job(frame.job_name)
             if state is None:
                 continue  # the owning job is already gone
-            record = state.frames.get(frame.frame_index)
+            record = state.frames.get(frame.unit)
             if record is not None and record.status is not FrameStatus.FINISHED:
-                state.return_frame_to_pending(frame.frame_index)
+                state.return_frame_to_pending(frame.unit)
         # No ghost assignments: a dead worker's mirror must not keep
         # offering steal candidates (or claim queue depth) for frames that
         # just went back to the pool.
@@ -493,9 +512,23 @@ class ClusterManager:
             track="job",
             args={"strategy": strategy.strategy_type, "frames": len(self.state.frames)},
         ):
-            await run_strategy(
-                self.job, self.state, self.live_workers, self.cancellation
-            )
+            try:
+                await run_strategy(
+                    self.job, self.state, self.live_workers, self.cancellation
+                )
+            finally:
+                # Accepted late results can finish a unit while its
+                # re-dispatched twin still sits queued on a live worker;
+                # the job is over, so those mirror entries are ghosts now
+                # — sweep them (closing their flows) before anything
+                # audits the mirrors. Tiled jobs: the last tile's
+                # finished event schedules the frame's stitch
+                # asynchronously — completed frames' stitches must land
+                # on disk even when the strategy RAISES (a failed job
+                # must not abandon mid-write assembly tasks).
+                for worker in self.live_workers():
+                    worker.sweep_finished_units(self._state_for_job)
+                await self.assembly.drain()
         finish = time.time()
         if not self.state.all_frames_finished():
             raise RuntimeError("Strategy exited before all frames finished.")

@@ -82,6 +82,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="Where the service's obs artifacts + metrics-live.json land "
         "(defaults to the canonical results/cluster-runs directory).",
     )
+    serve.add_argument(
+        "--baseDirectory",
+        dest="base_directory",
+        default=None,
+        help="%%BASE%% root for resolving tiled jobs' output directories "
+        "on the MASTER (the assembly stitcher reads tile files and writes "
+        "the final frames there).",
+    )
     return parser
 
 
@@ -98,6 +106,7 @@ async def serve_command(args: argparse.Namespace) -> int:
         args.host,
         args.port,
         metrics_snapshot_path=results_directory / "metrics-live.json",
+        output_base_directory=args.base_directory,
     )
     control = ControlServer(manager, args.host, args.control_port)
     await control.start()
@@ -140,6 +149,9 @@ async def run_job_command(args: argparse.Namespace) -> int:
         args.port,
         job,
         metrics_snapshot_path=Path(args.results_directory) / "metrics-live.json",
+        # Tiled jobs: the assembly stitcher resolves the job's %BASE%
+        # output prefix with the same base directory resume does.
+        output_base_directory=args.base_directory,
     )
     if args.resume:
         from tpu_render_cluster.master.resume import apply_resume

@@ -1,4 +1,4 @@
-"""Master-side replica of each worker's frame queue.
+"""Master-side replica of each worker's work-unit queue.
 
 Reference: ``WorkerQueue`` / ``FrameOnWorker``
 (master/src/connection/queue.rs:10-122). The mirror lets the scheduler sort
@@ -6,12 +6,14 @@ workers by load and pick steal candidates without a network round-trip; the
 atomic size counter of the reference collapses to ``len()`` because all
 mutation happens on one event loop.
 
-Multi-job extension: a worker's queue can hold frames from SEVERAL jobs
-(sched/manager.py multiplexes them), and two jobs may legitimately contain
-the same frame index, so entries are keyed by ``(job_name, frame_index)``.
-Callers that don't pass a job name (single-job code paths, older tests)
-fall back to an index-only scan — with one job on the queue that is the
-exact pre-multi-job behavior.
+Keying: entries are keyed ``(job_name, frame_index, tile)`` through the
+single ``mirror_key`` normalizer — a worker's queue can hold units from
+SEVERAL jobs (sched/manager.py multiplexes them), two jobs may contain the
+same frame index, and a tiled job legitimately parks several tiles of ONE
+frame on one worker. The index-only legacy fallback scan that predated the
+multi-job mirror is gone: every mutating caller names the owning job (the
+single-job paths included — their one job's name is always at hand), so a
+fallback could only ever mask a routing bug.
 """
 
 from __future__ import annotations
@@ -19,8 +21,21 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import TYPE_CHECKING
 
+from tpu_render_cluster.jobs.tiles import WorkUnit
+
 if TYPE_CHECKING:
     from tpu_render_cluster.protocol.messages import TraceContext
+
+MirrorKey = tuple[str | None, int, int | None]
+
+
+def mirror_key(
+    job_name: str | None, frame_index: int, tile: int | None = None
+) -> MirrorKey:
+    """THE mirror key normalizer: every lookup and every insertion goes
+    through here, so frame-keyed callers cannot drift from tile-keyed
+    ones (``tile=None`` IS the whole-frame key, not a wildcard)."""
+    return (job_name, int(frame_index), tile if tile is None else int(tile))
 
 
 @dataclass
@@ -36,75 +51,63 @@ class FrameOnWorker:
     # Owning job (multi-job masters; None on the legacy single-job path).
     job_name: str | None = None
     job_id: str | None = None
+    # Sub-frame tile index (None = whole frame).
+    tile: int | None = None
+
+    @property
+    def unit(self) -> WorkUnit:
+        return WorkUnit(self.frame_index, self.tile)
 
 
 class WorkerQueueMirror:
     """Insertion-ordered mirror of a worker's remote queue."""
 
     def __init__(self) -> None:
-        self._frames: dict[tuple[str | None, int], FrameOnWorker] = {}
+        self._frames: dict[MirrorKey, FrameOnWorker] = {}
 
     def __len__(self) -> int:
         return len(self._frames)
 
-    def __contains__(self, frame_index: int) -> bool:
-        return self._find_key(frame_index) is not None
-
-    def _find_key(
-        self, frame_index: int, job_name: str | None = None
-    ) -> tuple[str | None, int] | None:
-        """Exact ``(job_name, frame_index)`` hit, else a LEGACY-only scan.
-
-        The fallback keeps pre-multi-job callers working (entries added
-        without a job_name, single-job mirrors) but must never cross
-        jobs: a caller that names a job may only fall back to entries
-        that were added WITHOUT one — otherwise a duplicate event for
-        job A's already-popped frame could pop job B's same-index entry.
-        """
-        if (job_name, frame_index) in self._frames:
-            return (job_name, frame_index)
-        for key in self._frames:
-            if key[1] == frame_index and (job_name is None or key[0] is None):
-                return key
-        return None
-
     def add(self, frame: FrameOnWorker) -> None:
-        self._frames[(frame.job_name, frame.frame_index)] = frame
+        self._frames[
+            mirror_key(frame.job_name, frame.frame_index, frame.tile)
+        ] = frame
 
     def get(
-        self, frame_index: int, job_name: str | None = None
+        self, frame_index: int, job_name: str | None = None,
+        tile: int | None = None,
     ) -> FrameOnWorker | None:
-        key = self._find_key(frame_index, job_name)
-        return self._frames[key] if key is not None else None
+        return self._frames.get(mirror_key(job_name, frame_index, tile))
 
     def remove(
-        self, frame_index: int, job_name: str | None = None
+        self, frame_index: int, job_name: str | None = None,
+        tile: int | None = None,
     ) -> FrameOnWorker | None:
-        key = self._find_key(frame_index, job_name)
-        if key is None:
-            return None
-        return self._frames.pop(key)
+        return self._frames.pop(mirror_key(job_name, frame_index, tile), None)
 
     def clear(self) -> None:
-        """Drop every mirrored frame (eviction/drain: the worker is gone
+        """Drop every mirrored unit (eviction/drain: the worker is gone
         and keeping its mirror would leave ghost assignments a later steal
         pass could try to act on)."""
         self._frames.clear()
 
-    def set_rendering(self, frame_index: int, job_name: str | None = None) -> None:
-        key = self._find_key(frame_index, job_name)
-        if key is not None:
-            self._frames[key].is_rendering = True
+    def set_rendering(
+        self, frame_index: int, job_name: str | None = None,
+        tile: int | None = None,
+    ) -> None:
+        entry = self._frames.get(mirror_key(job_name, frame_index, tile))
+        if entry is not None:
+            entry.is_rendering = True
 
     def queued_frames_in_order(self) -> list[FrameOnWorker]:
-        """Frames not yet rendering, oldest first (steal-candidate order)."""
+        """Units not yet rendering, oldest first (steal-candidate order)."""
         return [f for f in self._frames.values() if not f.is_rendering]
 
     def all_frames(self) -> list[FrameOnWorker]:
         return list(self._frames.values())
 
     def frames_for_job(self, job_name: str) -> list[FrameOnWorker]:
-        """This job's mirrored frames, insertion order (sched/cancel path)."""
+        """This job's mirrored units, insertion order (sched/cancel path)."""
         return [f for f in self._frames.values() if f.job_name == job_name]
 
     def pending_size(self) -> int:

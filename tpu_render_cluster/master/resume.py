@@ -96,9 +96,19 @@ def apply_resume(
     base_directory: Path | str | None = None,
 ) -> int:
     """Marks already-rendered frames finished; returns how many were skipped."""
+    from tpu_render_cluster.jobs.tiles import WorkUnit
+
     rendered = scan_rendered_frames(job, base_directory)
     for frame_index in sorted(rendered):
-        state.mark_frame_as_finished(frame_index)
+        # A finished FRAME file covers every unit of that frame: under a
+        # tile grid the assembled output only exists once all tiles landed
+        # and were stitched, so all of them are safe to skip.
+        if job.tile_grid is None:
+            state.mark_frame_as_finished(WorkUnit(frame_index))
+        else:
+            for tile in range(job.tiles_per_frame()):
+                if state.mark_frame_as_finished(WorkUnit(frame_index, tile)):
+                    state.note_frame_assembled(frame_index)
     if rendered:
         logger.info(
             "Resume: %d/%d frames already rendered; %d remain.",

@@ -1,21 +1,30 @@
-"""Cluster manager state: the global frame table + worker registry.
+"""Cluster manager state: the global work-unit table + worker registry.
 
 Semantics follow the reference's ``ClusterManagerState`` frame status machine
 (Pending -> QueuedOnWorker -> RenderingOnWorker -> Finished, with steal
 transitions back to Queued — reference: master/src/cluster/state.rs:13-130),
 but the data structures are scale-fixed: the reference linearly scans a
 ``Vec`` of 14 400 frames on every 50 ms tick (state.rs:63-80, flagged in
-SURVEY.md §5.7); here pending frames live in a deque and finished frames in
-a counter, making ``next_pending_frame``/``all_frames_finished`` O(1).
+SURVEY.md §5.7); here pending units live in a deque and finished units in
+a counter, making ``next_pending_unit``/``all_frames_finished`` O(1).
+
+PR 7 extends the unit of distribution from a whole frame to
+``WorkUnit(frame_index, tile)`` (jobs/tiles.py): for a tiled job every
+frame splits into grid tiles that dispatch, steal, evict, and dedup
+independently, and a per-frame ASSEMBLY ledger tracks which tiles have
+landed so the frame-level result (the stitched image, the "frame done"
+event) fires exactly once — when the last tile lands. Whole-frame jobs
+(``tile is None``) behave exactly as before.
 """
 
 from __future__ import annotations
 
 import enum
 from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from tpu_render_cluster.jobs.models import BlenderJob
+from tpu_render_cluster.jobs.tiles import WorkUnit
 from tpu_render_cluster.protocol.messages import generate_trace_id
 
 
@@ -28,19 +37,32 @@ class FrameStatus(enum.Enum):
 
 @dataclass
 class FrameRecord:
-    frame_index: int
+    unit: WorkUnit
     status: FrameStatus = FrameStatus.PENDING
     worker_id: int | None = None
     queued_at: float | None = None
-    # Worker the frame was last stolen FROM (provenance for the
+    # Worker the unit was last stolen FROM (provenance for the
     # resteal-to-original-worker anti-thrash timer, reference:
     # master/src/cluster/state.rs:13-24, strategies.rs:155-191).
     stolen_from: int | None = None
     stolen_at: float | None = None
+    # Errored results received for this unit across all its assignments.
+    # A deterministic failure (a backend that cannot render the unit at
+    # all) would otherwise requeue-and-error forever; the cap turns the
+    # livelock into a job failure (worker_handle -> failed_reason).
+    errored_count: int = 0
+
+    @property
+    def frame_index(self) -> int:
+        return self.unit.frame_index
+
+    @property
+    def tile(self) -> int | None:
+        return self.unit.tile
 
 
 class ClusterManagerState:
-    """Per-job frame table; single event loop, so no locking is needed.
+    """Per-job work-unit table; single event loop, so no locking is needed.
 
     One instance per RUNNING job: the single-job master owns exactly one,
     the multi-job scheduler (sched/manager.py) one per admitted job, with
@@ -59,14 +81,18 @@ class ClusterManagerState:
         # submission's job_id must not count against a new job that
         # happens to share the name.
         self.sched_job_id: str | None = None
-        self.frames: dict[int, FrameRecord] = {
-            index: FrameRecord(index) for index in job.frame_indices()
+        # Set when a unit exhausts its error budget: the strategy loops
+        # surface it as a job failure (the scheduler cancels the job)
+        # instead of spinning redispatch RPCs forever.
+        self.failed_reason: str | None = None
+        self.frames: dict[WorkUnit, FrameRecord] = {
+            unit: FrameRecord(unit) for unit in job.work_units()
         }
-        self._pending: deque[int] = deque(job.frame_indices())
+        self._pending: deque[WorkUnit] = deque(job.work_units())
         self._finished_count = 0
         # Per-job exactly-once ledger, updated by WorkerHandle at the same
         # points as the global ``master_*_results_total`` counters so the
-        # PR-4 chaos invariant (ok - duplicates == frames_total) can be
+        # PR-4 chaos invariant (ok - duplicates == units_total) can be
         # audited PER JOB when several share the worker pool.
         self.ledger: dict[str, int] = {
             "ok_results": 0,
@@ -75,15 +101,23 @@ class ClusterManagerState:
             "late_results": 0,
             "stale_results": 0,
         }
+        # Per-frame assembly ledger (tiled jobs): frame -> the set of tile
+        # indices whose units reached FINISHED. A frame is assembly-ready
+        # when the set reaches ``tiles_per_frame`` — each tile lands in it
+        # exactly once because ``mark_frame_as_finished`` transitions each
+        # unit to FINISHED exactly once (duplicates are absorbed upstream).
+        self._tiles_per_frame = job.tiles_per_frame()
+        self._assembly: dict[int, set[int]] = {}
+        self.frames_assembled = 0
 
     # -- queries -----------------------------------------------------------
 
-    def next_pending_frame(self) -> int | None:
-        """Peek the next pending frame index (O(1))."""
+    def next_pending_unit(self) -> WorkUnit | None:
+        """Peek the next pending work unit (O(1))."""
         while self._pending:
-            index = self._pending[0]
-            if self.frames[index].status is FrameStatus.PENDING:
-                return index
+            unit = self._pending[0]
+            if self.frames[unit].status is FrameStatus.PENDING:
+                return unit
             self._pending.popleft()  # stale entry
         return None
 
@@ -95,11 +129,11 @@ class ClusterManagerState:
 
     def pending_count(self) -> int:
         return sum(
-            1 for i in self._pending if self.frames[i].status is FrameStatus.PENDING
+            1 for u in self._pending if self.frames[u].status is FrameStatus.PENDING
         )
 
     def in_flight_count(self) -> int:
-        """Frames currently queued-on or rendering-on some worker — the
+        """Units currently queued-on or rendering-on some worker — the
         quantity the fair-share scheduler meters per job."""
         return sum(
             1
@@ -108,63 +142,126 @@ class ClusterManagerState:
             in (FrameStatus.QUEUED_ON_WORKER, FrameStatus.RENDERING_ON_WORKER)
         )
 
-    def pending_frames(self, limit: int | None = None) -> list[int]:
+    def pending_units(self, limit: int | None = None) -> list[WorkUnit]:
         out = []
-        for index in self._pending:
-            if self.frames[index].status is FrameStatus.PENDING:
-                out.append(index)
+        for unit in self._pending:
+            if self.frames[unit].status is FrameStatus.PENDING:
+                out.append(unit)
                 if limit is not None and len(out) >= limit:
                     break
         return out
 
+    # -- assembly ledger (tiled jobs) --------------------------------------
+
+    def tiles_landed(self, frame_index: int) -> int:
+        """Tiles of ``frame_index`` that have reached FINISHED."""
+        if self._tiles_per_frame == 1:
+            # One unit per frame — but its KEY is tile 0 for a (valid)
+            # 1x1 tiled job and tile None for an untiled one.
+            unit = WorkUnit(
+                frame_index, None if self.job.tile_grid is None else 0
+            )
+            record = self.frames.get(unit)
+            return int(
+                record is not None and record.status is FrameStatus.FINISHED
+            )
+        return len(self._assembly.get(frame_index, ()))
+
+    def partially_assembled_frames(self) -> list[int]:
+        """Frames with SOME but not all tiles landed — must be empty after
+        any completed run (the no-ghost-frame chaos invariant; a cancelled
+        job may legitimately hold some)."""
+        return sorted(
+            frame
+            for frame, tiles in self._assembly.items()
+            if 0 < len(tiles) < self._tiles_per_frame
+        )
+
+    def assembly_view(self) -> dict:
+        """The ``assembly`` section of the per-job live view."""
+        return {
+            "tiles_per_frame": self._tiles_per_frame,
+            "frames_assembled": self.frames_assembled,
+            "frames_partial": len(self.partially_assembled_frames()),
+        }
+
     # -- transitions -------------------------------------------------------
+    #
+    # Every transition accepts a bare int as a WHOLE-FRAME unit (the
+    # pre-tiling call shape): normalization goes through one helper so
+    # frame-keyed callers and tile-keyed callers cannot drift.
+
+    @staticmethod
+    def _as_unit(unit: "WorkUnit | int") -> WorkUnit:
+        return WorkUnit(unit) if isinstance(unit, int) else unit
 
     def mark_frame_as_queued(
         self,
-        frame_index: int,
+        unit: "WorkUnit | int",
         worker_id: int,
         queued_at: float,
         *,
         stolen_from: int | None = None,
         stolen_at: float | None = None,
     ) -> None:
-        record = self.frames[frame_index]
+        unit = self._as_unit(unit)
+        record = self.frames[unit]
         if record.status is FrameStatus.FINISHED:
-            raise ValueError(f"BUG: frame {frame_index} is already finished.")
+            raise ValueError(f"BUG: unit {unit.label} is already finished.")
         record.status = FrameStatus.QUEUED_ON_WORKER
         record.worker_id = worker_id
         record.queued_at = queued_at
         if stolen_from is not None:
             record.stolen_from = stolen_from
             record.stolen_at = stolen_at
-        if self._pending and self._pending[0] == frame_index:
+        if self._pending and self._pending[0] == unit:
             self._pending.popleft()
 
-    def mark_frame_as_rendering(self, frame_index: int, worker_id: int) -> None:
-        record = self.frames[frame_index]
+    def mark_frame_as_rendering(
+        self, unit: "WorkUnit | int", worker_id: int
+    ) -> None:
+        unit = self._as_unit(unit)
+        record = self.frames[unit]
         if record.status is FrameStatus.FINISHED:
             return  # late event after a race; harmless
         record.status = FrameStatus.RENDERING_ON_WORKER
         record.worker_id = worker_id
 
-    def mark_frame_as_finished(self, frame_index: int) -> None:
-        record = self.frames[frame_index]
+    def mark_frame_as_finished(self, unit: "WorkUnit | int") -> bool:
+        """Transition a unit to FINISHED; returns True when this call
+        completed its whole FRAME (every tile landed) — the exactly-once
+        assembly trigger. Idempotent: repeated calls return False.
+        """
+        unit = self._as_unit(unit)
+        record = self.frames[unit]
         if record.status is FrameStatus.FINISHED:
-            return
+            return False
         record.status = FrameStatus.FINISHED
         self._finished_count += 1
+        if self._tiles_per_frame == 1:
+            return True
+        landed = self._assembly.setdefault(unit.frame_index, set())
+        landed.add(unit.tile if unit.tile is not None else 0)
+        return len(landed) >= self._tiles_per_frame
 
-    def return_frame_to_pending(self, frame_index: int) -> None:
-        """Frame comes back to the pool (steal succeeded, render errored,
+    def note_frame_assembled(self, frame_index: int) -> None:
+        self.frames_assembled += 1
+        # Fully-landed frames leave the partial map so the ghost-frame
+        # audit is O(frames in flight), not O(job).
+        self._assembly.pop(frame_index, None)
+
+    def return_frame_to_pending(self, unit: "WorkUnit | int") -> None:
+        """Unit comes back to the pool (steal succeeded, render errored,
         or its worker died). Unlike the reference — where a dead worker's
         frames stay QueuedOnWorker forever (SURVEY.md §5.3) — this makes
         eviction recoverable. Idempotent: under fault races (an eviction
-        and a failed dispatch both returning the same frame) the second
+        and a failed dispatch both returning the same unit) the second
         call must not add a second pending entry."""
-        record = self.frames[frame_index]
+        unit = self._as_unit(unit)
+        record = self.frames[unit]
         if record.status in (FrameStatus.FINISHED, FrameStatus.PENDING):
             return
         record.status = FrameStatus.PENDING
         record.worker_id = None
         record.queued_at = None
-        self._pending.append(frame_index)
+        self._pending.append(unit)

@@ -30,6 +30,7 @@ from tpu_render_cluster.jobs.models import (
     BlenderJob,
     DynamicStrategyOptions,
 )
+from tpu_render_cluster.jobs.tiles import WorkUnit
 from tpu_render_cluster.master.queue_mirror import FrameOnWorker
 from tpu_render_cluster.master.state import ClusterManagerState, FrameStatus
 from tpu_render_cluster.protocol import messages as pm
@@ -114,6 +115,15 @@ def find_busiest_worker_and_frame_to_steal(
 # Strategy loops
 
 
+def check_job_failed(state: ClusterManagerState) -> None:
+    """Raise when the job crossed its unit-error budget — called once
+    per tick by every strategy loop so a deterministically-failing unit
+    ends the job with a clear error instead of an endless redispatch
+    spin (the scheduler's loop cancels the job instead of raising)."""
+    if state.failed_reason is not None:
+        raise RuntimeError(f"Job failed: {state.failed_reason}")
+
+
 async def dispatch_one_pending(
     worker: "WorkerHandle",
     job: BlenderJob,
@@ -129,19 +139,19 @@ async def dispatch_one_pending(
     exactly one definition. ``job_id`` is the scheduler's submission id,
     piggybacked on the wire (None on the single-job path).
     """
-    frame_index = state.next_pending_frame()
-    if frame_index is None:
+    unit = state.next_pending_unit()
+    if unit is None:
         return False
     # Claim immediately so concurrent assignment in the same tick can't
-    # double-queue the frame, then confirm via RPC.
-    state.mark_frame_as_queued(frame_index, worker.worker_id, time.time())
+    # double-queue the unit, then confirm via RPC.
+    state.mark_frame_as_queued(unit, worker.worker_id, time.time())
     try:
-        await worker.queue_frame(job, frame_index, job_id=job_id)
+        await worker.queue_frame(job, unit, job_id=job_id)
     except Exception as e:  # noqa: BLE001 - worker failure mid-RPC
         logger.warning(
-            "Failed to queue frame %d on %08x: %s", frame_index, worker.worker_id, e
+            "Failed to queue unit %s on %08x: %s", unit.label, worker.worker_id, e
         )
-        state.return_frame_to_pending(frame_index)
+        state.return_frame_to_pending(unit)
         return False
     return True
 
@@ -162,6 +172,7 @@ async def naive_fine_strategy(
     while not cancellation.is_cancelled():
         if state.all_frames_finished():
             return
+        check_job_failed(state)
         for worker in workers_fn():
             if worker.is_dead or not worker.has_empty_queue():
                 continue
@@ -180,6 +191,7 @@ async def eager_naive_coarse_strategy(
     while not cancellation.is_cancelled():
         if state.all_frames_finished():
             return
+        check_job_failed(state)
         for worker in workers_fn():
             if worker.is_dead:
                 continue
@@ -201,6 +213,7 @@ async def dynamic_strategy(
     while not cancellation.is_cancelled():
         if state.all_frames_finished():
             return
+        check_job_failed(state)
         workers = [w for w in workers_fn() if not w.is_dead]
         workers.sort(key=lambda w: len(w.queue))
         for worker in workers:
@@ -213,7 +226,7 @@ async def dynamic_strategy(
             if found is None:
                 break  # nobody has anything stealable; next tick
             victim, frame = found
-            await steal_frame(job, state, worker, victim, frame.frame_index)
+            await steal_frame(job, state, worker, victim, frame.unit)
         await asyncio.sleep(DYNAMIC_TICK)
 
 
@@ -222,16 +235,18 @@ async def steal_frame(
     state: ClusterManagerState,
     thief: "WorkerHandle",
     victim: "WorkerHandle",
-    frame_index: int,
+    unit: WorkUnit | int,
 ) -> bool:
     """Unqueue from victim, requeue on thief with provenance.
 
     Tolerates the distributed races exactly like the reference
     (strategies.rs:340-396): if the victim already started rendering or
-    finished the frame, the steal silently aborts.
+    finished the unit, the steal silently aborts.
     """
+    if isinstance(unit, int):
+        unit = WorkUnit(unit)
     try:
-        result = await victim.unqueue_frame(job.job_name, frame_index)
+        result = await victim.unqueue_frame(job.job_name, unit)
     except Exception as e:  # noqa: BLE001
         logger.warning("Steal unqueue RPC failed on %08x: %s", victim.worker_id, e)
         return False
@@ -253,7 +268,7 @@ async def steal_frame(
     #   unqueue above removed it from the mirror eviction sweeps): requeue
     #   it HERE or it would be lost forever;
     # - victim alive and still owning the record: proceed with the steal.
-    record = state.frames.get(frame_index)
+    record = state.frames.get(unit)
     owned_by_victim = (
         record is not None
         and record.status is FrameStatus.QUEUED_ON_WORKER
@@ -261,23 +276,23 @@ async def steal_frame(
     )
     if victim.is_dead or not owned_by_victim:
         if owned_by_victim:
-            state.return_frame_to_pending(frame_index)
+            state.return_frame_to_pending(unit)
         logger.warning(
-            "Steal of frame %d aborted: victim %08x %s mid-steal.",
-            frame_index,
+            "Steal of unit %s aborted: victim %08x %s mid-steal.",
+            unit.label,
             victim.worker_id,
             "died" if victim.is_dead else "lost the assignment",
         )
         return False
     victim.frames_stolen_count += 1
     try:
-        await thief.queue_frame(job, frame_index, stolen_from=victim.worker_id)
+        await thief.queue_frame(job, unit, stolen_from=victim.worker_id)
     except Exception as e:  # noqa: BLE001
         logger.warning("Steal requeue failed on %08x: %s", thief.worker_id, e)
-        state.return_frame_to_pending(frame_index)
+        state.return_frame_to_pending(unit)
         return False
     logger.debug(
-        "Stole frame %d: %08x -> %08x", frame_index, victim.worker_id, thief.worker_id
+        "Stole unit %s: %08x -> %08x", unit.label, victim.worker_id, thief.worker_id
     )
     return True
 
@@ -286,7 +301,7 @@ async def preempt_frame(
     job: BlenderJob,
     state: ClusterManagerState,
     victim: "WorkerHandle",
-    frame_index: int,
+    unit: WorkUnit | int,
 ) -> bool:
     """Unqueue a not-yet-rendering frame back to its job's pending pool.
 
@@ -296,8 +311,10 @@ async def preempt_frame(
     the frame returns to ITS OWN job's pending pool instead of moving to a
     thief, freeing the worker slot for an under-share job's next dispatch.
     """
+    if isinstance(unit, int):
+        unit = WorkUnit(unit)
     try:
-        result = await victim.unqueue_frame(job.job_name, frame_index)
+        result = await victim.unqueue_frame(job.job_name, unit)
     except Exception as e:  # noqa: BLE001
         logger.warning(
             "Preempt unqueue RPC failed on %08x: %s", victim.worker_id, e
@@ -306,10 +323,10 @@ async def preempt_frame(
     if result != pm.FRAME_QUEUE_REMOVE_RESULT_REMOVED:
         return False
     # Same await-point races as steal_frame: the victim may have died (or
-    # the assignment moved) while the RPC was in flight. Requeue the frame
+    # the assignment moved) while the RPC was in flight. Requeue the unit
     # here exactly when this worker still owns its live assignment —
     # eviction already requeued it otherwise.
-    record = state.frames.get(frame_index)
+    record = state.frames.get(unit)
     owned_by_victim = (
         record is not None
         and record.status is FrameStatus.QUEUED_ON_WORKER
@@ -317,13 +334,13 @@ async def preempt_frame(
     )
     if not owned_by_victim:
         logger.warning(
-            "Preemption of frame %d aborted: victim %08x lost the "
+            "Preemption of unit %s aborted: victim %08x lost the "
             "assignment mid-RPC.",
-            frame_index,
+            unit.label,
             victim.worker_id,
         )
         return False
-    state.return_frame_to_pending(frame_index)
+    state.return_frame_to_pending(unit)
     return True
 
 

@@ -35,6 +35,7 @@ from tpu_render_cluster.jobs.models import (
 )
 from tpu_render_cluster.master.state import ClusterManagerState
 from tpu_render_cluster.master.strategies import (
+    check_job_failed,
     find_busiest_worker_and_frame_to_steal,
     steal_frame,
 )
@@ -249,6 +250,7 @@ async def tpu_batch_strategy(
     while not cancellation.is_cancelled():
         if state.all_frames_finished():
             return
+        check_job_failed(state)
         workers = [w for w in workers_fn() if not w.is_dead]
         if not workers:
             await asyncio.sleep(TPU_BATCH_TICK)
@@ -265,8 +267,13 @@ async def tpu_batch_strategy(
         # Collect slots from queue deficits, with per-worker targets scaled
         # to each worker's predicted rate (uniform targets until history
         # arrives — the cold-start case falls back to eager-coarse shape).
-        upcoming = state.pending_frames(limit=2 * RATE_TARGET_CAP)
-        complexity_memo = cost_model.frame_complexity.predict_many(upcoming)
+        # Units are (frame, tile) under a tile grid; the complexity model
+        # stays keyed by FRAME index (tiles of one frame share the scene,
+        # so they share the frame's complexity factor).
+        upcoming = state.pending_units(limit=2 * RATE_TARGET_CAP)
+        complexity_memo = cost_model.frame_complexity.predict_many(
+            [u.frame_index for u in upcoming]
+        )
         batch_mean_complexity = (
             float(np.mean(list(complexity_memo.values()))) if upcoming else 1.0
         )
@@ -324,15 +331,15 @@ async def tpu_batch_strategy(
         del slots[slot_cap:]
 
         if slots:
-            frames = state.pending_frames(limit=len(slots))
-            if frames:
+            units = state.pending_units(limit=len(slots))
+            if units:
                 complexity = {
-                    f: complexity_memo.get(f)
-                    or cost_model.frame_complexity.predict(f)
-                    for f in frames
+                    u: complexity_memo.get(u.frame_index)
+                    or cost_model.frame_complexity.predict(u.frame_index)
+                    for u in units
                 }
                 cost = build_cost_matrix(
-                    frames,
+                    units,
                     slots,
                     cost_model.worker_speed,
                     frame_complexity=complexity,
@@ -377,20 +384,20 @@ async def tpu_batch_strategy(
                 # concurrently (the reference queues serially in the tick
                 # loop; batching the RPCs keeps tick latency flat as the
                 # cluster grows).
-                async def assign(frame_index: int, worker: "WorkerHandle") -> None:
+                async def assign(unit, worker: "WorkerHandle") -> None:
                     try:
-                        await worker.queue_frame(job, frame_index)
+                        await worker.queue_frame(job, unit)
                     except Exception as e:  # noqa: BLE001
                         logger.warning(
-                            "tpu-batch: failed to queue frame %d on %08x: %s",
-                            frame_index,
+                            "tpu-batch: failed to queue unit %s on %08x: %s",
+                            unit.label,
                             worker.worker_id,
                             e,
                         )
-                        state.return_frame_to_pending(frame_index)
+                        state.return_frame_to_pending(unit)
 
                 tasks = []
-                for i, frame_index in enumerate(frames):
+                for i, unit in enumerate(units):
                     worker, _position = slots[int(assignment[i])]
                     others_rate = cluster_rate - 1.0 / max(
                         1e-6, speeds[worker.worker_id]
@@ -398,16 +405,16 @@ async def tpu_batch_strategy(
                     # Everything the rest of the cluster still has to chew
                     # through: the pending pool plus their own queues.
                     rest_units = max(
-                        0.0, pool_units - complexity[frame_index]
+                        0.0, pool_units - complexity[unit]
                     ) + (total_queued_units - queued_units[worker.worker_id])
                     horizon = makespan_horizon(
-                        rest_units, others_rate, fastest_speed, complexity[frame_index]
+                        rest_units, others_rate, fastest_speed, complexity[unit]
                     )
                     if cost[i, int(assignment[i])] > horizon:
                         continue  # leave pending; a better slot will open
-                    state.mark_frame_as_queued(frame_index, worker.worker_id, time.time())
-                    tasks.append(assign(frame_index, worker))
-                if not tasks and frames:
+                    state.mark_frame_as_queued(unit, worker.worker_id, time.time())
+                    tasks.append(assign(unit, worker))
+                if not tasks and units:
                     # Forced progress: the gate's invariant is that the
                     # fastest worker's front slot always passes, but the
                     # auction may return an epsilon-suboptimal matching
@@ -437,13 +444,11 @@ async def tpu_batch_strategy(
                             fastest is fastest_overall
                             or time.time() - starved_since > 1.0
                         ):
-                            frame_index = min(
-                                frames, key=lambda f: complexity[f]
-                            )
+                            unit = min(units, key=lambda u: complexity[u])
                             state.mark_frame_as_queued(
-                                frame_index, fastest.worker_id, time.time()
+                                unit, fastest.worker_id, time.time()
                             )
-                            tasks.append(assign(frame_index, fastest))
+                            tasks.append(assign(unit, fastest))
                 if tasks:
                     # The streak is CONSECUTIVE fully-gated ticks only; any
                     # tick that queues work (and, below, any tick with
@@ -467,7 +472,7 @@ async def tpu_batch_strategy(
                 if found is None:
                     break
                 victim, frame = found
-                await steal_frame(job, state, thief, victim, frame.frame_index)
+                await steal_frame(job, state, thief, victim, frame.unit)
 
         if not slots:
             starved_since = None  # no slots this tick: not a gated streak

@@ -35,6 +35,7 @@ import time
 from typing import Awaitable, Callable
 
 from tpu_render_cluster.jobs.models import BlenderJob
+from tpu_render_cluster.jobs.tiles import WorkUnit
 from tpu_render_cluster.master.queue_mirror import FrameOnWorker, WorkerQueueMirror
 from tpu_render_cluster.master.state import ClusterManagerState, FrameStatus
 from tpu_render_cluster.obs import ClockOffsetEstimator, MetricsRegistry, Tracer
@@ -67,6 +68,16 @@ def rpc_deadline_seconds() -> float:
     return env_float("TRC_RPC_DEADLINE_SECONDS", DEFAULT_WAIT_TIMEOUT)
 
 
+def unit_error_limit() -> int:
+    """Errored results per unit before the job fails
+    (``TRC_MAX_UNIT_ERRORS``). Transient render errors requeue and
+    succeed elsewhere well inside this budget; a unit that keeps
+    erroring deterministically (e.g. a tiled unit on a backend that
+    cannot render sub-frame regions, cluster-wide) must fail the job
+    loudly instead of redispatching in a hot loop forever."""
+    return env_int("TRC_MAX_UNIT_ERRORS", 8)
+
+
 def heartbeat_pong_retries() -> int:
     """Extra pings after a missed pong before eviction
     (``TRC_HEARTBEAT_PONG_RETRIES``). A pong can be lost to a transient
@@ -91,6 +102,8 @@ class WorkerHandle:
         span_tracer: Tracer | None = None,
         dispatch_delay_fn: Callable[[int], float] | None = None,
         state_resolver: Callable[[str | None], ClusterManagerState | None]
+        | None = None,
+        on_frame_complete: Callable[[ClusterManagerState, int], None]
         | None = None,
     ) -> None:
         self.worker_id = worker_id
@@ -123,9 +136,14 @@ class WorkerHandle:
         # Chrome trace events the worker piggybacked on its job-finished
         # response ({"process_name", "events"}), for the cluster timeline.
         self.collected_span_events: dict | None = None
-        # Observed per-frame render durations (for scheduler cost models),
-        # keyed (job_name, frame_index) — frame indices alias across jobs.
-        self._rendering_started_at: dict[tuple[str, int], float] = {}
+        # Fires when an ok result completes a whole FRAME (every tile
+        # landed): the master's assembly hook. Sync by contract — the
+        # implementation schedules its own task so event handling never
+        # blocks on image stitching.
+        self._on_frame_complete = on_frame_complete
+        # Observed per-unit render durations (for scheduler cost models),
+        # keyed (job_name, unit) — frame indices alias across jobs.
+        self._rendering_started_at: dict[tuple[str, WorkUnit], float] = {}
         self._completion_observations: list[tuple[int, float]] = []
         self._on_dead = on_dead
         self.logger = WorkerLogger(
@@ -195,7 +213,7 @@ class WorkerHandle:
         for frame in self.queue.all_frames():
             self._complete_frame_flow(
                 "frame evicted",
-                frame.frame_index,
+                frame.unit,
                 frame.trace,
                 start_wall=now,
                 duration=0.0,
@@ -256,7 +274,7 @@ class WorkerHandle:
     def _complete_frame_flow(
         self,
         name: str,
-        frame_index: int,
+        unit: WorkUnit,
         trace: pm.TraceContext | None,
         *,
         start_wall: float,
@@ -268,7 +286,9 @@ class WorkerHandle:
         when the assignment's trace context is known."""
         if self.span_tracer is None:
             return
-        args = {"frame": frame_index, **(extra_args or {})}
+        args = {"frame": unit.frame_index, **(extra_args or {})}
+        if unit.tile is not None:
+            args["tile"] = unit.tile
         track = f"worker-{self._worker_label()}"
         if trace is not None:
             args["flow"] = trace.flow_id
@@ -281,13 +301,16 @@ class WorkerHandle:
             args=args,
         )
         if trace is not None:
+            flow_args = {"frame": unit.frame_index}
+            if unit.tile is not None:
+                flow_args["tile"] = unit.tile
             self.span_tracer.flow_end(
                 "frame",
                 id=trace.flow_id,
                 ts=start_wall + duration / 2.0,
                 cat="frame",
                 track=track,
-                args={"frame": frame_index},
+                args=flow_args,
             )
 
     # -- scheduling RPCs ----------------------------------------------------
@@ -295,18 +318,23 @@ class WorkerHandle:
     async def queue_frame(
         self,
         job: BlenderJob,
-        frame_index: int,
+        unit: WorkUnit | int,
         *,
         stolen_from: int | None = None,
         job_id: str | None = None,
     ) -> None:
-        """RPC a frame onto this worker's queue; sync mirror + global state.
+        """RPC a work unit onto this worker's queue; sync mirror + state.
 
         Reference: master/src/connection/mod.rs:139-168. ``job_id`` is the
         multi-job scheduler's submission id, piggybacked on the wire and
-        echoed by (Python) workers; single-job dispatch leaves it None and
-        the request encodes byte-identically to before.
+        echoed by (Python) workers; single-job dispatch leaves it None.
+        ``unit.tile`` rides the same optional-key idiom — whole-frame
+        dispatch encodes byte-identically to before (a bare int is
+        accepted as a whole-frame unit for legacy callers/tests).
         """
+        if isinstance(unit, int):
+            unit = WorkUnit(unit)
+        frame_index = unit.frame_index
         if self.is_dead:
             raise RuntimeError("Worker is dead; refusing dispatch.")
         state = self._state_for(job.job_name)
@@ -323,7 +351,7 @@ class WorkerHandle:
         # frame starts a new causal chain with its own Perfetto flow.
         trace = pm.TraceContext.new(state.trace_id)
         request = pm.MasterFrameQueueAddRequest.new(
-            job, frame_index, trace=trace, job_id=job_id
+            job, frame_index, trace=trace, job_id=job_id, tile=unit.tile
         )
         rpc_started = time.perf_counter()
         rpc_started_wall = time.time()
@@ -349,17 +377,17 @@ class WorkerHandle:
         # adopt (and then wedge on) the old submission's dispatch.
         if self._state_for(job.job_name) is not state:
             raise RuntimeError(
-                f"Assignment of frame {frame_index} was superseded "
+                f"Assignment of unit {unit.label} was superseded "
                 f"mid-dispatch (job {job.job_name!r} was cancelled/replaced)."
             )
-        record = state.frames.get(frame_index)
+        record = state.frames.get(unit)
         if (
             self.is_dead
             or record is None
             or record.status is FrameStatus.FINISHED
         ):
             raise RuntimeError(
-                f"Assignment of frame {frame_index} was superseded "
+                f"Assignment of unit {unit.label} was superseded "
                 f"mid-dispatch ({'worker died' if self.is_dead else 'frame finished or job gone'})."
             )
         rpc_seconds = time.perf_counter() - rpc_started
@@ -374,6 +402,8 @@ class WorkerHandle:
             # Constant span name (frame index in args) so viewers and the
             # analysis roll-up aggregate all assignments into one stat.
             args = {"frame": frame_index, "flow": trace.flow_id}
+            if unit.tile is not None:
+                args["tile"] = unit.tile
             if stolen_from is not None:
                 args["stolen_from"] = stolen_from
             track = f"worker-{self._worker_label()}"
@@ -388,13 +418,16 @@ class WorkerHandle:
             # Flow source, mid-span so it binds inside the assign slice;
             # the worker's queue_wait/read/render/write spans route it and
             # the result-received span terminates it.
+            flow_args = {"frame": frame_index}
+            if unit.tile is not None:
+                flow_args["tile"] = unit.tile
             self.span_tracer.flow_start(
                 "frame",
                 id=trace.flow_id,
                 ts=rpc_started_wall + rpc_seconds / 2.0,
                 cat="frame",
                 track=track,
-                args={"frame": frame_index},
+                args=flow_args,
             )
         now = time.time()
         self.queue.add(
@@ -405,25 +438,31 @@ class WorkerHandle:
                 trace=trace,
                 job_name=job.job_name,
                 job_id=job_id,
+                tile=unit.tile,
             )
         )
         self._update_queue_depth_gauge()
         state.mark_frame_as_queued(
-            frame_index,
+            unit,
             self.worker_id,
             now,
             stolen_from=stolen_from,
             stolen_at=now if stolen_from is not None else None,
         )
 
-    async def unqueue_frame(self, job_name: str, frame_index: int) -> str:
-        """RPC-remove a frame (the steal primitive); returns the result enum.
+    async def unqueue_frame(self, job_name: str, unit: WorkUnit | int) -> str:
+        """RPC-remove a work unit (the steal primitive); returns the result
+        enum.
 
         Tolerates the remove-vs-render races (``already-rendering`` /
         ``already-finished`` — reference: strategies.rs:347-373 leaves those
         to the caller).
         """
-        request = pm.MasterFrameQueueRemoveRequest.new(job_name, frame_index)
+        if isinstance(unit, int):
+            unit = WorkUnit(unit)
+        request = pm.MasterFrameQueueRemoveRequest.new(
+            job_name, unit.frame_index, tile=unit.tile
+        )
         rpc_started_wall = time.time()
         rpc_started = time.perf_counter()
         response = await request_response(
@@ -434,7 +473,7 @@ class WorkerHandle:
             timeout=rpc_deadline_seconds(),
         )
         if response.result == pm.FRAME_QUEUE_REMOVE_RESULT_REMOVED:
-            removed = self.queue.remove(frame_index, job_name)
+            removed = self.queue.remove(unit.frame_index, job_name, unit.tile)
             self._update_queue_depth_gauge()
             # A successful steal ends this assignment's causal chain (the
             # thief's queue_frame opens a fresh one) — terminate the flow
@@ -442,7 +481,7 @@ class WorkerHandle:
             if self.span_tracer is not None:
                 self._complete_frame_flow(
                     "frame stolen",
-                    frame_index,
+                    unit,
                     removed.trace if removed is not None else None,
                     start_wall=rpc_started_wall,
                     duration=time.perf_counter() - rpc_started,
@@ -452,6 +491,38 @@ class WorkerHandle:
 
     def has_empty_queue(self) -> bool:
         return len(self.queue) == 0
+
+    def sweep_finished_units(self, state_for) -> int:
+        """Drop mirror entries whose unit already FINISHED, closing their
+        Perfetto flows. These are ghost copies left by accepted LATE
+        results: the evicted original's result finished the unit while
+        the re-dispatched twin still sat queued here — if the job ends
+        before the twin renders, nothing else would ever pop the entry
+        (or terminate its flow), and the mirror would keep offering a
+        finished unit to steal passes. Called at job finalization; racing
+        events for swept entries are absorbed by the dedup seam as usual.
+        """
+        removed = 0
+        now = time.time()
+        for frame in self.queue.all_frames():
+            state = state_for(frame.job_name)
+            if state is None:
+                continue
+            record = state.frames.get(frame.unit)
+            if record is not None and record.status is FrameStatus.FINISHED:
+                self.queue.remove(frame.frame_index, frame.job_name, frame.tile)
+                self._complete_frame_flow(
+                    "frame superseded",
+                    frame.unit,
+                    frame.trace,
+                    start_wall=now,
+                    duration=0.0,
+                    extra_args={"reason": "finished elsewhere"},
+                )
+                removed += 1
+        if removed:
+            self._update_queue_depth_gauge()
+        return removed
 
     def drain_completion_observations(self) -> list[tuple[int, float]]:
         """Take (frame_index, seconds) samples observed since the last call."""
@@ -520,17 +591,17 @@ class WorkerHandle:
         )
 
     def _mirror_entry_for_event(
-        self, frame_index: int, job_name: str, event_job_id: str | None
+        self, unit: WorkUnit, job_name: str, event_job_id: str | None
     ):
         """The mirror entry an incoming event may touch, or None.
 
         Generation guard: after a cancel + same-name resubmit, the mirror
-        key (job_name, frame_index) can be occupied by the NEW
+        key (job_name, frame_index, tile) can be occupied by the NEW
         submission's dispatch while a late event from the OLD one is
         still in flight — only an entry whose job_id matches (or where
         either side is anonymous) belongs to this event.
         """
-        entry = self.queue.get(frame_index, job_name)
+        entry = self.queue.get(unit.frame_index, job_name, unit.tile)
         if (
             entry is not None
             and entry.job_id is not None
@@ -543,20 +614,19 @@ class WorkerHandle:
     def _apply_rendering_event(
         self, event: pm.WorkerFrameQueueItemRenderingEvent
     ) -> None:
+        unit = WorkUnit(event.frame_index, event.tile)
         state = self._state_for(event.job_name)
-        # Keep the mirror honest even for a defunct job: a frame that
+        # Keep the mirror honest even for a defunct job: a unit that
         # started rendering must stop looking like a steal candidate —
         # but never touch a same-keyed entry of a NEWER generation.
         if (
-            self._mirror_entry_for_event(
-                event.frame_index, event.job_name, event.job_id
-            )
+            self._mirror_entry_for_event(unit, event.job_name, event.job_id)
             is not None
         ):
-            self.queue.set_rendering(event.frame_index, event.job_name)
+            self.queue.set_rendering(unit.frame_index, event.job_name, unit.tile)
         if self._job_generation_mismatch(state, event.job_id):
             state = None
-        record = state.frames.get(event.frame_index) if state is not None else None
+        record = state.frames.get(unit) if state is not None else None
         if state is None or not self._is_current_assignment(record):
             # E.g. the queue-add ack timed out (frame requeued elsewhere)
             # but the add had landed, and the superseded copy now renders;
@@ -570,22 +640,23 @@ class WorkerHandle:
                 ledger_key="stale_results",
             )
             self.logger.debug(
-                "Stale rendering event for frame %d ignored.", event.frame_index
+                "Stale rendering event for unit %s ignored.", unit.label
             )
             return
-        self.logger.debug("Frame %d started rendering.", event.frame_index)
-        self._rendering_started_at[(event.job_name, event.frame_index)] = time.time()
-        state.mark_frame_as_rendering(event.frame_index, self.worker_id)
+        self.logger.debug("Unit %s started rendering.", unit.label)
+        self._rendering_started_at[(event.job_name, unit)] = time.time()
+        state.mark_frame_as_rendering(unit, self.worker_id)
 
     def _apply_finished_event(
         self, event: pm.WorkerFrameQueueItemFinishedEvent
     ) -> None:
         received_wall = time.time()
         received_mono = time.perf_counter()
+        unit = WorkUnit(event.frame_index, event.tile)
         state = self._state_for(event.job_name)
         if self._job_generation_mismatch(state, event.job_id):
             state = None
-        record = state.frames.get(event.frame_index) if state is not None else None
+        record = state.frames.get(unit) if state is not None else None
         # Popped unconditionally — the duplicate/late/stale returns below
         # must not leave a ghost in-flight entry on this handle — EXCEPT
         # when the same-keyed entry belongs to a newer generation of a
@@ -593,15 +664,13 @@ class WorkerHandle:
         # assignment, not this event's.
         frame_on_worker = None
         if (
-            self._mirror_entry_for_event(
-                event.frame_index, event.job_name, event.job_id
-            )
+            self._mirror_entry_for_event(unit, event.job_name, event.job_id)
             is not None
         ):
-            frame_on_worker = self.queue.remove(event.frame_index, event.job_name)
-        started = self._rendering_started_at.pop(
-            (event.job_name, event.frame_index), None
-        )
+            frame_on_worker = self.queue.remove(
+                unit.frame_index, event.job_name, unit.tile
+            )
+        started = self._rendering_started_at.pop((event.job_name, unit), None)
         self._update_queue_depth_gauge()
         if self.metrics is not None:
             self.metrics.counter(
@@ -624,15 +693,15 @@ class WorkerHandle:
             )
             self._complete_frame_flow(
                 "frame result",
-                event.frame_index,
+                unit,
                 frame_on_worker.trace if frame_on_worker is not None else None,
                 start_wall=received_wall,
                 duration=time.perf_counter() - received_mono,
                 extra_args={"result": event.result, "job_gone": True},
             )
             self.logger.debug(
-                "Result for frame %d of defunct job %r ignored.",
-                event.frame_index,
+                "Result for unit %s of defunct job %r ignored.",
+                unit.label,
                 event.job_name,
             )
             return
@@ -651,7 +720,7 @@ class WorkerHandle:
             trace = frame_on_worker.trace
         self._complete_frame_flow(
             "frame result",
-            event.frame_index,
+            unit,
             trace if current else None,
             start_wall=received_wall,
             duration=time.perf_counter() - received_mono,
@@ -672,7 +741,7 @@ class WorkerHandle:
                     ledger_key="duplicate_results",
                 )
                 self.logger.warning(
-                    "Duplicate result for frame %d ignored.", event.frame_index
+                    "Duplicate result for unit %s ignored.", unit.label
                 )
                 return
             if not current:
@@ -687,27 +756,27 @@ class WorkerHandle:
                     ledger_key="late_results",
                 )
                 self.logger.warning(
-                    "Late result for frame %d accepted from a superseded "
+                    "Late result for unit %s accepted from a superseded "
                     "assignment.",
-                    event.frame_index,
+                    unit.label,
                 )
-                state.mark_frame_as_finished(event.frame_index)
+                self._finish_unit(state, unit)
                 return
-            self.logger.debug("Frame %d finished.", event.frame_index)
+            self.logger.debug("Unit %s finished.", unit.label)
             if started is None and frame_on_worker is not None:
                 started = frame_on_worker.queued_at
             if started is not None:
                 self._completion_observations.append(
                     (event.frame_index, max(1e-4, time.time() - started))
                 )
-            state.mark_frame_as_finished(event.frame_index)
+            self._finish_unit(state, unit)
         else:
             state.ledger["errored_results"] += 1
             if not current:
-                # An errored result for a frame this worker no longer owns
+                # An errored result for a unit this worker no longer owns
                 # must NOT requeue it: the live assignment is
                 # authoritative, and a second pending entry would render
-                # the frame twice.
+                # the unit twice.
                 self._count_anomaly(
                     "master_stale_results_total",
                     "Worker events ignored because the frame's live assignment "
@@ -717,19 +786,45 @@ class WorkerHandle:
                     ledger_key="stale_results",
                 )
                 self.logger.warning(
-                    "Stale errored result for frame %d ignored.",
-                    event.frame_index,
+                    "Stale errored result for unit %s ignored.",
+                    unit.label,
                 )
                 return
             # Reference workers swallow render errors and the master
             # hangs (worker/src/rendering/queue.rs:169-174); we
-            # reschedule the frame instead.
+            # reschedule the unit instead — up to the error budget, past
+            # which the failure is evidently deterministic and the job
+            # fails rather than livelocking on redispatch.
+            record.errored_count += 1
+            if record.errored_count >= unit_error_limit():
+                state.failed_reason = (
+                    f"unit {unit.label} errored {record.errored_count} "
+                    f"times (last: {event.error_reason}); giving up"
+                )
+                self.logger.error("Job failed: %s", state.failed_reason)
+                return
             self.logger.warning(
-                "Frame %d errored on worker (%s); rescheduling.",
-                event.frame_index,
+                "Unit %s errored on worker (%s); rescheduling "
+                "(attempt %d/%d).",
+                unit.label,
                 event.error_reason,
+                record.errored_count,
+                unit_error_limit(),
             )
-            state.return_frame_to_pending(event.frame_index)
+            state.return_frame_to_pending(unit)
+
+    def _finish_unit(self, state: ClusterManagerState, unit: WorkUnit) -> None:
+        """Mark a unit finished; when it completes its whole frame, fire
+        the master's frame-complete hook (assembly of tiled frames). The
+        transition returns True exactly once per frame, so a duplicate or
+        late copy of the final tile can never assemble a frame twice."""
+        frame_completed = state.mark_frame_as_finished(unit)
+        if (
+            frame_completed
+            and state.job.tile_grid is not None
+            and self._on_frame_complete is not None
+        ):
+            self._on_frame_complete(state, unit.frame_index)
 
     async def _handle_goodbye(self, event: pm.WorkerGoodbyeEvent) -> None:
         """Graceful drain: requeue the returned frames without an eviction.
@@ -745,25 +840,27 @@ class WorkerHandle:
         self.drained = True
         self.cancel_heartbeat()
         now = time.time()
-        # Mirror entries carry their owning job; the advisory indices the
+        # Mirror entries carry their owning job; the advisory units the
         # goodbye shipped are attributed to its (single) job_name — in a
         # multi-job cluster the mirror sweep is authoritative anyway,
         # since everything the master credits to this worker is mirrored.
-        items = {(f.job_name, f.frame_index) for f in self.queue.all_frames()}
-        items |= {(event.job_name, index) for index in event.returned_frames}
+        items = {(f.job_name, f.unit) for f in self.queue.all_frames()}
+        tiles = event.returned_tiles or (None,) * len(event.returned_frames)
+        items |= {
+            (event.job_name, WorkUnit(index, tile))
+            for index, tile in zip(event.returned_frames, tiles)
+        }
         requeued = 0
-        for job_name, frame_index in sorted(
-            items, key=lambda item: (item[0] or "", item[1])
+        for job_name, unit in sorted(
+            items, key=lambda item: (item[0] or "", item[1].sort_key)
         ):
             state = self._state_for(job_name)
-            record = (
-                state.frames.get(frame_index) if state is not None else None
-            )
-            frame = self.queue.remove(frame_index, job_name)
+            record = state.frames.get(unit) if state is not None else None
+            frame = self.queue.remove(unit.frame_index, job_name, unit.tile)
             if frame is not None:
                 self._complete_frame_flow(
                     "frame returned",
-                    frame_index,
+                    unit,
                     frame.trace,
                     start_wall=now,
                     duration=0.0,
@@ -774,7 +871,7 @@ class WorkerHandle:
                 and record.status is not FrameStatus.FINISHED
                 and record.worker_id == self.worker_id
             ):
-                state.return_frame_to_pending(frame_index)
+                state.return_frame_to_pending(unit)
                 requeued += 1
         self._update_queue_depth_gauge()
         if self.metrics is not None:

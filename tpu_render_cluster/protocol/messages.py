@@ -113,6 +113,25 @@ def _job_id_from_payload(payload: dict[str, Any]) -> str | None:
     return job_id
 
 
+def _tile_from_payload(payload: dict[str, Any]) -> int | None:
+    """Decode the optional ``tile`` key (piggyback idiom: absent -> None).
+
+    Rides queue add/remove requests and both frame-event echoes when the
+    job splits frames into sub-frame tiles (PROTOCOL.md §Tile-sharded
+    frames). Whole-frame jobs never set it — their traffic stays
+    byte-identical to the reference, and C++ workers (which neither read
+    nor echo the key) interoperate on whole-frame jobs unmodified.
+    """
+    tile = payload.get("tile")
+    if tile is None:
+        return None
+    if isinstance(tile, bool) or not isinstance(tile, int):
+        raise ValueError("tile must be an integer tile index")
+    if tile < 0:
+        raise ValueError(f"tile index must be >= 0, got {tile}")
+    return tile
+
+
 # ---------------------------------------------------------------------------
 # Result-enum wire values
 
@@ -227,6 +246,8 @@ class MasterFrameQueueAddRequest(Message):
     trace: TraceContext | None = None
     # Optional scheduler job id (multi-job masters only, same idiom).
     job_id: str | None = None
+    # Optional sub-frame tile index (tiled jobs only, same idiom).
+    tile: int | None = None
 
     @classmethod
     def new(
@@ -236,8 +257,11 @@ class MasterFrameQueueAddRequest(Message):
         *,
         trace: TraceContext | None = None,
         job_id: str | None = None,
+        tile: int | None = None,
     ) -> "MasterFrameQueueAddRequest":
-        return cls(generate_message_request_id(), job, frame_index, trace, job_id)
+        return cls(
+            generate_message_request_id(), job, frame_index, trace, job_id, tile
+        )
 
     def to_payload(self) -> dict[str, Any]:
         out = {
@@ -249,6 +273,8 @@ class MasterFrameQueueAddRequest(Message):
             out["trace"] = self.trace.to_dict()
         if self.job_id is not None:
             out["job_id"] = self.job_id
+        if self.tile is not None:
+            out["tile"] = self.tile
         return out
 
     @classmethod
@@ -259,6 +285,7 @@ class MasterFrameQueueAddRequest(Message):
             frame_index=int(payload["frame_index"]),
             trace=_trace_from_payload(payload),
             job_id=_job_id_from_payload(payload),
+            tile=_tile_from_payload(payload),
         )
 
 
@@ -299,17 +326,25 @@ class MasterFrameQueueRemoveRequest(Message):
     message_request_id: int
     job_name: str
     frame_index: int
+    # Optional sub-frame tile index (piggyback idiom): a tiled steal or
+    # preemption removes one TILE; whole-frame requests omit the key.
+    tile: int | None = None
 
     @classmethod
-    def new(cls, job_name: str, frame_index: int) -> "MasterFrameQueueRemoveRequest":
-        return cls(generate_message_request_id(), job_name, frame_index)
+    def new(
+        cls, job_name: str, frame_index: int, *, tile: int | None = None
+    ) -> "MasterFrameQueueRemoveRequest":
+        return cls(generate_message_request_id(), job_name, frame_index, tile)
 
     def to_payload(self) -> dict[str, Any]:
-        return {
+        out: dict[str, Any] = {
             "message_request_id": self.message_request_id,
             "job_name": self.job_name,
             "frame_index": self.frame_index,
         }
+        if self.tile is not None:
+            out["tile"] = self.tile
+        return out
 
     @classmethod
     def from_payload(cls, payload: dict[str, Any]) -> "MasterFrameQueueRemoveRequest":
@@ -317,6 +352,7 @@ class MasterFrameQueueRemoveRequest(Message):
             message_request_id=int(payload["message_request_id"]),
             job_name=str(payload["job_name"]),
             frame_index=int(payload["frame_index"]),
+            tile=_tile_from_payload(payload),
         )
 
 
@@ -362,6 +398,8 @@ class WorkerFrameQueueItemRenderingEvent(Message):
     trace: TraceContext | None = None
     # Echo of the queue-add request's optional scheduler job id.
     job_id: str | None = None
+    # Echo of the queue-add request's optional tile index.
+    tile: int | None = None
 
     def to_payload(self) -> dict[str, Any]:
         out: dict[str, Any] = {
@@ -372,6 +410,8 @@ class WorkerFrameQueueItemRenderingEvent(Message):
             out["trace"] = self.trace.to_dict()
         if self.job_id is not None:
             out["job_id"] = self.job_id
+        if self.tile is not None:
+            out["tile"] = self.tile
         return out
 
     @classmethod
@@ -381,6 +421,7 @@ class WorkerFrameQueueItemRenderingEvent(Message):
             int(payload["frame_index"]),
             trace=_trace_from_payload(payload),
             job_id=_job_id_from_payload(payload),
+            tile=_tile_from_payload(payload),
         )
 
 
@@ -403,6 +444,9 @@ class WorkerFrameQueueItemFinishedEvent(Message):
     trace: TraceContext | None = None
     # Echo of the queue-add request's optional scheduler job id.
     job_id: str | None = None
+    # Echo of the queue-add request's optional tile index: the master's
+    # assembly ledger credits the finished TILE, not the whole frame.
+    tile: int | None = None
 
     @classmethod
     def new_ok(
@@ -412,10 +456,11 @@ class WorkerFrameQueueItemFinishedEvent(Message):
         *,
         trace: TraceContext | None = None,
         job_id: str | None = None,
+        tile: int | None = None,
     ) -> "WorkerFrameQueueItemFinishedEvent":
         return cls(
             job_name, frame_index, FRAME_QUEUE_ITEM_FINISHED_OK, trace=trace,
-            job_id=job_id,
+            job_id=job_id, tile=tile,
         )
 
     @classmethod
@@ -427,10 +472,11 @@ class WorkerFrameQueueItemFinishedEvent(Message):
         *,
         trace: TraceContext | None = None,
         job_id: str | None = None,
+        tile: int | None = None,
     ) -> "WorkerFrameQueueItemFinishedEvent":
         return cls(
             job_name, frame_index, FRAME_QUEUE_ITEM_FINISHED_ERRORED, reason,
-            trace=trace, job_id=job_id,
+            trace=trace, job_id=job_id, tile=tile,
         )
 
     def to_payload(self) -> dict[str, Any]:
@@ -443,6 +489,8 @@ class WorkerFrameQueueItemFinishedEvent(Message):
             out["trace"] = self.trace.to_dict()
         if self.job_id is not None:
             out["job_id"] = self.job_id
+        if self.tile is not None:
+            out["tile"] = self.tile
         return out
 
     @classmethod
@@ -455,6 +503,7 @@ class WorkerFrameQueueItemFinishedEvent(Message):
             reason,
             trace=_trace_from_payload(payload),
             job_id=_job_id_from_payload(payload),
+            tile=_tile_from_payload(payload),
         )
 
 
@@ -555,6 +604,10 @@ class WorkerGoodbyeEvent(Message):
     reason: str = "drain"
     job_name: str | None = None
     returned_frames: tuple[int, ...] = ()
+    # Optional tile indices aligned 1:1 with ``returned_frames`` (null for
+    # whole-frame entries). Omitted entirely when every returned unit is a
+    # whole frame, keeping untiled goodbyes byte-identical.
+    returned_tiles: tuple[int | None, ...] | None = None
 
     def to_payload(self) -> dict[str, Any]:
         out: dict[str, Any] = {
@@ -563,6 +616,10 @@ class WorkerGoodbyeEvent(Message):
         }
         if self.job_name is not None:
             out["job_name"] = self.job_name
+        if self.returned_tiles is not None and any(
+            t is not None for t in self.returned_tiles
+        ):
+            out["returned_tiles"] = list(self.returned_tiles)
         return out
 
     @classmethod
@@ -570,11 +627,19 @@ class WorkerGoodbyeEvent(Message):
         frames = payload.get("returned_frames") or []
         if not isinstance(frames, list):
             raise ValueError("returned_frames must be a list")
+        tiles = payload.get("returned_tiles")
+        if tiles is not None:
+            if not isinstance(tiles, list) or len(tiles) != len(frames):
+                raise ValueError(
+                    "returned_tiles must align 1:1 with returned_frames"
+                )
+            tiles = tuple(None if t is None else int(t) for t in tiles)
         job_name = payload.get("job_name")
         return cls(
             reason=str(payload.get("reason", "drain")),
             job_name=None if job_name is None else str(job_name),
             returned_frames=tuple(int(f) for f in frames),
+            returned_tiles=tiles,
         )
 
 

@@ -182,11 +182,14 @@ def compaction_order(alive):
 
 
 @jax.jit
-def _compact_sphere(origins, directions, throughput, alive, lane):
+def _compact_sphere(origins, directions, throughput, alive, lane, rng):
     """Compact sphere-scene state (no coherence sort needed — the sphere
     pass has no packet culling, so only the dead/alive partition
     matters). One packed gather so the random-access cost is paid once
-    per row, not per field."""
+    per row, not per field. ``rng`` is the RNG-counter row riding next
+    to the scatter index ``lane`` (identical arrays unless the caller
+    renders a region with full-frame lane ids — XLA CSEs the duplicate
+    gather away in the identical case)."""
     perm, live = compaction_order(alive)
     packed = jnp.concatenate([origins, directions, throughput], axis=1)[perm]
     return (
@@ -195,12 +198,13 @@ def _compact_sphere(origins, directions, throughput, alive, lane):
         packed[:, 6:9],
         alive[perm],
         lane[perm],
+        rng[perm],
         live,
     )
 
 
 @jax.jit
-def _compact_mesh(origins, directions, throughput, alive, lane, mesh):
+def _compact_mesh(origins, directions, throughput, alive, lane, rng, mesh):
     """Compact mesh-scene state with the integrator's coherence sort.
 
     _ray_sort_order's dead flag (bit 31) already parks dead lanes at the
@@ -217,36 +221,38 @@ def _compact_mesh(origins, directions, throughput, alive, lane, mesh):
         packed[:, 6:9],
         alive[order],
         lane[order],
+        rng[order],
         jnp.sum(alive.astype(jnp.int32)),
     )
 
 
 @functools.partial(jax.jit, static_argnames=("total_bounces",))
 def _sphere_step(
-    scene, origins, directions, throughput, alive, lane, live, seed,
+    scene, origins, directions, throughput, alive, lane, rng, live, seed,
     bounce, radiance_total, *, total_bounces: int,
 ):
     contribution, o2, d2, thr2, alive2 = pk.sphere_bounce_pallas(
         scene, origins, directions, throughput, alive, seed, bounce,
-        total_bounces=total_bounces, lane=lane, live_count=live,
+        total_bounces=total_bounces, lane=rng, live_count=live,
     )
     return o2, d2, thr2, alive2, radiance_total.at[lane].add(contribution)
 
 
 @functools.partial(jax.jit, static_argnames=("total_bounces",))
 def _mesh_step(
-    scene, mesh, origins, directions, throughput, alive, lane, live, seed,
+    scene, mesh, origins, directions, throughput, alive, lane, rng, live, seed,
     bounce, radiance_total, *, total_bounces: int,
 ):
     contribution, o2, d2, thr2, alive2 = pk.mesh_bounce_pallas(
         scene, mesh, origins, directions, throughput, alive, seed, bounce,
-        total_bounces=total_bounces, lane=lane, live_count=live,
+        total_bounces=total_bounces, lane=rng, live_count=live,
     )
     return o2, d2, thr2, alive2, radiance_total.at[lane].add(contribution)
 
 
 def trace_paths_wavefront(
-    scene, origins, directions, seed, *, max_bounces: int = 4, mesh=None
+    scene, origins, directions, seed, *, max_bounces: int = 4, mesh=None,
+    rng_lanes=None,
 ):
     """Trace one sample per ray, wavefront-style; returns radiance [R, 3].
 
@@ -260,7 +266,10 @@ def trace_paths_wavefront(
     Physics and per-original-lane RNG streams are identical to the
     masked Pallas paths (integrator.trace_paths with TRC_PALLAS on), so
     images agree up to FP tie-breaking — tests/test_wavefront.py pins
-    the equivalence.
+    the equivalence. ``rng_lanes`` (optional [R] int32) overrides the
+    RNG counters with FULL-frame lane ids: the cluster-tile region path
+    (render_region_wavefront) uses it so a tiled wavefront frame
+    reproduces the whole-frame wavefront image on its pixels.
     """
     from tpu_render_cluster.obs import get_tracer
 
@@ -276,6 +285,7 @@ def trace_paths_wavefront(
     throughput = jnp.ones((n0, 3), jnp.float32)
     alive = jnp.ones((n0,), bool)
     lane = jnp.arange(n0, dtype=jnp.int32)
+    rng = lane if rng_lanes is None else jnp.asarray(rng_lanes, jnp.int32)
     seed = jnp.asarray(seed, jnp.int32)
 
     for bounce in range(max_bounces):
@@ -284,12 +294,16 @@ def trace_paths_wavefront(
         width = origins.shape[0]
         _count_compile(kind, "compact", width)
         if mesh is not None:
-            origins, directions, throughput, alive, lane, live_dev = (
-                _compact_mesh(origins, directions, throughput, alive, lane, mesh)
+            origins, directions, throughput, alive, lane, rng, live_dev = (
+                _compact_mesh(
+                    origins, directions, throughput, alive, lane, rng, mesh
+                )
             )
         else:
-            origins, directions, throughput, alive, lane, live_dev = (
-                _compact_sphere(origins, directions, throughput, alive, lane)
+            origins, directions, throughput, alive, lane, rng, live_dev = (
+                _compact_sphere(
+                    origins, directions, throughput, alive, lane, rng
+                )
             )
         live = int(live_dev)
         survival.observe(live / n0, bounce=bounce)
@@ -310,6 +324,7 @@ def trace_paths_wavefront(
             throughput = throughput[:bucket]
             alive = alive[:bucket]
             lane = lane[:bucket]
+            rng = rng[:bucket]
         occupancy.set(live / bucket)
         launched.observe(live / bucket)
         _count_compile(kind, "bounce", bucket, max_bounces)
@@ -317,7 +332,7 @@ def trace_paths_wavefront(
             origins, directions, throughput, alive, radiance_total = (
                 _mesh_step(
                     scene, mesh, origins, directions, throughput, alive,
-                    lane, live_dev, seed, bounce, radiance_total,
+                    lane, rng, live_dev, seed, bounce, radiance_total,
                     total_bounces=max_bounces,
                 )
             )
@@ -325,7 +340,7 @@ def trace_paths_wavefront(
             origins, directions, throughput, alive, radiance_total = (
                 _sphere_step(
                     scene, origins, directions, throughput, alive, lane,
-                    live_dev, seed, bounce, radiance_total,
+                    rng, live_dev, seed, bounce, radiance_total,
                     total_bounces=max_bounces,
                 )
             )
@@ -398,6 +413,65 @@ def render_frame_wavefront(
     )
     return _finish_frame(
         radiance, samples=samples, height=height, width=width
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("width", "height", "samples", "tile_height", "tile_width"),
+)
+def _region_rays(
+    camera, frame, y0, x0, *, width: int, height: int, samples: int,
+    tile_height: int, tile_width: int,
+):
+    from tpu_render_cluster.render.integrator import region_rays_and_seed
+
+    return region_rays_and_seed(
+        camera, frame, width=width, height=height, samples=samples,
+        y0=y0, x0=x0, tile_height=tile_height, tile_width=tile_width,
+    )
+
+
+def render_region_wavefront(
+    scene_name: str,
+    frame_index,
+    *,
+    y0: int,
+    x0: int,
+    tile_height: int,
+    tile_width: int,
+    width: int = 512,
+    height: int = 512,
+    samples: int = 8,
+    max_bounces: int = 4,
+):
+    """Render one region of a frame through the wavefront driver.
+
+    The cluster-tile counterpart of ``render_frame_wavefront``: region
+    rays + full-frame RNG lane ids (integrator.region_rays_and_seed), so
+    a stitched grid of regions reproduces the whole-frame wavefront
+    image — the worker's wavefront tier serves tile work units through
+    here. Returns [tile_height, tile_width, 3] linear radiance.
+    """
+    from tpu_render_cluster.render.camera import scene_camera
+    from tpu_render_cluster.render.mesh import scene_mesh_set
+    from tpu_render_cluster.render.scene import build_scene
+
+    scene = build_scene(scene_name, frame_index)
+    camera = scene_camera(scene_name, frame_index)
+    mesh = scene_mesh_set(scene_name, frame_index)
+    origins, directions, lanes, seed = _region_rays(
+        camera, jnp.asarray(frame_index, jnp.float32),
+        jnp.asarray(y0, jnp.int32), jnp.asarray(x0, jnp.int32),
+        width=width, height=height, samples=samples,
+        tile_height=tile_height, tile_width=tile_width,
+    )
+    radiance = trace_paths_wavefront(
+        scene, origins, directions, seed, max_bounces=max_bounces,
+        mesh=mesh, rng_lanes=lanes,
+    )
+    return _finish_frame(
+        radiance, samples=samples, height=tile_height, width=tile_width
     )
 
 

@@ -45,8 +45,45 @@ def output_path_for_frame(
     )
 
 
+def output_path_for_tile(
+    output_directory: Path,
+    name_format: str,
+    file_format: str,
+    frame_number: int,
+    tile: int,
+    grid: tuple[int, int],
+) -> Path:
+    """Where one tile of a tiled frame lands: the frame's own output path
+    with a ``.tile_rRcC`` infix — always ``.png``. Tile intermediates are
+    LOSSLESS regardless of the job's final format: encoding each tile of
+    a JPEG job lossily and re-encoding the stitched frame would quantize
+    twice (with independent per-tile block boundaries) and break the
+    tiled-equals-untiled pixel contract. Workers (writing) and the
+    master's assembler (reading/stitching) both resolve through here, so
+    the naming cannot drift."""
+    from tpu_render_cluster.jobs.tiles import tile_rc
+
+    frame_path = output_path_for_frame(
+        output_directory, name_format, file_format, frame_number
+    )
+    row, col = tile_rc(tile, grid)
+    return frame_path.with_name(
+        f"{frame_path.stem}.tile_r{row}c{col}.png"
+    )
+
+
 def write_image(path: Path, pixels: np.ndarray, file_format: str = "PNG") -> None:
-    """Write a [H, W, 3] uint8 array; falls back to PNG for unknown formats."""
+    """Write a [H, W, 3] uint8 array; falls back to PNG for unknown formats.
+
+    Atomic (write-temp-then-rename): a reader never sees a torn file.
+    Load-bearing for tile assembly — a duplicate assignment of the same
+    tile (queue-add ack timeout races) can still be writing the tile path
+    when the master's stitcher reads it; both copies carry identical
+    pixels, so with the rename either complete version is correct.
+    """
+    import os
+    import tempfile
+
     from PIL import Image
 
     image_format = file_format.upper()
@@ -56,7 +93,20 @@ def write_image(path: Path, pixels: np.ndarray, file_format: str = "PNG") -> Non
         image_format = "PNG"
     path.parent.mkdir(parents=True, exist_ok=True)
     image = Image.fromarray(np.asarray(pixels))
-    if image_format == "JPEG":
-        image.save(path, image_format, quality=90)  # reference script: quality=90
-    else:
-        image.save(path, image_format)
+    fd, tmp_name = tempfile.mkstemp(
+        prefix=f".{path.name}.", suffix=".tmp", dir=path.parent
+    )
+    try:
+        with os.fdopen(fd, "wb") as f:
+            if image_format == "JPEG":
+                # reference script: quality=90
+                image.save(f, image_format, quality=90)
+            else:
+                image.save(f, image_format)
+        os.replace(tmp_name, path)
+    except BaseException:
+        try:
+            os.unlink(tmp_name)
+        except OSError:
+            pass
+        raise

@@ -301,8 +301,100 @@ def frame_rays_and_seed(camera: Camera, frame, *, width, height, samples):
     return origins, directions, trace_seed(tile_trace_key(base_key))
 
 
+def region_pixel_indices(*, y0, x0, tile_height, tile_width, width):
+    """Row-major FULL-frame pixel indices of one region ([th*tw] int32).
+
+    ``y0``/``x0`` may be traced scalars."""
+    return (
+        (jnp.arange(tile_height, dtype=jnp.int32)[:, None]
+         + jnp.asarray(y0, jnp.int32)) * width
+        + jnp.arange(tile_width, dtype=jnp.int32)[None, :]
+        + jnp.asarray(x0, jnp.int32)
+    ).reshape(-1)
+
+
+def region_lane_map(
+    *, y0, x0, tile_height, tile_width, width, height, samples
+):
+    """Local region-ray index -> FULL-frame lane id ([samples*th*tw] int32).
+
+    THE lane-layout definition (sample-major over row-major pixels:
+    ``s*H*W + y*W + x``) the cross-tier tiled-equals-untiled contract
+    rests on — shared by ``region_rays_and_seed`` and the ray pool's
+    region glane map so the two cannot drift.
+    """
+    pix = region_pixel_indices(
+        y0=y0, x0=x0, tile_height=tile_height, tile_width=tile_width,
+        width=width,
+    )
+    return (
+        jnp.arange(samples, dtype=jnp.int32)[:, None] * (height * width)
+        + pix[None, :]
+    ).reshape(-1)
+
+
+def region_rays_and_seed(
+    camera: Camera, frame, *, width, height, samples, y0, x0,
+    tile_height, tile_width,
+):
+    """One REGION's rows of the full frame's flattened primary rays, plus
+    their GLOBAL lane ids and the frame's kernel trace seed.
+
+    The cluster-tiling counterpart of ``frame_rays_and_seed``: instead of
+    deriving a fresh RNG root from the tile coordinates (what
+    ``render_tile(y0, x0)`` does — a different image per tiling), the
+    region inherits the FULL frame's derivation. Per sample the whole
+    frame's jitter array is drawn (cheap next to tracing) and sliced to
+    the region's pixels, the camera rays are built from the same global
+    pixel coordinates, and each ray carries its full-frame lane id
+    ``s*H*W + y*W + x`` — the counter the Pallas kernels key their PCG
+    streams on. Tracing these rays with these lane ids reproduces the
+    whole-frame render's radiance at the region's pixels exactly, which
+    is what makes a master-assembled tiled frame pixel-identical to the
+    untiled render (tests/test_tiles.py pins it across all three
+    execution tiers).
+
+    ``y0``/``x0`` may be traced scalars (one compiled region program per
+    tile SHAPE serves every tile position and frame).
+    """
+    base_key = tile_base_key(frame, 0, 0)
+    n_frame = height * width
+    pix = region_pixel_indices(
+        y0=y0, x0=x0, tile_height=tile_height, tile_width=tile_width,
+        width=width,
+    )
+
+    def one_sample(key):
+        jitter_key, _ = jax.random.split(key)
+        # The FULL frame's jitter, sliced: identical values to what
+        # sample_jitter_rays feeds camera_rays for these pixels in the
+        # whole-frame render.
+        jitter = jax.random.uniform(jitter_key, (n_frame, 2))[pix]
+        return camera_rays(
+            camera, width, height, y0=y0, x0=x0,
+            tile_height=tile_height, tile_width=tile_width, jitter=jitter,
+        )
+
+    sample_keys = jax.vmap(lambda s: jax.random.fold_in(base_key, s))(
+        jnp.arange(samples)
+    )
+    origins, directions = jax.vmap(one_sample)(sample_keys)
+    n_tile = tile_height * tile_width
+    lanes = region_lane_map(
+        y0=y0, x0=x0, tile_height=tile_height, tile_width=tile_width,
+        width=width, height=height, samples=samples,
+    )
+    return (
+        origins.reshape(samples * n_tile, 3),
+        directions.reshape(samples * n_tile, 3),
+        lanes,
+        trace_seed(tile_trace_key(base_key)),
+    )
+
+
 def trace_paths(
-    scene: Scene, origins, directions, key, *, max_bounces: int = 4, mesh=None
+    scene: Scene, origins, directions, key, *, max_bounces: int = 4, mesh=None,
+    rng_lanes=None,
 ) -> jnp.ndarray:
     """Trace one sample per ray; returns radiance [R, 3].
 
@@ -311,14 +403,33 @@ def trace_paths(
     RNG — pallas_kernels.trace_paths_fused); elsewhere it runs the XLA
     bounce scan below. The two paths use different RNG streams but identical
     physics, so images agree statistically, not bit-for-bit.
+
+    ``rng_lanes`` (optional [R] int32) overrides the RNG counter per ray:
+    the region render path (cluster tiling) passes each ray's FULL-frame
+    lane id so a cropped trace reproduces the whole-frame streams. Only
+    meaningful on the Pallas paths — with it set, sphere and
+    megakernel-eligible mesh scenes route through the per-bounce state-io
+    kernels (which accept explicit lane ids; per-lane streams match the
+    megakernels', pinned by tests/test_wavefront.py), and the XLA
+    fallback ignores it (shape-derived RNG cannot be cropped — region
+    renders there are statistically, not bitwise, consistent).
     """
     from tpu_render_cluster.render import pallas_kernels
 
     if pallas_kernels.pallas_enabled():
         seed = trace_seed(key)
-        if mesh is None:
+        if mesh is None and rng_lanes is None:
             return pallas_kernels.trace_paths_fused(
                 scene, origins, directions, seed, max_bounces=max_bounces
+            )
+        if mesh is None:
+            # Explicit lane ids: the SAME fused megakernel, with the RNG
+            # counters read from the caller's lane row instead of the
+            # launch position — a cropped region launch therefore runs
+            # bitwise-identical per-lane math to the whole-frame render.
+            return pallas_kernels.trace_paths_fused(
+                scene, origins, directions, seed, max_bounces=max_bounces,
+                lane=jnp.asarray(rng_lanes, jnp.int32),
             )
         # Mesh scenes: the megakernel (whole bounce loop incl. the
         # instanced BVH walk in one kernel) wins when the per-bounce walk
@@ -327,7 +438,7 @@ def trace_paths(
         # behind the per-bounce instanced kernels (measured on-chip,
         # 256x256 4spp: 02_physics-mesh [3 nodes x 24 inst] 16.9 -> 38.9
         # f/s; 03_physics-2-mesh [127 nodes x 48 inst] 1.89 -> 1.52).
-        if pallas_kernels.mesh_megakernel_eligible(mesh):
+        if rng_lanes is None and pallas_kernels.mesh_megakernel_eligible(mesh):
             return pallas_kernels.trace_paths_fused_mesh(
                 scene, mesh, origins, directions, seed,
                 max_bounces=max_bounces,
@@ -348,6 +459,11 @@ def trace_paths(
         radiance = jnp.zeros((n, 3), jnp.float32)
         alive = jnp.ones((n,), bool)
         lane = jnp.arange(n, dtype=jnp.int32)
+        # The RNG counter rides separately from the unsort index when the
+        # caller supplies full-frame lane ids (region rendering); with
+        # positional lanes the two arrays are identical and XLA CSEs the
+        # duplicate gathers away.
+        rng = lane if rng_lanes is None else jnp.asarray(rng_lanes, jnp.int32)
         for bounce in range(max_bounces):
             order = _ray_sort_order(origins, directions, alive, mesh=mesh)
             packed = jnp.concatenate(
@@ -359,6 +475,7 @@ def trace_paths(
             radiance = packed[:, 9:12]
             alive = alive[order]
             lane = lane[order]
+            rng = rng[order]
             # The sort key's dead flag (bit 31) puts every dead lane
             # after every live one, so lanes >= live are exactly the dead
             # tail: the kernel's live-count prefetch skips those blocks
@@ -372,7 +489,7 @@ def trace_paths(
                 pallas_kernels.mesh_bounce_pallas(
                     scene, mesh, origins, directions, throughput, alive,
                     seed, bounce, total_bounces=max_bounces,
-                    lane=lane, live_count=live,
+                    lane=rng, live_count=live,
                 )
             )
             radiance = radiance + contribution
@@ -590,3 +707,91 @@ def fused_frame_renderer(
         return tonemap(linear)
 
     return render
+
+
+@functools.lru_cache(maxsize=64)
+def fused_region_renderer(
+    scene_name: str,
+    width: int,
+    height: int,
+    tile_height: int,
+    tile_width: int,
+    samples: int,
+    max_bounces: int,
+):
+    """A jitted ``(frame, y0, x0) -> [th, tw, 3] LINEAR`` region closure.
+
+    The masked execution tier's cluster-tile path: one compiled program
+    per tile SHAPE (``y0``/``x0`` are traced), so every tile of a grid —
+    and every frame — reuses the same executable. The region traces the
+    full frame's rays-and-RNG restricted to its pixels
+    (``region_rays_and_seed``), so stitching a grid of regions is
+    pixel-identical to the whole-frame render (up to the FP ties of the
+    megakernel-vs-state-io kernel pairing; see ``trace_paths``).
+
+    Returns LINEAR radiance (not tonemapped): callers tonemap after
+    (matching render_frame's contract) so the assembly seam test can
+    compare linear images.
+    """
+    from tpu_render_cluster.render.camera import scene_camera
+    from tpu_render_cluster.render.scene import build_scene
+
+    @jax.jit
+    def render(frame: jnp.ndarray, y0, x0) -> jnp.ndarray:
+        from tpu_render_cluster.render.mesh import scene_mesh_set
+
+        scene = build_scene(scene_name, frame)
+        camera = scene_camera(scene_name, frame)
+        mesh = scene_mesh_set(scene_name, frame)
+        origins, directions, lanes, seed = region_rays_and_seed(
+            camera, jnp.asarray(frame, jnp.float32),
+            width=width, height=height, samples=samples,
+            y0=y0, x0=x0, tile_height=tile_height, tile_width=tile_width,
+        )
+        base_key = tile_base_key(jnp.asarray(frame, jnp.float32), 0, 0)
+        n = tile_height * tile_width
+        from tpu_render_cluster.render import pallas_kernels
+
+        if pallas_kernels.pallas_enabled():
+            radiance = trace_paths(
+                scene, origins, directions, tile_trace_key(base_key),
+                max_bounces=max_bounces, mesh=mesh, rng_lanes=lanes,
+            )
+        else:
+            # XLA fallback: per-lane counters don't exist there, so the
+            # region renders with its own shape-derived streams —
+            # statistically the same image, not bitwise (the Pallas tiers
+            # carry the exactness contract).
+            radiance = trace_paths(
+                scene, origins, directions, tile_trace_key(base_key),
+                max_bounces=max_bounces, mesh=mesh,
+            )
+        return radiance.reshape(samples, n, 3).mean(axis=0).reshape(
+            tile_height, tile_width, 3
+        )
+
+    return render
+
+
+def render_frame_region(
+    scene_name: str,
+    frame_index: int,
+    *,
+    y0: int,
+    x0: int,
+    tile_height: int,
+    tile_width: int,
+    width: int = 512,
+    height: int = 512,
+    samples: int = 8,
+    max_bounces: int = 4,
+) -> jnp.ndarray:
+    """Render one region of a frame; [tile_height, tile_width, 3] linear.
+
+    Equals the whole-frame render's pixels on the region (the cluster
+    tiling contract) — see ``fused_region_renderer``.
+    """
+    return fused_region_renderer(
+        scene_name, width, height, tile_height, tile_width, samples,
+        max_bounces,
+    )(jnp.asarray(frame_index, jnp.float32), y0, x0)

@@ -321,7 +321,7 @@ def _uniform_from_hash(h):
 
 def _trace_kernel_factory(
     max_bounces: int, n_padded: int, state_io: bool = False,
-    pool_io: bool = False,
+    pool_io: bool = False, lane_io: bool = False,
 ):
     """Sphere path-trace kernel. Three shapes share one bounce_step (same
     split as _mesh_trace_kernel_factory):
@@ -361,6 +361,15 @@ def _trace_kernel_factory(
              albedo_ref, emission_ref, dcsun_ref, params_ref,
              out_ref, o_out_ref, d_out_ref, thr_out_ref,
              alive_out_ref) = refs
+        elif lane_io:
+            # The megakernel with an EXPLICIT lane row: the cluster-tile
+            # region path feeds each ray its full-frame lane id, so a
+            # cropped launch runs bitwise-identical per-lane math to the
+            # whole-frame megakernel (same kernel, same loop — only the
+            # RNG counter's source differs).
+            (seed_ref, o_ref, d_ref, lane_ref, c_ref, r2_ref, csq_ref,
+             rad_ref, albedo_ref, emission_ref, dcsun_ref, params_ref,
+             out_ref) = refs
         else:
             (seed_ref, o_ref, d_ref, c_ref, r2_ref, csq_ref, rad_ref,
              albedo_ref, emission_ref, dcsun_ref, params_ref,
@@ -397,11 +406,11 @@ def _trace_kernel_factory(
         else:
             seed = seed_ref[0, 0].astype(jnp.uint32)
             fid_match = None
-            if state_io:
+            if state_io or lane_io:
                 # RNG counters follow the ORIGINAL lane id the caller
-                # threads through compaction/re-sorts, not the current
-                # position: a ray keeps its stream wherever compaction
-                # lands it.
+                # threads through compaction/re-sorts (or the region
+                # path's full-frame lane map), not the current position:
+                # a ray keeps its stream wherever it lands.
                 ray_index = lane_ref[:, :].astype(jnp.uint32)
             else:
                 ray_index = (
@@ -606,13 +615,18 @@ def _trace_fused(
     origins, directions, centers, radii, albedo, emission,
     sun_direction, sun_color, sky_horizon, sky_zenith,
     plane_albedo_a, plane_albedo_b, seed,
-    *, max_bounces: int, interpret: bool,
+    *, max_bounces: int, interpret: bool, lane=None,
 ):
     rays = origins.shape[0]
     padded_rays = -(-rays // BLOCK_R) * BLOCK_R
     ray_pad = padded_rays - rays
     o_t = jnp.pad(origins, ((0, ray_pad), (0, 0))).T
     d_t = jnp.pad(directions, ((0, ray_pad), (0, 0))).T
+    lane_t = (
+        None
+        if lane is None
+        else jnp.pad(jnp.asarray(lane, jnp.int32), (0, ray_pad))[None, :]
+    )
 
     n = centers.shape[0]
     padded_n = -(-n // _SUBLANE) * _SUBLANE
@@ -637,36 +651,47 @@ def _trace_fused(
 
     grid = (padded_rays // BLOCK_R,)
     whole = lambda i: (0, 0)  # noqa: E731 - scene blocks replicated per step
+    ray_block = pl.BlockSpec((3, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM)
+    lane_block = pl.BlockSpec((1, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM)
+    in_specs = [
+        pl.BlockSpec((1, 1), whole, memory_space=pltpu.SMEM),
+        ray_block,
+        ray_block,
+        *([lane_block] if lane_t is not None else []),
+        pl.BlockSpec((3, padded_n), whole, memory_space=pltpu.VMEM),
+        pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+        pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+        pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+        pl.BlockSpec((3, padded_n), whole, memory_space=pltpu.VMEM),
+        pl.BlockSpec((3, padded_n), whole, memory_space=pltpu.VMEM),
+        pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
+        pl.BlockSpec((8, 3), whole, memory_space=pltpu.VMEM),
+    ]
+    operands = [seed_arr, o_t, d_t]
+    if lane_t is not None:
+        operands.append(lane_t)
+    operands += [c_t, r2, csq, rad, albedo_t, emission_t, dc_sun, params]
     out = pl.pallas_call(
-        _trace_kernel_factory(max_bounces, padded_n),
+        _trace_kernel_factory(max_bounces, padded_n, lane_io=lane_t is not None),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1), whole, memory_space=pltpu.SMEM),
-            pl.BlockSpec((3, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((3, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((3, padded_n), whole, memory_space=pltpu.VMEM),
-            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
-            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
-            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
-            pl.BlockSpec((3, padded_n), whole, memory_space=pltpu.VMEM),
-            pl.BlockSpec((3, padded_n), whole, memory_space=pltpu.VMEM),
-            pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
-            pl.BlockSpec((8, 3), whole, memory_space=pltpu.VMEM),
-        ],
-        out_specs=[
-            pl.BlockSpec((3, BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
+        out_specs=[ray_block],
         out_shape=[jax.ShapeDtypeStruct((3, padded_rays), jnp.float32)],
         interpret=interpret,
-    )(seed_arr, o_t, d_t, c_t, r2, csq, rad, albedo_t, emission_t, dc_sun, params)[0]
+    )(*operands)[0]
     return out.T[:rays]
 
 
-def trace_paths_fused(scene, origins, directions, seed, *, max_bounces: int):
+def trace_paths_fused(
+    scene, origins, directions, seed, *, max_bounces: int, lane=None
+):
     """Fused megakernel path trace; drop-in for integrator.trace_paths.
 
     ``seed`` is an int32 scalar (derived from the frame/tile) driving the
     in-kernel counter-based PCG RNG; radiance is returned as [R, 3].
+    ``lane`` (optional [R] int32) overrides the positional RNG counters —
+    the cluster-tile region path passes full-frame lane ids so a cropped
+    launch reproduces the whole-frame image bitwise on its pixels.
     """
     return _trace_fused(
         origins,
@@ -684,6 +709,7 @@ def trace_paths_fused(scene, origins, directions, seed, *, max_bounces: int):
         seed,
         max_bounces=max_bounces,
         interpret=_interpret(),
+        lane=lane,
     )
 
 

@@ -306,19 +306,22 @@ def _pool_sort_order(origins, directions, alive, fid, lo_w, hi_w):
     jax.jit,
     static_argnames=(
         "scene_name", "width", "height", "samples", "max_bounces",
-        "pool_width",
+        "pool_width", "tile_shape",
     ),
 )
 def _raypool_batch(
     scene_name: str,
     frames,  # [f_cap] float32 frame indices (tail-padded)
     n_frames,  # traced int32: frames actually served (<= f_cap)
+    y0,  # traced int32 region origin (0 for whole frames)
+    x0,
     *,
     width: int,
     height: int,
     samples: int,
     max_bounces: int,
     pool_width: int,
+    tile_shape: tuple[int, int] | None = None,
 ):
     """The whole batch as ONE compiled program; returns
     (linear images [f_cap, H, W, 3], stats tuple).
@@ -330,7 +333,10 @@ def _raypool_batch(
     exists for).
     """
     from tpu_render_cluster.render.camera import scene_camera
-    from tpu_render_cluster.render.integrator import frame_rays_and_seed
+    from tpu_render_cluster.render.integrator import (
+        frame_rays_and_seed,
+        region_rays_and_seed,
+    )
     from tpu_render_cluster.render.mesh import cached_mesh_bvh
     from tpu_render_cluster.render.scene import (
         build_mesh_instances,
@@ -339,7 +345,11 @@ def _raypool_batch(
     )
 
     f_cap = frames.shape[0]
-    n = samples * height * width  # rays per frame
+    if tile_shape is None:
+        tile_height, tile_width = height, width
+    else:
+        tile_height, tile_width = tile_shape
+    n = samples * tile_height * tile_width  # rays per frame (of this region)
     total = n_frames * n  # traced: primaries to serve
     pool = pool_width
     block = (
@@ -348,11 +358,38 @@ def _raypool_batch(
         else pk.SPHERE_BOUNCE_BLOCK_R
     )
 
-    # Primary rays + per-frame trace seeds, via the SAME helper the
-    # masked render_tile uses — the RNG/ray derivation cannot drift.
-    def frame_rays(frame):
-        return frame_rays_and_seed(
-            scene_camera(scene_name, frame), frame,
+    # Primary rays + per-frame trace seeds, via the SAME helpers the
+    # masked render_tile / region path use — the RNG/ray derivation
+    # cannot drift. Under a region, each lane additionally maps to its
+    # FULL-frame lane id (the RNG counter), so a tiled pool batch
+    # reproduces the whole-frame streams on its pixels. The tile ORIGIN
+    # (y0/x0) is traced — like the other two tiers, one compiled pool
+    # program per tile SHAPE serves every position of the grid.
+    glane_map = None
+    if tile_shape is None:
+        def frame_rays(frame):
+            return frame_rays_and_seed(
+                scene_camera(scene_name, frame), frame,
+                width=width, height=height, samples=samples,
+            )
+    else:
+        def frame_rays(frame):
+            o, d, _lanes, seed = region_rays_and_seed(
+                scene_camera(scene_name, frame), frame,
+                width=width, height=height, samples=samples,
+                y0=y0, x0=x0, tile_height=tile_height,
+                tile_width=tile_width,
+            )
+            return o, d, seed
+
+        # The local->full-frame lane map is frame-independent (every
+        # frame serves the same region); in-graph arithmetic off the
+        # traced origin, THE shared derivation (integrator.region_lane_map
+        # — the same one region_rays_and_seed builds its lanes from).
+        from tpu_render_cluster.render.integrator import region_lane_map
+
+        glane_map = region_lane_map(
+            y0=y0, x0=x0, tile_height=tile_height, tile_width=tile_width,
             width=width, height=height, samples=samples,
         )
 
@@ -474,16 +511,22 @@ def _raypool_batch(
         live2 = live + take
 
         # 3. One fused bounce over the live prefix (per-lane frame seed
-        # + bounce depth key the RNG; all-dead tail blocks skip).
+        # + bounce depth key the RNG; all-dead tail blocks skip). Under a
+        # region the RNG counter is the lane's FULL-frame id, not its
+        # local scatter index.
         seed_row = seeds[jnp.clip(fid, 0, f_cap - 1)]
+        rng = (
+            lane if glane_map is None
+            else glane_map[jnp.clip(lane, 0, n - 1)]
+        )
         if mesh_ops is not None:
             contrib, o, d, thr, alive_k = pk.pool_mesh_bounce(
-                mesh_ops, o, d, thr, alive, lane, fid, seed_row, bounce,
+                mesh_ops, o, d, thr, alive, rng, fid, seed_row, bounce,
                 live2, total_bounces=max_bounces,
             )
         else:
             contrib, o, d, thr, alive_k = pk.pool_sphere_bounce(
-                sphere_ops, o, d, thr, alive, lane, fid, seed_row,
+                sphere_ops, o, d, thr, alive, rng, fid, seed_row,
                 bounce, live2, total_bounces=max_bounces,
             )
 
@@ -527,9 +570,9 @@ def _raypool_batch(
     final = jax.lax.while_loop(cond, body, state)
     images = (
         final["radiance"]
-        .reshape(f_cap, samples, height * width, 3)
+        .reshape(f_cap, samples, tile_height * tile_width, 3)
         .mean(axis=1)
-        .reshape(f_cap, height, width, 3)
+        .reshape(f_cap, tile_height, tile_width, 3)
     )
     stats = (
         final["it"], final["served"], final["refilled"],
@@ -613,6 +656,7 @@ def render_batch_raypool(
     max_bounces: int = 4,
     pool_width: int | None = None,
     frame_cap: int | None = None,
+    region: tuple[int, int, int, int] | None = None,
 ):
     """Render a batch of frames through the device-resident ray pool.
 
@@ -620,6 +664,13 @@ def render_batch_raypool(
     ``frame_indices`` in order. Batches larger than the frame-window
     cap chunk into windows (one host sync per window); every window of
     any size reuses the one compiled program for this pool config.
+
+    ``region`` = (y0, x0, tile_height, tile_width) restricts every frame
+    of the batch to ONE tile region (the cluster-tiling work unit): the
+    pool serves the region's rays with their full-frame RNG lane ids, so
+    the returned [th, tw, 3] images equal the whole-frame pool render's
+    pixels on the region. The batch dimension stays FRAMES — a tiled
+    multi-frame job batches same-tile units across frames.
     """
     import numpy as np
 
@@ -630,7 +681,11 @@ def render_batch_raypool(
         return []
     f_cap = frame_cap if frame_cap is not None else raypool_frame_cap()
     f_cap = max(1, min(f_cap, RAYPOOL_MAX_FRAMES))
-    n = samples * height * width
+    if region is not None:
+        region = tuple(int(v) for v in region)
+        n = samples * region[2] * region[3]
+    else:
+        n = samples * height * width
     block = (
         pk.BVH_BLOCK_R
         if mesh_kind_for_scene(scene_name) is not None
@@ -645,7 +700,7 @@ def render_batch_raypool(
         padded = chunk + [chunk[-1]] * (f_cap - len(chunk))
         note_compile(
             "raypool", scene_name, width, height, samples, max_bounces,
-            pool, f_cap,
+            pool, f_cap, None if region is None else (region[2], region[3]),
         )
         start_wall = time.time()
         start_mono = time.perf_counter()
@@ -653,8 +708,11 @@ def render_batch_raypool(
             scene_name,
             jnp.asarray(padded, jnp.float32),
             jnp.int32(len(chunk)),
+            jnp.int32(0 if region is None else region[0]),
+            jnp.int32(0 if region is None else region[1]),
             width=width, height=height, samples=samples,
             max_bounces=max_bounces, pool_width=pool,
+            tile_shape=None if region is None else (region[2], region[3]),
         )
         # THE host sync of the batch: everything before this line is one
         # dispatched XLA program.

@@ -114,6 +114,7 @@ class JobManager(ClusterManager):
         span_tracer: Tracer | None = None,
         metrics_snapshot_path: str | Path | None = None,
         dispatch_delay_fn=None,
+        output_base_directory: str | Path | None = None,
     ) -> None:
         super().__init__(
             host,
@@ -123,6 +124,7 @@ class JobManager(ClusterManager):
             span_tracer=span_tracer,
             metrics_snapshot_path=metrics_snapshot_path,
             dispatch_delay_fn=dispatch_delay_fn,
+            output_base_directory=output_base_directory,
         )
         self.config = config if config is not None else SchedulerConfig.from_env()
         self._runs: dict[str, JobRun] = {}  # job_id -> run, submit order
@@ -130,6 +132,7 @@ class JobManager(ClusterManager):
         self._running: list[str] = []  # running job_ids, admission order
         self._active_by_name: dict[str, JobRun] = {}
         self._draining = False
+        self._cancelling: set[str] = set()
         self._drain_stuck_since: float | None = None
         self._job_seq = 0
         self._started_serving = time.time()
@@ -235,30 +238,44 @@ class JobManager(ClusterManager):
         slots go back to the remaining jobs with no ghost assignments.
         """
         run = self._runs.get(job_id)
-        if run is None or run.status in (JOB_FINISHED, JOB_CANCELLED):
+        if (
+            run is None
+            or run.status in (JOB_FINISHED, JOB_CANCELLED)
+            or job_id in self._cancelling
+        ):
             return False
-        now = time.time()
         if run.status == JOB_QUEUED:
             self._admission.remove(job_id)
-            self._finish_run(run, JOB_CANCELLED, now)
+            self._finish_run(run, JOB_CANCELLED, time.time())
             return True
-        # RUNNING: deactivate FIRST so in-flight events/dispatches resolve
-        # to "defunct job" instead of mutating the frozen frame table.
-        self._running.remove(job_id)
-        self._active_by_name.pop(run.job_name, None)
-        self._finish_run(run, JOB_CANCELLED, now)
-        for worker in self.live_workers():
-            for frame in worker.queue.frames_for_job(run.job_name):
-                if frame.is_rendering:
-                    continue  # its finished event will sweep the mirror
-                try:
-                    await worker.unqueue_frame(run.job_name, frame.frame_index)
-                except Exception as e:  # noqa: BLE001 - worker failure mid-RPC
-                    logger.warning(
-                        "Cancel of %s: unqueue of frame %d on %08x failed: %s",
-                        job_id, frame.frame_index, worker.worker_id, e,
-                    )
-        return True
+        self._cancelling.add(job_id)
+        try:
+            # RUNNING: let the job's in-flight assembly stitches land
+            # BEFORE its name is released — a same-name resubmit must
+            # not race the old stitcher (reading a mixed tile set,
+            # unlinking the new job's tile files) on the shared output
+            # path. The await window is re-entry-safe via _cancelling.
+            await self.assembly.drain_job(run.job_name)
+            now = time.time()
+            # Deactivate so in-flight events/dispatches resolve to
+            # "defunct job" instead of mutating the frozen frame table.
+            self._running.remove(job_id)
+            self._active_by_name.pop(run.job_name, None)
+            self._finish_run(run, JOB_CANCELLED, now)
+            for worker in self.live_workers():
+                for frame in worker.queue.frames_for_job(run.job_name):
+                    if frame.is_rendering:
+                        continue  # its finished event will sweep the mirror
+                    try:
+                        await worker.unqueue_frame(run.job_name, frame.unit)
+                    except Exception as e:  # noqa: BLE001 - worker failure mid-RPC
+                        logger.warning(
+                            "Cancel of %s: unqueue of unit %s on %08x failed: %s",
+                            job_id, frame.unit.label, worker.worker_id, e,
+                        )
+            return True
+        finally:
+            self._cancelling.discard(job_id)
 
     def request_drain(self) -> None:
         """Stop admitting NEW submissions; serve() returns once every
@@ -271,7 +288,14 @@ class JobManager(ClusterManager):
         """Bind, run the scheduler until drained, collect worker traces."""
         await self._bind_server()
         try:
-            await self._scheduler_loop()
+            try:
+                await self._scheduler_loop()
+            finally:
+                # Tiled jobs: stitches scheduled by the last finished
+                # events may still be in flight when the loop drains —
+                # or when it RAISES; either way they must land, not be
+                # destroyed pending at teardown.
+                await self.assembly.drain()
             with self.span_tracer.span(
                 "collect traces", cat="master", track="job"
             ):
@@ -287,6 +311,18 @@ class JobManager(ClusterManager):
             dt, last = now - last, now
             await self._admit_ready_jobs(now)
             self._finalize_finished_jobs(now)
+            # A job whose unit exhausted its error budget (deterministic
+            # render failure — worker_handle sets failed_reason) must not
+            # spin redispatch forever: cancel it, releasing the pool.
+            for job_id in list(self._running):
+                run = self._runs[job_id]
+                if run.state is not None and run.state.failed_reason:
+                    logger.error(
+                        "Job %s failed: %s — cancelling.",
+                        job_id,
+                        run.state.failed_reason,
+                    )
+                    await self.cancel_job(job_id)
             if self._draining and not self._running and self._admission:
                 # Liveness under drain: a queued job whose worker barrier
                 # exceeds the live pool — with nothing running whose
@@ -439,7 +475,29 @@ class JobManager(ClusterManager):
     def _finalize_finished_jobs(self, now: float) -> None:
         for job_id in list(self._running):
             run = self._runs[job_id]
+            if (
+                run.state is not None
+                and run.state.all_frames_finished()
+                and self.assembly.has_pending(run.job_name)
+            ):
+                # A tiled job's last stitches are still writing: stay
+                # RUNNING (and keep the name reserved) until they land —
+                # a status poll must never say "finished" before the
+                # frame files exist, and a same-name resubmit must not
+                # race the old stitcher on the same output path. The
+                # next tick finalizes.
+                continue
             if run.state is not None and run.state.all_frames_finished():
+                # Ghost copies of units an accepted late result finished:
+                # nothing will render them now that the job is done, so
+                # sweep their mirror entries (and close their flows)
+                # before the job's name is released.
+                state = run.state
+                job_name = run.job_name
+                for worker in self.live_workers():
+                    worker.sweep_finished_units(
+                        lambda name: state if name == job_name else None
+                    )
                 self._running.remove(job_id)
                 self._active_by_name.pop(run.job_name, None)
                 self._finish_run(run, JOB_FINISHED, now)
@@ -570,7 +628,7 @@ class JobManager(ClusterManager):
                 return  # everything the job holds is already rendering
             victim, frame = found
             if not await preempt_frame(
-                run.spec.job, run.state, victim, frame.frame_index
+                run.spec.job, run.state, victim, frame.unit
             ):
                 return
             run.preemptions += 1

@@ -16,18 +16,28 @@ class RenderBackend(abc.ABC):
     performance reducer's monotonicity requirements
     (tpu_render_cluster/traces/performance.py).
 
+    Tiled jobs: when the job carries a tile grid, ``render_frame`` is
+    called once per ``(frame, tile)`` work unit with ``tile`` set — the
+    backend renders only that tile's pixel region and writes the tile
+    file (master/assembly.tile_file_path naming); the master stitches
+    the frame. Backends that cannot render sub-frame regions (the
+    Blender subprocess backend) must raise a clear error instead of
+    silently rendering the whole frame under a tile's name.
+
     Optional hint protocol: a backend may additionally define
-    ``note_upcoming_frames(job, frame_indices)``. Before each
-    ``render_frame`` the worker queue calls it (when present) with the
-    OTHER frames of the same job still queued locally — the honest
-    work-ahead visible to this worker. Backends that batch internally
-    (the tpu-raytrace ray-pool mode renders several queued frames in
-    one device program and serves later requests from its cache) key
-    off this hint; the one-frame-per-request wire contract is
+    ``note_upcoming_frames(job, units)``. Before each ``render_frame``
+    the worker queue calls it (when present) with the OTHER work units
+    (``jobs.tiles.WorkUnit``) of the same job still queued locally —
+    the honest work-ahead visible to this worker. Backends that batch
+    internally (the tpu-raytrace ray-pool mode renders several queued
+    frames in one device program and serves later requests from its
+    cache) key off this hint; the one-unit-per-request wire contract is
     unchanged, so masters and peers cannot tell a batching worker from
     a serial one.
     """
 
     @abc.abstractmethod
-    async def render_frame(self, job: BlenderJob, frame_index: int) -> FrameRenderTime:
+    async def render_frame(
+        self, job: BlenderJob, frame_index: int, tile: int | None = None
+    ) -> FrameRenderTime:
         ...

@@ -151,7 +151,17 @@ class BlenderBackend(RenderBackend):
             *self.append_arguments,
         ]
 
-    async def render_frame(self, job: BlenderJob, frame_index: int) -> FrameRenderTime:
+    async def render_frame(
+        self, job: BlenderJob, frame_index: int, tile: int | None = None
+    ) -> FrameRenderTime:
+        if tile is not None:
+            # Blender's CLI renders whole frames; rendering the full frame
+            # under a tile's name would make the master stitch N copies of
+            # it. The master reschedules the errored unit elsewhere.
+            raise RuntimeError(
+                "The Blender backend cannot render sub-frame tiles; "
+                "run tiled jobs on tpu-raytrace workers."
+            )
         project_file = self._resolve(job.project_file_path)
         render_script = self._resolve(job.render_script_path)
         if not project_file.is_file():

@@ -41,7 +41,9 @@ class FaultyBackend(RenderBackend):
         self._controller = controller
         self._ordinal = 0
 
-    async def render_frame(self, job: BlenderJob, frame_index: int) -> FrameRenderTime:
+    async def render_frame(
+        self, job: BlenderJob, frame_index: int, tile: int | None = None
+    ) -> FrameRenderTime:
         self._ordinal += 1
         ordinal = self._ordinal
         controller = self._controller
@@ -51,7 +53,7 @@ class FaultyBackend(RenderBackend):
         if controller.should_hang(ordinal):
             await asyncio.Event().wait()  # parked until the run tears down
         started = time.perf_counter()
-        timing = await self._inner.render_frame(job, frame_index)
+        timing = await self._inner.render_frame(job, frame_index, tile=tile)
         multiplier = controller.render_multiplier()
         if multiplier > 1.0:
             # Stretch the frame's wall time by the straggler factor; only

@@ -34,8 +34,12 @@ class MockBackend(RenderBackend):
         # workloads (animated scenes whose cost varies by frame index).
         self.render_seconds_fn = render_seconds_fn
         self.rendered_frames: list[int] = []
+        # (frame_index, tile) pairs, recorded only for tiled renders.
+        self.rendered_units: list[tuple[int, int | None]] = []
 
-    async def render_frame(self, job: BlenderJob, frame_index: int) -> FrameRenderTime:
+    async def render_frame(
+        self, job: BlenderJob, frame_index: int, tile: int | None = None
+    ) -> FrameRenderTime:
         started_process = time.time()
         await asyncio.sleep(self.load_seconds)
         finished_loading = time.time()
@@ -50,6 +54,7 @@ class MockBackend(RenderBackend):
         )
         await asyncio.sleep(render_seconds)
         finished_rendering = time.time()
+        self.rendered_units.append((frame_index, tile))
         saving_started = time.time()
         await asyncio.sleep(self.save_seconds)
         saving_finished = time.time()

@@ -64,29 +64,34 @@ class TpuRaytraceBackend(RenderBackend):
         # pool batch, serving later requests from the cache below.
         # Worker-internal only: one frame per request on the wire.
         self.raypool = raypool
-        self._upcoming: dict[str, tuple[int, ...]] = {}
-        # (job_name, frame_index) -> linear image rendered ahead by a pool
-        # batch. Bounded BY BYTES: stale entries (stolen/removed frames we
-        # rendered ahead of) are evicted oldest-first.
-        self._raypool_cache: dict[tuple[str, int], object] = {}
+        # Work units (jobs.tiles.WorkUnit) of each job still queued here.
+        self._upcoming: dict[str, tuple] = {}
+        # (job_name, frame_index, tile) -> linear image rendered ahead by
+        # a pool batch. Bounded BY BYTES: stale entries (stolen/removed
+        # units we rendered ahead of) are evicted oldest-first.
+        self._raypool_cache: dict[tuple[str, int, int | None], object] = {}
 
     # Staleness backstop, not a working-set budget: live entries drain
     # within one pool window of requests, so anything pushing the cache
     # past this is stolen/removed frames.
     _RAYPOOL_CACHE_MAX_BYTES = 64 * 1024 * 1024
 
-    def note_upcoming_frames(
-        self, job: BlenderJob, frame_indices: tuple[int, ...]
-    ) -> None:
-        """Queue hint (RenderBackend hint protocol): same-job frames still
-        queued on this worker, i.e. what a pool batch may render ahead.
+    def note_upcoming_frames(self, job: BlenderJob, units: tuple) -> None:
+        """Queue hint (RenderBackend hint protocol): same-job work units
+        still queued on this worker, i.e. what a pool batch may render
+        ahead (same-tile units of other frames, for tiled jobs).
 
         An empty hint drops the job's entry — the map tracks only jobs
         with outstanding local work, so a long-lived worker's job history
-        doesn't accumulate here.
+        doesn't accumulate here. Bare ints are accepted as whole-frame
+        units (the pre-tiling call shape).
         """
-        if frame_indices:
-            self._upcoming[job.job_name] = tuple(frame_indices)
+        if units:
+            from tpu_render_cluster.jobs.tiles import WorkUnit
+
+            self._upcoming[job.job_name] = tuple(
+                WorkUnit(u) if isinstance(u, int) else u for u in units
+            )
         else:
             self._upcoming.pop(job.job_name, None)
 
@@ -187,8 +192,10 @@ class TpuRaytraceBackend(RenderBackend):
                 )(1)
             )
 
-    async def render_frame(self, job: BlenderJob, frame_index: int) -> FrameRenderTime:
-        return await asyncio.to_thread(self._render_sync, job, frame_index)
+    async def render_frame(
+        self, job: BlenderJob, frame_index: int, tile: int | None = None
+    ) -> FrameRenderTime:
+        return await asyncio.to_thread(self._render_sync, job, frame_index, tile)
 
     def _trim_raypool_cache(self) -> None:
         """Evict oldest rendered-ahead frames past the byte cap (stale
@@ -245,16 +252,39 @@ class TpuRaytraceBackend(RenderBackend):
         if execute_seconds > 0:
             render_fps_gauge(registry).set(1.0 / execute_seconds)
 
-    def _render_sync(self, job: BlenderJob, frame_index: int) -> FrameRenderTime:
+    def _render_sync(
+        self, job: BlenderJob, frame_index: int, tile: int | None = None
+    ) -> FrameRenderTime:
         import numpy as np
 
-        from tpu_render_cluster.render.image_io import output_path_for_frame, write_image
+        from tpu_render_cluster.render.image_io import (
+            output_path_for_frame,
+            output_path_for_tile,
+            write_image,
+        )
         from tpu_render_cluster.render.integrator import fused_frame_renderer, tonemap
         from tpu_render_cluster.render.scene import scene_for_job_name
 
         started_process_at = time.time()
 
         scene_name = scene_for_job_name(job.job_name)
+        # Tiled work unit: resolve the tile's pixel region once. All three
+        # execution tiers below serve it through their region paths, which
+        # trace the FULL frame's rays/RNG restricted to these pixels — a
+        # master-assembled grid of tiles is pixel-identical to the
+        # whole-frame render (render/integrator.region_rays_and_seed).
+        region = None
+        if tile is not None:
+            from tpu_render_cluster.jobs.tiles import tile_bounds
+
+            if job.tile_grid is None:
+                raise RuntimeError(
+                    f"Tile {tile} requested but job {job.job_name!r} "
+                    "carries no tile grid."
+                )
+            region = tile_bounds(
+                tile, job.tile_grid, width=self.width, height=self.height
+            )
         # "Loading" = fetching (or first-building) the compiled renderer for
         # this scene/config — the analog of Blender's .blend load phase.
         # Scene construction itself is fused into the XLA program: one
@@ -264,13 +294,16 @@ class TpuRaytraceBackend(RenderBackend):
         # programs compile lazily inside the render — warm() pre-visits
         # them), so its loading phase is just scene-name resolution; same
         # for the ray-pool path (one pool program per config, warmed).
-        cache_key = (job.job_name, frame_index)
+        cache_key = (job.job_name, frame_index, tile)
         cached_linear = self._raypool_cache.pop(cache_key, None)
+        # Work-ahead for a pool batch: same-job units still queued HERE
+        # with the SAME tile (a pool batch spans frames, not regions).
         upcoming = [
-            f
-            for f in self._upcoming.get(job.job_name, ())
-            if f != frame_index
-            and (job.job_name, f) not in self._raypool_cache
+            u.frame_index
+            for u in self._upcoming.get(job.job_name, ())
+            if u.tile == tile
+            and u.frame_index != frame_index
+            and (job.job_name, u.frame_index, tile) not in self._raypool_cache
         ]
         use_raypool = cached_linear is None and self._use_raypool(
             scene_name, frames_ahead=len(upcoming)
@@ -280,11 +313,13 @@ class TpuRaytraceBackend(RenderBackend):
             and not use_raypool
             and self._use_wavefront(scene_name)
         )
+        use_sharded = self.sharding in ("tile", "spp") and region is None
         if (
-            self.sharding not in ("tile", "spp")
+            not use_sharded
             and cached_linear is None
             and not use_wavefront
             and not use_raypool
+            and region is None
         ):
             renderer = fused_frame_renderer(
                 scene_name,
@@ -302,7 +337,7 @@ class TpuRaytraceBackend(RenderBackend):
             # carried by the frame that triggered it — per-frame phase
             # timings under batching reflect that amortization.
             display = tonemap(cached_linear)
-        elif self.sharding in ("tile", "spp"):
+        elif use_sharded:
             from tpu_render_cluster.parallel.sharded_render import render_frame_sharded
 
             linear = render_frame_sharded(
@@ -321,9 +356,9 @@ class TpuRaytraceBackend(RenderBackend):
                 render_batch_raypool,
             )
 
-            # One pool window: this frame plus the next queued frames of
-            # the same job (the queue's hint — all assigned to THIS
-            # worker, so nothing is rendered speculatively). Frames
+            # One pool window: this unit plus the next queued same-tile
+            # frames of the same job (the queue's hint — all assigned to
+            # THIS worker, so nothing is rendered speculatively). Units
             # rendered ahead are served from the cache on their own
             # requests.
             batch = [frame_index] + upcoming[: raypool_frame_cap() - 1]
@@ -334,17 +369,57 @@ class TpuRaytraceBackend(RenderBackend):
                 height=self.height,
                 samples=self.samples,
                 max_bounces=self.max_bounces,
+                region=region,
             )
             for ahead_frame, image in zip(batch[1:], images[1:]):
-                self._raypool_cache[(job.job_name, ahead_frame)] = image
+                self._raypool_cache[(job.job_name, ahead_frame, tile)] = image
             self._trim_raypool_cache()
             display = tonemap(images[0])
         elif use_wavefront:
-            from tpu_render_cluster.render.compaction import render_frame_wavefront
+            from tpu_render_cluster.render.compaction import (
+                render_frame_wavefront,
+                render_region_wavefront,
+            )
 
-            linear = render_frame_wavefront(
+            if region is None:
+                linear = render_frame_wavefront(
+                    scene_name,
+                    frame_index,
+                    width=self.width,
+                    height=self.height,
+                    samples=self.samples,
+                    max_bounces=self.max_bounces,
+                )
+            else:
+                y0, x0, tile_height, tile_width = region
+                linear = render_region_wavefront(
+                    scene_name,
+                    frame_index,
+                    y0=y0,
+                    x0=x0,
+                    tile_height=tile_height,
+                    tile_width=tile_width,
+                    width=self.width,
+                    height=self.height,
+                    samples=self.samples,
+                    max_bounces=self.max_bounces,
+                )
+            display = tonemap(linear)
+        elif region is not None:
+            # Masked tier, one tile: the jitted region program (one
+            # compile per tile shape; y0/x0/frame are traced). Local
+            # tile/spp sharding is bypassed for cluster-tile units — the
+            # unit is already sub-frame work.
+            from tpu_render_cluster.render.integrator import render_frame_region
+
+            y0, x0, tile_height, tile_width = region
+            linear = render_frame_region(
                 scene_name,
                 frame_index,
+                y0=y0,
+                x0=x0,
+                tile_height=tile_height,
+                tile_width=tile_width,
                 width=self.width,
                 height=self.height,
                 samples=self.samples,
@@ -365,13 +440,29 @@ class TpuRaytraceBackend(RenderBackend):
         output_directory = parse_with_base_directory_prefix(
             job.output_directory_path, self.base_directory
         )
-        path = output_path_for_frame(
-            output_directory,
-            job.output_file_name_format,
-            job.output_file_format,
-            frame_index,
+        if tile is None:
+            path = output_path_for_frame(
+                output_directory,
+                job.output_file_name_format,
+                job.output_file_format,
+                frame_index,
+            )
+        else:
+            # One tile file per unit; the master's assembly service
+            # stitches the grid into the frame file and removes these.
+            # Always PNG (lossless — see image_io.output_path_for_tile);
+            # the assembler encodes the final frame in the job's format.
+            path = output_path_for_tile(
+                output_directory,
+                job.output_file_name_format,
+                job.output_file_format,
+                frame_index,
+                tile,
+                job.tile_grid,
+            )
+        write_image(
+            path, pixels, "PNG" if tile is not None else job.output_file_format
         )
-        write_image(path, pixels, job.output_file_format)
         file_saving_finished_at = time.time()
 
         self._observe_render_obs(

@@ -21,6 +21,7 @@ import time
 from dataclasses import dataclass, field
 
 from tpu_render_cluster.jobs.models import BlenderJob
+from tpu_render_cluster.jobs.tiles import WorkUnit
 from tpu_render_cluster.obs import MetricsRegistry, Tracer
 from tpu_render_cluster.protocol import messages as pm
 from tpu_render_cluster.transport.actors import SenderHandle
@@ -57,6 +58,13 @@ class QueuedFrame:
     # Scheduler job id from the queue-add request (None from single-job
     # masters); echoed on rendering/finished events.
     job_id: str | None = None
+    # Sub-frame tile index from the queue-add request (None = whole
+    # frame); echoed on rendering/finished events.
+    tile: int | None = None
+
+    @property
+    def unit(self) -> WorkUnit:
+        return WorkUnit(self.frame_index, self.tile)
 
 
 class WorkerAutomaticQueue:
@@ -88,7 +96,7 @@ class WorkerAutomaticQueue:
             else None
         )
         self._frames: list[QueuedFrame] = []
-        self._finished_indices: set[tuple[str, int]] = set()
+        self._finished_indices: set[tuple[str, int, int | None]] = set()
         self._task: asyncio.Task | None = None
         self._draining = False
         # Wakes the render loop as soon as work arrives; the 100 ms sleep
@@ -105,6 +113,7 @@ class WorkerAutomaticQueue:
         *,
         trace: pm.TraceContext | None = None,
         job_id: str | None = None,
+        tile: int | None = None,
     ) -> None:
         if self._draining:
             # Refuse, don't silently park: the add RPC answers errored and
@@ -112,19 +121,28 @@ class WorkerAutomaticQueue:
             # accepted here after drain() collected the queue would be lost.
             raise RuntimeError("Worker is draining; not accepting new frames.")
         self._frames.append(
-            QueuedFrame(job, frame_index, trace=trace, job_id=job_id)
+            QueuedFrame(job, frame_index, trace=trace, job_id=job_id, tile=tile)
         )
         self._work_available.set()
 
-    def unqueue_frame(self, job_name: str, frame_index: int) -> str:
+    def unqueue_frame(
+        self, job_name: str, frame_index: int, tile: int | None = None
+    ) -> str:
         """Returns the frame-queue-remove result enum wire value.
 
-        Reference: worker/src/rendering/queue.rs:192-229.
+        Reference: worker/src/rendering/queue.rs:192-229. ``tile`` rides
+        the same optional piggyback as queue-add: a tiled steal removes
+        exactly one tile, and whole-frame requests (tile None) only ever
+        match whole-frame entries.
         """
-        if (job_name, frame_index) in self._finished_indices:
+        if (job_name, frame_index, tile) in self._finished_indices:
             return pm.FRAME_QUEUE_REMOVE_RESULT_ALREADY_FINISHED
         for i, frame in enumerate(self._frames):
-            if frame.job.job_name == job_name and frame.frame_index == frame_index:
+            if (
+                frame.job.job_name == job_name
+                and frame.frame_index == frame_index
+                and frame.tile == tile
+            ):
                 if frame.state is FrameState.RENDERING:
                     return pm.FRAME_QUEUE_REMOVE_RESULT_ALREADY_RENDERING
                 if frame.state is FrameState.FINISHED:
@@ -151,7 +169,7 @@ class WorkerAutomaticQueue:
         while any(f.state is FrameState.RENDERING for f in self._frames):
             await asyncio.sleep(0.01)
         returned = [
-            (f.job.job_name, f.frame_index)
+            (f.job.job_name, f.unit)
             for f in self._frames
             if f.state is FrameState.QUEUED
         ]
@@ -203,7 +221,7 @@ class WorkerAutomaticQueue:
             note_upcoming(
                 frame.job,
                 tuple(
-                    f.frame_index
+                    f.unit
                     for f in self._frames
                     if f.state is FrameState.QUEUED
                     and f.job.job_name == job_name
@@ -212,13 +230,15 @@ class WorkerAutomaticQueue:
         await self._sender.send_message(
             pm.WorkerFrameQueueItemRenderingEvent(
                 job_name, frame.frame_index, trace=frame.trace,
-                job_id=frame.job_id,
+                job_id=frame.job_id, tile=frame.tile,
             )
         )
         try:
-            timing = await self._backend.render_frame(frame.job, frame.frame_index)
+            timing = await self._backend.render_frame(
+                frame.job, frame.frame_index, tile=frame.tile
+            )
         except Exception as e:  # noqa: BLE001 - report, don't hang the master
-            logger.error("Frame %d render failed: %s", frame.frame_index, e)
+            logger.error("Unit %s render failed: %s", frame.unit.label, e)
             if self._metrics is not None:
                 self._metrics.counter(
                     "worker_frames_errored_total", "Frames that failed to render"
@@ -230,18 +250,18 @@ class WorkerAutomaticQueue:
             await self._sender.send_message(
                 pm.WorkerFrameQueueItemFinishedEvent.new_errored(
                     job_name, frame.frame_index, str(e), trace=frame.trace,
-                    job_id=frame.job_id,
+                    job_id=frame.job_id, tile=frame.tile,
                 )
             )
             return
         self._tracer.trace_new_rendered_frame(frame.frame_index, timing)
         self._observe_frame_phases(frame, timing)
         self._remove(frame)
-        self._finished_indices.add((job_name, frame.frame_index))
+        self._finished_indices.add((job_name, frame.frame_index, frame.tile))
         await self._sender.send_message(
             pm.WorkerFrameQueueItemFinishedEvent.new_ok(
                 job_name, frame.frame_index, trace=frame.trace,
-                job_id=frame.job_id,
+                job_id=frame.job_id, tile=frame.tile,
             )
         )
 
@@ -267,6 +287,8 @@ class WorkerAutomaticQueue:
                 self._phase_histogram.observe(duration, phase=phase)
             if self._span_tracer is not None:
                 args = {"frame": frame.frame_index}
+                if frame.tile is not None:
+                    args["tile"] = frame.tile
                 if frame.trace is not None:
                     args["flow"] = frame.trace.flow_id
                 self._span_tracer.complete(
@@ -282,13 +304,16 @@ class WorkerAutomaticQueue:
                     # (mid-span so it binds even to zero-length phases):
                     # the master's assign span started it; its
                     # result-received span will terminate it.
+                    flow_args = {"frame": frame.frame_index, "phase": phase}
+                    if frame.tile is not None:
+                        flow_args["tile"] = frame.tile
                     self._span_tracer.flow_step(
                         "frame",
                         id=frame.trace.flow_id,
                         ts=start + duration / 2.0,
                         cat="frame",
                         track="frames",
-                        args={"frame": frame.frame_index, "phase": phase},
+                        args=flow_args,
                     )
         if self._metrics is not None:
             self._metrics.counter(

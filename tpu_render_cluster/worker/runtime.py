@@ -237,7 +237,7 @@ class Worker:
                 try:
                     frame_queue.queue_frame(
                         request.job, request.frame_index, trace=request.trace,
-                        job_id=request.job_id,
+                        job_id=request.job_id, tile=request.tile,
                     )
                     self.tracer.increment_total_queued_frames()
                     response = pm.WorkerFrameQueueAddResponse.new_ok(
@@ -253,7 +253,7 @@ class Worker:
             while True:
                 request = await remove_queue.get()
                 result = frame_queue.unqueue_frame(
-                    request.job_name, request.frame_index
+                    request.job_name, request.frame_index, request.tile
                 )
                 if result == pm.FRAME_QUEUE_REMOVE_RESULT_REMOVED:
                     self.tracer.increment_total_frames_removed_from_queue()
@@ -319,7 +319,14 @@ class Worker:
                 pm.WorkerGoodbyeEvent(
                     reason="drain",
                     job_name=job_name,
-                    returned_frames=tuple(index for _, index in returned),
+                    returned_frames=tuple(
+                        unit.frame_index for _, unit in returned
+                    ),
+                    returned_tiles=(
+                        tuple(unit.tile for _, unit in returned)
+                        if any(unit.tile is not None for _, unit in returned)
+                        else None
+                    ),
                 )
             )
             logger.info(
