@@ -599,6 +599,139 @@ def multi_job_bench(
     return record
 
 
+def speculation_bench(
+    workers: int = 3,
+    frames: int = 24,
+    reps: int = 5,
+    seed: int = 1205,
+    straggler_multiplier: float = 6.0,
+    render_seconds: float = 0.12,
+) -> dict:
+    """Speculation-on vs -off on a seeded tail-heavy straggler workload.
+
+    The workload is the chaos harness's real cluster stack (dynamic
+    work-stealing strategy, real localhost WebSockets, mock renders)
+    under a deterministic seeded fault plan that makes ``workers - 1``
+    of the workers ``straggler_multiplier``x slow — the recorded
+    heterogeneous/tail-heavy shape where the makespan is gated by the
+    last unit rendering on a straggler and stealing cannot help (a
+    RENDERING unit cannot be unqueued). Speculation-on runs add
+    ``TRC_SPECULATION=1``: the predicted/overdue tail unit is duplicated
+    onto the fastest idle worker and the first result wins through the
+    dedup ledger.
+
+    Measured per run: the job makespan and the EXACT p99 of per-unit
+    winning-result latencies (state.unit_seconds). ``reps`` interleaved
+    off/on repetitions, median per mode (the bench-variance protocol:
+    this host measures +-30% run-to-run, so only interleaved
+    median-of-reps A/B timings are meaningful). EVERY run — both modes —
+    must pass the full chaos invariant audit (exactly-once ledger, no
+    ghost mirrors, valid merged trace); a violation fails the bench.
+    """
+    import statistics
+
+    from tpu_render_cluster.chaos.plan import ChaosTimings, FaultEvent, FaultPlan
+    from tpu_render_cluster.chaos.runner import run_chaos_job
+
+    # Deterministic pure-data plan (fingerprinted in the record): every
+    # slot but the last renders straggler_multiplier-x slow.
+    plan = FaultPlan(
+        seed=seed,
+        workers=workers,
+        events=tuple(
+            FaultEvent(
+                kind="slow_render",
+                target=slot,
+                multiplier=straggler_multiplier,
+            )
+            for slot in range(workers - 1)
+        ),
+        timings=ChaosTimings(),
+    )
+
+    spec_env = {
+        "TRC_SPECULATION": None,  # set per run
+        "TRC_SPEC_THRESHOLD": "1.5",
+        "TRC_SPEC_MIN_SAMPLES": "2",
+    }
+
+    def run_once(spec_on: bool) -> tuple[float, float, dict | None]:
+        saved = {name: os.environ.get(name) for name in spec_env}
+        os.environ.update(
+            {name: value for name, value in spec_env.items() if value}
+        )
+        os.environ["TRC_SPECULATION"] = "1" if spec_on else "0"
+        try:
+            report = run_chaos_job(
+                plan, frames=frames, render_seconds=render_seconds, timeout=180.0
+            )
+        finally:
+            for name, value in saved.items():
+                if value is None:
+                    os.environ.pop(name, None)
+                else:
+                    os.environ[name] = value
+        if not report.ok:
+            raise RuntimeError(
+                f"chaos audit failed (speculation={'on' if spec_on else 'off'}): "
+                f"{report.violations}"
+            )
+        return (
+            float(report.stats["job_seconds"]),
+            float(report.stats["unit_latency"].get("p99_s", 0.0)),
+            report.stats.get("speculation"),
+        )
+
+    makespans: dict[str, list[float]] = {"off": [], "on": []}
+    p99s: dict[str, list[float]] = {"off": [], "on": []}
+    speculation_views: list[dict] = []
+    for _rep in range(reps):
+        # Interleaved A/B: machine-load drift cancels across modes.
+        makespan, p99, _ = run_once(False)
+        makespans["off"].append(makespan)
+        p99s["off"].append(p99)
+        makespan, p99, view = run_once(True)
+        makespans["on"].append(makespan)
+        p99s["on"].append(p99)
+        if view is not None:
+            speculation_views.append(view)
+    launched = sum(v.get("launched", 0) for v in speculation_views)
+    outcomes: dict[str, int] = {}
+    for view in speculation_views:
+        for outcome, count in (view.get("outcomes") or {}).items():
+            outcomes[outcome] = outcomes.get(outcome, 0) + int(count)
+    record = {
+        "metric": (
+            f"speculative tail-unit re-execution: {frames} frames, "
+            f"{workers} workers ({workers - 1} stragglers "
+            f"{straggler_multiplier}x slow), seeded chaos stack"
+        ),
+        "unit": "seconds (median of interleaved reps)",
+        "workers": workers,
+        "frames": frames,
+        "reps": reps,
+        "plan_fingerprint": plan.fingerprint(),
+        "straggler_multiplier": straggler_multiplier,
+        "render_seconds": render_seconds,
+        "audits": "every run (both modes) passed the full chaos "
+        "invariant audit incl. ok_results - duplicate_results == "
+        "units_total",
+        "makespan_off_s": round(statistics.median(makespans["off"]), 4),
+        "makespan_on_s": round(statistics.median(makespans["on"]), 4),
+        "unit_p99_off_s": round(statistics.median(p99s["off"]), 4),
+        "unit_p99_on_s": round(statistics.median(p99s["on"]), 4),
+        "speculations_launched": launched,
+        "speculation_outcomes": outcomes,
+    }
+    record["makespan_speedup"] = round(
+        record["makespan_off_s"] / record["makespan_on_s"], 3
+    )
+    record["unit_p99_speedup"] = round(
+        record["unit_p99_off_s"] / record["unit_p99_on_s"], 3
+    )
+    return record
+
+
 def tile_scaling_bench(
     workers_list: tuple[int, ...] = (1, 2, 4),
     reps: int = 5,
@@ -834,6 +967,26 @@ def main() -> int:
             os.path.dirname(os.path.abspath(__file__)),
             "results",
             "SCHED_BENCH.json",
+        )
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
+        return 0
+
+    if "--speculation" in sys.argv:
+        workers = _int_flag("--workers", 3)
+        frames = _int_flag("--frames", 24)
+        reps = _int_flag("--reps", 5)
+        record = speculation_bench(workers=workers, frames=frames, reps=reps)
+        record["command"] = (
+            f"python bench.py --speculation --workers {workers} "
+            f"--frames {frames} --reps {reps}"
+        )
+        print(json.dumps(record))
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "results",
+            "SPEC_BENCH.json",
         )
         with open(out_path, "w", encoding="utf-8") as f:
             json.dump(record, f, indent=1)

@@ -114,3 +114,66 @@ def test_apply_resume_full_job_short_circuits(tmp_path):
     state = ClusterManagerState(job)
     assert apply_resume(state, job) == 4
     assert state.all_frames_finished()
+
+
+# ---------------------------------------------------------------------------
+# Cost-model snapshot restore (ISSUE 8 satellite): a resumed master warms
+# its predictors from the previous run's snapshot instead of cold-starting.
+
+
+def test_cost_model_snapshot_round_trip(tmp_path):
+    from tpu_render_cluster.master.persist import save_cost_model
+    from tpu_render_cluster.master.resume import load_cost_model
+    from tpu_render_cluster.sched.cost_model import JointCostModel
+
+    job = _job(tmp_path)
+    results = tmp_path / "results"
+    model = JointCostModel(alpha=0.5)
+    # A cold model is never snapshotted (it would overwrite a learned one
+    # with nothing), and a missing snapshot resumes cold.
+    assert save_cost_model(job, results, model) is None
+    assert load_cost_model(job, results) is None
+    model.observe(0x77, 3, 1.5)
+    model.observe(0x88, 3, 6.0)
+    path = save_cost_model(job, results, model)
+    assert path is not None and path.is_file()
+    restored = load_cost_model(job, results)
+    assert restored is not None
+    for worker in (0x77, 0x88):
+        assert restored.predict_unit_seconds(worker, 3) == (
+            model.predict_unit_seconds(worker, 3)
+        )
+    assert restored.samples_observed == model.samples_observed
+
+
+def test_cost_model_snapshot_corrupt_resumes_cold(tmp_path):
+    from tpu_render_cluster.master.persist import cost_model_snapshot_path
+    from tpu_render_cluster.master.resume import load_cost_model
+
+    job = _job(tmp_path)
+    results = tmp_path / "results"
+    path = cost_model_snapshot_path(job, results)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("{not json", encoding="utf-8")
+    assert load_cost_model(job, results) is None  # degrade, never crash
+
+
+def test_explicit_trc_cost_model_wins_over_snapshot(tmp_path, monkeypatch):
+    """TRC_COST_MODEL precedence: a snapshot exists, but with the env var
+    set the resume restore stands down (the explicit model was already
+    loaded at master construction and must not be overwritten)."""
+    from tpu_render_cluster.master.persist import save_cost_model
+    from tpu_render_cluster.master.resume import load_cost_model
+    from tpu_render_cluster.sched.cost_model import JointCostModel
+
+    monkeypatch.delenv("TRC_COST_MODEL", raising=False)
+    job = _job(tmp_path, frames=2)
+    results = tmp_path / "results"
+    model = JointCostModel(alpha=0.5)
+    model.observe(0x42, 1, 2.0)
+    save_cost_model(job, results, model)
+    restored = load_cost_model(job, results)
+    assert restored is not None and restored.worker_speed.has_history(0x42)
+    monkeypatch.setenv("TRC_COST_MODEL", str(tmp_path / "explicit.json"))
+    assert load_cost_model(job, results) is None
+    assert load_cost_model(job, results, respect_env=False) is not None

@@ -784,3 +784,73 @@ def test_multi_job_chaos_randomized_sweep(seed):
     plan = FaultPlan.generate(seed, 4, drains=1)
     report = run_chaos_multi_job(plan, jobs=3, frames=10, timeout=240.0)
     assert report.ok, report.violations
+
+
+# ---------------------------------------------------------------------------
+# Tile-aware auction pricing (ISSUE 8 satellite): a (frame, tile) unit is
+# priced at its pixel share of the frame, not the whole frame's cost.
+
+
+def test_unit_complexity_map_scales_tiles_by_pixel_fraction():
+    from tpu_render_cluster.jobs.tiles import WorkUnit
+    from tpu_render_cluster.master.tpu_batch import (
+        FrameComplexityModel,
+        unit_complexity_map,
+    )
+
+    complexity_model = FrameComplexityModel(alpha=1.0)
+    complexity_model.observe(7, 2.0)
+    whole = unit_complexity_map([WorkUnit(7)], complexity_model, None)
+    tiles = unit_complexity_map(
+        [WorkUnit(7, t) for t in range(4)], complexity_model, (2, 2)
+    )
+    assert whole[WorkUnit(7)] == pytest.approx(2.0)
+    assert tiles[WorkUnit(7, 0)] == pytest.approx(0.5)
+    # The grid's tiles sum back to exactly the whole frame's work.
+    assert sum(tiles.values()) == pytest.approx(whole[WorkUnit(7)])
+
+
+def test_build_cost_matrix_prices_tiles_at_their_fraction():
+    from tpu_render_cluster.jobs.tiles import WorkUnit
+    from tpu_render_cluster.master.tpu_batch import (
+        FrameComplexityModel,
+        WorkerCostModel,
+        build_cost_matrix,
+        unit_complexity_map,
+    )
+
+    class _StubQueue(list):
+        def all_frames(self):
+            return list(self)
+
+    class _StubWorker:
+        def __init__(self, worker_id):
+            self.worker_id = worker_id
+            self.queue = _StubQueue()
+
+    speed = WorkerCostModel(alpha=1.0)
+    speed.observe(1, 0.1)
+    complexity_model = FrameComplexityModel(alpha=1.0)
+    complexity_model.observe(7, 2.0)
+    worker = _StubWorker(1)
+    whole_unit, tile_unit = WorkUnit(7), WorkUnit(7, 0)
+    whole_cost = build_cost_matrix(
+        [whole_unit],
+        [(worker, 0)],
+        speed,
+        frame_complexity=unit_complexity_map(
+            [whole_unit], complexity_model, None
+        ),
+    )
+    tile_cost = build_cost_matrix(
+        [tile_unit],
+        [(worker, 0)],
+        speed,
+        frame_complexity=unit_complexity_map(
+            [tile_unit], complexity_model, (2, 2)
+        ),
+    )
+    assert whole_cost[0, 0] == pytest.approx(0.1 * 2.0)
+    # Regression: this used to equal the whole frame's cost (tile-blind
+    # pricing uniformly overpriced tiled jobs by the tile count).
+    assert tile_cost[0, 0] == pytest.approx(whole_cost[0, 0] / 4.0)

@@ -1,0 +1,553 @@
+"""Predictive-scheduling suite (sched/cost_model.py + master/speculate.py).
+
+Fast deterministic tier-1 subset (marked ``spec``):
+
+- cost-model units: joint fit, pixel-fraction normalization, the ridge
+  complexity-curve prior, serialize/save/load round-trips, env loading;
+- cost-aware WFQ units: predicted-seconds load beats unit counts;
+- speculation trigger units: the pure tail-candidate selection;
+- e2e: a real in-process cluster with a deterministic straggler —
+  the speculative twin wins, the loser's copy is absorbed exactly-once,
+  no ghost mirrors — plus a seeded straggler chaos run with speculation
+  enabled whose full invariant audit must stay green.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tpu_render_cluster.chaos.invariants import check_job_invariants
+from tpu_render_cluster.harness.local import _run_local_job_full
+from tpu_render_cluster.jobs.models import BlenderJob, DistributionStrategy
+from tpu_render_cluster.jobs.tiles import WorkUnit, tile_pixel_fraction
+from tpu_render_cluster.master.speculate import (
+    InFlightUnit,
+    SpeculationConfig,
+    select_speculation_candidate,
+)
+from tpu_render_cluster.sched import fair_share
+from tpu_render_cluster.sched.cost_model import (
+    ComplexityCurve,
+    CostModelService,
+    JointCostModel,
+    TraceSample,
+    fit_cost_model,
+    load_cost_model_from_env,
+    samples_from_cluster_trace,
+)
+from tpu_render_cluster.worker.backends.mock import MockBackend
+
+pytestmark = pytest.mark.spec
+
+FAST, SLOW = 0x11, 0x22
+
+
+# ---------------------------------------------------------------------------
+# Cost model
+
+
+def _heterogeneous_samples(frames=range(1, 25)) -> list[TraceSample]:
+    ramp = lambda f: 1.0 + f / 12.0  # noqa: E731
+    out = []
+    for frame in frames:
+        out.append(TraceSample(FAST, frame, 0.01 * ramp(frame)))
+        out.append(TraceSample(SLOW, frame, 0.08 * ramp(frame)))
+    return out
+
+
+def test_fit_recovers_speed_gap_and_ramp():
+    model = fit_cost_model(_heterogeneous_samples())
+    ratio = model.worker_speed.predict(SLOW) / model.worker_speed.predict(FAST)
+    assert ratio == pytest.approx(8.0, rel=0.2)
+    predictions = [model.frame_complexity.predict(f) for f in (2, 10, 18, 24)]
+    assert predictions == sorted(predictions), "ramp shape lost"
+
+
+def test_fitted_curve_predicts_unseen_frames():
+    # Train on frames 1..24, predict 40: interpolation alone would clamp
+    # to the edge value; the ridge curve extrapolates the ramp upward.
+    model = fit_cost_model(_heterogeneous_samples())
+    assert model.frame_complexity.curve is not None
+    edge = model.frame_complexity.predict(24)
+    beyond = model.frame_complexity.predict(40)
+    assert beyond > edge * 1.05
+
+
+def test_curve_only_model_predicts_from_prior():
+    curve = ComplexityCurve.fit([0, 10, 20], [1.0, 2.0, 3.0], degree=1)
+    from tpu_render_cluster.sched.cost_model import FrameComplexityModel
+
+    model = FrameComplexityModel()
+    model.curve = curve
+    assert model.predict(10) == pytest.approx(2.0, rel=0.05)
+    # An online observation wins over the prior at its own frame.
+    model.observe(10, 9.0)
+    assert model.predict(10) == pytest.approx(9.0)
+
+
+def test_pixel_fraction_normalizes_tiled_observations():
+    model = JointCostModel(alpha=1.0)
+    # A quarter-frame tile took 1 s -> the whole frame costs ~4 s.
+    model.observe(FAST, 5, 1.0, pixel_fraction=0.25)
+    whole = model.predict_unit_seconds(FAST, 5)
+    quarter = model.predict_unit_seconds(FAST, 5, pixel_fraction=0.25)
+    assert whole == pytest.approx(4.0, rel=1e-6)
+    assert quarter == pytest.approx(1.0, rel=1e-6)
+
+
+def test_serialize_round_trip(tmp_path):
+    model = fit_cost_model(_heterogeneous_samples())
+    model.observe(FAST, 3, 0.5, scene="sceneB.blend")
+    path = model.save(tmp_path / "model.json")
+    restored = JointCostModel.load(path)
+    for frame in (1, 7, 24, 40):
+        assert restored.predict_unit_seconds(
+            SLOW, frame
+        ) == pytest.approx(model.predict_unit_seconds(SLOW, frame))
+    assert restored.predict_unit_seconds(
+        FAST, 3, scene="sceneB.blend"
+    ) == pytest.approx(model.predict_unit_seconds(FAST, 3, scene="sceneB.blend"))
+    assert restored.samples_observed == model.samples_observed
+    assert set(restored.scenes()) == set(model.scenes())
+
+
+def test_env_loading(tmp_path, monkeypatch):
+    monkeypatch.delenv("TRC_COST_MODEL", raising=False)
+    assert load_cost_model_from_env() is None
+    monkeypatch.setenv("TRC_COST_MODEL", str(tmp_path / "missing.json"))
+    assert load_cost_model_from_env() is None  # degrade, never crash
+    model = fit_cost_model(_heterogeneous_samples())
+    path = model.save(tmp_path / "model.json")
+    monkeypatch.setenv("TRC_COST_MODEL", str(path))
+    loaded = load_cost_model_from_env()
+    assert loaded is not None
+    assert loaded.worker_speed.has_history(SLOW)
+
+
+def test_samples_from_cluster_trace():
+    document = {
+        "traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": 7,
+             "args": {"name": f"worker-{FAST:08x}"}},
+            {"ph": "X", "name": "render", "cat": "worker", "pid": 7,
+             "ts": 0, "dur": 2_000_000, "args": {"frame": 3}},
+            {"ph": "X", "name": "render", "cat": "worker", "pid": 7,
+             "ts": 0, "dur": 500_000, "args": {"frame": 4, "tile": 0}},
+            {"ph": "X", "name": "render", "cat": "worker", "pid": 7,
+             "ts": 0, "dur": 500_000, "args": {"frame": 4, "tile": 1}},
+            # Non-render and unknown-process events are ignored.
+            {"ph": "X", "name": "write", "cat": "worker", "pid": 7,
+             "ts": 0, "dur": 9, "args": {"frame": 3}},
+            {"ph": "X", "name": "render", "cat": "worker", "pid": 99,
+             "ts": 0, "dur": 9, "args": {"frame": 3}},
+        ]
+    }
+    samples = samples_from_cluster_trace(document)
+    assert len(samples) == 3
+    whole = [s for s in samples if s.pixel_fraction == 1.0]
+    tiled = [s for s in samples if s.pixel_fraction != 1.0]
+    assert len(whole) == 1 and whole[0].seconds == pytest.approx(2.0)
+    assert len(tiled) == 2
+    # Two distinct tiles seen -> fraction 1/2 each.
+    assert all(s.pixel_fraction == pytest.approx(0.5) for s in tiled)
+
+
+def test_cost_model_cli(tmp_path):
+    from tpu_render_cluster.sched.cost_model import main as cost_model_main
+
+    document = {
+        "traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": 1,
+             "args": {"name": f"worker-{FAST:08x}"}},
+            *[
+                {"ph": "X", "name": "render", "cat": "worker", "pid": 1,
+                 "ts": 0, "dur": 100_000 * f, "args": {"frame": f}}
+                for f in range(1, 6)
+            ],
+        ]
+    }
+    trace_path = tmp_path / "trace.json"
+    trace_path.write_text(json.dumps(document), encoding="utf-8")
+    out_path = tmp_path / "model.json"
+    assert cost_model_main([str(trace_path), "-o", str(out_path)]) == 0
+    model = JointCostModel.load(out_path)
+    assert model.samples_observed == 5
+    assert model.worker_speed.has_history(FAST)
+
+
+class _StubHandle:
+    """WorkerHandle stand-in for CostModelService.ingest."""
+
+    def __init__(self, worker_id, observations):
+        self.worker_id = worker_id
+        self._observations = list(observations)
+
+    def drain_completion_observations(self):
+        out, self._observations = self._observations, []
+        return out
+
+
+def test_service_ingests_once_and_accounts_error():
+    from tpu_render_cluster.obs import MetricsRegistry
+
+    registry = MetricsRegistry()
+    service = CostModelService(metrics=registry)
+    worker = _StubHandle(FAST, [("job", WorkUnit(1), 2.0)])
+    assert service.ingest([worker]) == 1
+    assert service.model.samples_observed == 1
+    # Draining is destructive: a second ingest pass sees nothing (this is
+    # what lets several scheduler loops tick the same service safely).
+    assert service.ingest([worker]) == 0
+    # First observation for the worker carries no prediction -> no error
+    # sample; the second does.
+    worker._observations = [("job", WorkUnit(2), 2.5)]
+    assert service.ingest([worker]) == 1
+    snapshot = registry.snapshot()
+    entry = snapshot["sched_cost_model_abs_error_seconds"]["series"]
+    assert sum(s["count"] for s in entry.values()) == 1
+    # A same-name job resubmission (new generation) keeps feeding the
+    # model — observations are not deduped across generations.
+    worker._observations = [("job", WorkUnit(2), 2.5)]
+    assert service.ingest([worker]) == 1
+
+
+# ---------------------------------------------------------------------------
+# Cost-aware WFQ
+
+
+def share(job_id, weight=1.0, in_flight=0, pending=1, cost=None, priority=0):
+    return fair_share.JobShareInput(
+        job_id=job_id,
+        weight=weight,
+        priority=priority,
+        in_flight=in_flight,
+        pending=pending,
+        in_flight_cost=cost,
+    )
+
+
+def test_wfq_counts_vs_predicted_seconds():
+    # Job A holds ONE predicted-slow unit (30 s), job B THREE fast ones
+    # (3 s each): the count-based pick calls A lighter; the cost-aware
+    # pick knows A already holds more outstanding work.
+    assert (
+        fair_share.pick_job_to_dispatch(
+            [share("a", in_flight=1), share("b", in_flight=3)]
+        )
+        == "a"
+    )
+    assert (
+        fair_share.pick_job_to_dispatch(
+            [share("a", in_flight=1, cost=30.0), share("b", in_flight=3, cost=9.0)]
+        )
+        == "b"
+    )
+
+
+def test_wfq_cost_respects_weights():
+    # B holds twice A's predicted seconds but has 4x the weight ->
+    # normalized load 20/4 < 10/1: B is served.
+    jobs = [
+        share("a", weight=1.0, in_flight=1, cost=10.0),
+        share("b", weight=4.0, in_flight=2, cost=20.0),
+    ]
+    assert fair_share.pick_job_to_dispatch(jobs) == "b"
+
+
+def test_wfq_priority_still_dominates_cost():
+    jobs = [
+        share("low", priority=0, in_flight=0, cost=0.0),
+        share("high", priority=5, in_flight=9, cost=900.0),
+    ]
+    assert fair_share.pick_job_to_dispatch(jobs) == "high"
+
+
+def test_slot_targets_stay_slot_denominated():
+    # Targets/preemption stay in slots: cost inputs must not change them.
+    jobs = [
+        share("a", in_flight=1, pending=10, cost=100.0),
+        share("b", in_flight=1, pending=10, cost=1.0),
+    ]
+    targets = fair_share.compute_slot_targets(jobs, 8.0)
+    assert targets["a"] == pytest.approx(targets["b"])
+
+
+# ---------------------------------------------------------------------------
+# Speculation trigger
+
+
+def row(unit_index, worker, predicted, elapsed=0.0):
+    return InFlightUnit(
+        unit=WorkUnit(unit_index),
+        worker_id=worker,
+        predicted_s=predicted,
+        elapsed_s=elapsed,
+    )
+
+
+def test_candidate_requires_a_tail():
+    assert select_speculation_candidate([], threshold=2.0) is None
+    uniform = [row(i, FAST, 0.1) for i in range(4)]
+    assert select_speculation_candidate(uniform, threshold=2.0) is None
+
+
+def test_candidate_picks_predicted_straggler():
+    units = [row(1, FAST, 0.1), row(2, FAST, 0.12), row(3, SLOW, 0.9)]
+    picked = select_speculation_candidate(units, threshold=2.0)
+    assert picked is not None and picked.unit == WorkUnit(3)
+
+
+def test_single_unit_triggers_only_when_overdue():
+    # p50 of one unit is its own prediction: the prediction can never
+    # exceed threshold x itself, so only elapsed overdue-ness triggers
+    # (catches hangs and unmodeled stragglers).
+    assert (
+        select_speculation_candidate([row(1, SLOW, 0.5)], threshold=2.0) is None
+    )
+    picked = select_speculation_candidate(
+        [row(1, SLOW, 0.5, elapsed=2.0)], threshold=2.0
+    )
+    assert picked is not None and picked.unit == WorkUnit(1)
+
+
+def test_speculation_config_from_env(monkeypatch):
+    for name in (
+        "TRC_SPECULATION",
+        "TRC_SPEC_THRESHOLD",
+        "TRC_SPEC_MIN_SAMPLES",
+        "TRC_SPEC_MAX_ACTIVE",
+    ):
+        monkeypatch.delenv(name, raising=False)
+    assert SpeculationConfig.from_env() == SpeculationConfig()
+    monkeypatch.setenv("TRC_SPECULATION", "1")
+    monkeypatch.setenv("TRC_SPEC_THRESHOLD", "1.25")
+    monkeypatch.setenv("TRC_SPEC_MAX_ACTIVE", "4")
+    config = SpeculationConfig.from_env()
+    assert config.enabled and config.threshold == 1.25 and config.max_active == 4
+
+
+# ---------------------------------------------------------------------------
+# Tile-aware pricing (unit-level; the cost-matrix regression sits in
+# tests/test_sched.py next to the other scheduler pricing tests)
+
+
+def test_tile_pixel_fraction():
+    assert tile_pixel_fraction(None, None) == 1.0
+    assert tile_pixel_fraction(0, (2, 2)) == pytest.approx(0.25)
+    exact = tile_pixel_fraction(0, (2, 2), width=101, height=77)
+    assert exact == pytest.approx(0.25, rel=0.05)
+    total = sum(
+        tile_pixel_fraction(t, (3, 3), width=101, height=77) for t in range(9)
+    )
+    assert total == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# End to end: the speculative twin wins against a deterministic straggler
+
+
+def _job(frames: int, workers: int) -> BlenderJob:
+    return BlenderJob(
+        job_name="spec-e2e",
+        job_description="speculation e2e",
+        project_file_path="%BASE%/p.blend",
+        render_script_path="%BASE%/s.py",
+        frame_range_from=1,
+        frame_range_to=frames,
+        wait_for_number_of_workers=workers,
+        frame_distribution_strategy=DistributionStrategy.naive_fine(),
+        output_directory_path="%BASE%/out",
+        output_file_name_format="rendered-#####",
+        output_file_format="PNG",
+    )
+
+
+def test_speculative_twin_exactly_once(monkeypatch):
+    """A 2-worker cluster with a 30x straggler: at the tail the fast
+    worker idles while the straggler grinds its unit; speculation must
+    duplicate that unit onto the fast worker, the twin's result must win
+    through the dedup seam, and every exactly-once invariant must hold
+    (duplicate accounted, loser absorbed, no ghost mirror entries)."""
+    monkeypatch.setenv("TRC_SPECULATION", "1")
+    monkeypatch.setenv("TRC_SPEC_THRESHOLD", "1.5")
+    monkeypatch.setenv("TRC_SPEC_MIN_SAMPLES", "2")
+    monkeypatch.delenv("TRC_COST_MODEL", raising=False)
+    frames = 6
+    backends = [
+        MockBackend(load_seconds=0.0, save_seconds=0.0, render_seconds=0.04),
+        MockBackend(load_seconds=0.0, save_seconds=0.0, render_seconds=1.2),
+    ]
+    _trace, _worker_traces, manager, _workers = _run_local_job_full(
+        _job(frames, workers=2), backends, 60.0
+    )
+    state = manager.state
+    assert state.all_frames_finished()
+    # The straggler's unit was hedged and the twin delivered first.
+    assert manager.speculation.launched_total >= 1
+    assert manager.speculation.outcomes["won"] >= 1
+    # Every launched twin got an outcome (no leaked speculation records).
+    assert sum(manager.speculation.outcomes.values()) == (
+        manager.speculation.launched_total
+    )
+    assert not state.speculations
+    # Exactly-once + no ghost mirrors, by the chaos audit.
+    violations = check_job_invariants(state, manager.workers.values())
+    assert violations == [], violations
+    # The winning results' latency log covers every unit exactly once.
+    assert len(state.unit_seconds) == frames
+
+
+def test_speculation_off_is_inert(monkeypatch):
+    monkeypatch.delenv("TRC_SPECULATION", raising=False)
+    monkeypatch.delenv("TRC_COST_MODEL", raising=False)
+    backends = [
+        MockBackend(load_seconds=0.0, save_seconds=0.0, render_seconds=0.02),
+        MockBackend(load_seconds=0.0, save_seconds=0.0, render_seconds=0.02),
+    ]
+    _trace, _worker_traces, manager, _workers = _run_local_job_full(
+        _job(4, workers=2), backends, 60.0
+    )
+    assert manager.speculation.launched_total == 0
+    assert manager.state.all_frames_finished()
+    violations = check_job_invariants(manager.state, manager.workers.values())
+    assert violations == [], violations
+
+
+@pytest.mark.chaos
+def test_seeded_straggler_chaos_with_speculation(monkeypatch):
+    """The acceptance-criterion audit: a seeded tail-heavy (straggler)
+    chaos workload with speculation enabled must hold every invariant —
+    ``ok_results - duplicate_results == units_total``, plan-exact
+    eviction accounting, no ghost mirrors, valid merged trace."""
+    from tpu_render_cluster.chaos.plan import FaultPlan
+    from tpu_render_cluster.chaos.runner import run_chaos_job
+
+    monkeypatch.setenv("TRC_SPECULATION", "1")
+    monkeypatch.setenv("TRC_SPEC_THRESHOLD", "1.5")
+    monkeypatch.setenv("TRC_SPEC_MIN_SAMPLES", "2")
+    monkeypatch.delenv("TRC_COST_MODEL", raising=False)
+    plan = FaultPlan.generate(
+        1205,
+        3,
+        kills=0,
+        partitions=0,
+        duplicate_sends=0,
+        stragglers=2,
+        wedges=0,
+        drops=0,
+        dispatch_delays=0,
+    )
+    report = run_chaos_job(plan, frames=18, timeout=120.0)
+    assert report.ok, report.violations
+    speculation = report.stats.get("speculation")
+    assert speculation is not None and speculation["enabled"]
+    # Every launched twin resolved to an outcome.
+    assert sum(speculation["outcomes"].values()) == speculation["launched"]
+    assert report.stats["unit_latency"]["count"] == 18
+
+
+# ---------------------------------------------------------------------------
+# statistics.json prediction section
+
+
+def test_summarize_prediction_section():
+    from tpu_render_cluster.analysis.obs_events import summarize_prediction
+
+    assert summarize_prediction([{}]) is None  # runs without the layer
+    snapshots = [
+        {
+            "written_at": 10.0,
+            "metrics": {
+                "sched_cost_model_abs_error_seconds": {
+                    "series": {"": {"count": 4, "sum": 0.8}}
+                },
+                "master_unit_latency_seconds": {
+                    "series": {"": {"count": 10, "sum": 5.0}}
+                },
+                "sched_speculations_total": {
+                    "series": {"outcome=won": 2.0, "outcome=lost": 1.0}
+                },
+                "sched_speculations_launched_total": {"series": {"": 3.0}},
+            },
+            "prediction": {"samples_observed": 10, "predictions": 4},
+            "speculation": {"enabled": True, "launched": 3},
+        }
+    ]
+    section = summarize_prediction(snapshots)
+    assert section is not None
+    assert section["abs_error"]["count"] == 4
+    assert section["abs_error"]["mean_s"] == pytest.approx(0.2)
+    assert section["unit_latency"]["mean_s"] == pytest.approx(0.5)
+    assert section["speculations"]["launched"] == 3.0
+    assert section["speculations"]["outcomes"] == {"won": 2.0, "lost": 1.0}
+    assert section["prediction"]["samples_observed"] == 10
+    assert section["speculation"]["enabled"] is True
+
+
+def test_statistics_prediction_from_live_run(monkeypatch, tmp_path):
+    """summarize_obs folds a real speculation run's snapshot into a
+    statistics.json-shaped `prediction` section."""
+    from tpu_render_cluster.analysis.obs_events import summarize_obs
+
+    monkeypatch.setenv("TRC_SPECULATION", "1")
+    monkeypatch.setenv("TRC_SPEC_THRESHOLD", "1.5")
+    monkeypatch.setenv("TRC_SPEC_MIN_SAMPLES", "2")
+    monkeypatch.delenv("TRC_COST_MODEL", raising=False)
+    backends = [
+        MockBackend(load_seconds=0.0, save_seconds=0.0, render_seconds=0.04),
+        MockBackend(load_seconds=0.0, save_seconds=0.0, render_seconds=1.2),
+    ]
+    _trace, _worker_traces, manager, _workers = _run_local_job_full(
+        _job(6, workers=2), backends, 60.0
+    )
+    snapshot = {
+        "written_at": 1.0,
+        "metrics": manager.metrics.snapshot(),
+        **manager.cluster_view(),
+    }
+    out = summarize_obs([], [snapshot])
+    section = out.get("prediction")
+    assert section is not None
+    assert section["unit_latency"]["count"] == 6
+    assert section["speculations"]["launched"] >= 1
+    assert "abs_error" in section  # predicted-vs-actual comparison present
+
+
+def test_multi_job_scheduler_speculates_at_the_tail(monkeypatch):
+    """The scheduler-service path: two concurrent jobs over a pool with a
+    deterministic straggler — the per-job speculation tick must hedge the
+    tail, both jobs complete, and every per-job exactly-once audit holds."""
+    from tpu_render_cluster.harness.local import run_local_multi_job
+    from tpu_render_cluster.sched.models import JOB_FINISHED, JobSpec
+
+    monkeypatch.setenv("TRC_SPECULATION", "1")
+    monkeypatch.setenv("TRC_SPEC_THRESHOLD", "1.3")
+    monkeypatch.setenv("TRC_SPEC_MIN_SAMPLES", "2")
+    monkeypatch.delenv("TRC_COST_MODEL", raising=False)
+    specs = []
+    for index in range(2):
+        job = BlenderJob.from_dict(
+            {
+                **_job(3, workers=2).to_dict(),
+                "job_name": f"spec-mj-{index}",
+            }
+        )
+        specs.append(JobSpec(job=job, weight=1.0))
+    backends = [
+        MockBackend(load_seconds=0.0, save_seconds=0.0, render_seconds=0.04),
+        MockBackend(load_seconds=0.0, save_seconds=0.0, render_seconds=1.5),
+    ]
+    _traces, job_ids, manager, _workers = run_local_multi_job(
+        specs, backends, timeout=120.0
+    )
+    for job_id in job_ids:
+        run = manager._runs[job_id]
+        assert run.status == JOB_FINISHED
+        violations = check_job_invariants(run.state, manager.workers.values())
+        assert violations == [], (job_id, violations)
+    assert manager.speculation.launched_total >= 1
+    assert sum(manager.speculation.outcomes.values()) == (
+        manager.speculation.launched_total
+    )

@@ -491,6 +491,122 @@ def summarize_tiles(metrics: list[dict[str, Any]]) -> dict[str, Any] | None:
     return out
 
 
+def summarize_prediction(metrics: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """Roll the predictive-scheduling evidence up (sched/cost_model.py +
+    master/speculate.py).
+
+    Three families: the cost model's prediction quality
+    (``sched_cost_model_abs_error_seconds`` — absolute error of each
+    per-unit prediction at observation time, i.e. predicted vs actual),
+    the per-unit winning-result latency distribution
+    (``master_unit_latency_seconds`` — what speculation is judged on),
+    and the speculation ledger (``sched_speculations_total{outcome}`` +
+    the launched counter). The live ``prediction``/``speculation``
+    sections a master's cluster_view stamps into its snapshots ride
+    along (newest snapshot wins). None when no snapshot carries any of
+    it — runs without the predictive layer get no ``prediction`` section.
+    """
+    found = False
+    abs_error_count = 0
+    abs_error_sum = 0.0
+    latency_count = 0
+    latency_sum = 0.0
+    speculations: dict[str, float] = {}
+    launched = 0.0
+    live: dict[str, Any] = {}
+    # Newest-wins PER SECTION: snapshots from different masters may each
+    # carry only one of the two live views, and one must not age out the
+    # other.
+    live_at: dict[str, float] = {}
+
+    def take_registry(names: dict[str, Any]) -> bool:
+        nonlocal found, abs_error_count, abs_error_sum
+        nonlocal latency_count, latency_sum, launched
+        took = False
+        histogram = names.get("sched_cost_model_abs_error_seconds")
+        if histogram:
+            found = took = True
+            for series in histogram.get("series", {}).values():
+                abs_error_count += int(series.get("count", 0))
+                abs_error_sum += float(series.get("sum", 0.0))
+        histogram = names.get("master_unit_latency_seconds")
+        if histogram:
+            found = took = True
+            for series in histogram.get("series", {}).values():
+                latency_count += int(series.get("count", 0))
+                latency_sum += float(series.get("sum", 0.0))
+        counter = names.get("sched_speculations_total")
+        if counter:
+            found = took = True
+            for label, value in counter.get("series", {}).items():
+                outcome = label.partition("=")[2] or label or "total"
+                speculations[outcome] = speculations.get(outcome, 0.0) + float(
+                    value
+                )
+        counter = names.get("sched_speculations_launched_total")
+        if counter:
+            found = took = True
+            launched += sum(
+                float(v) for v in counter.get("series", {}).values()
+            )
+        return took
+
+    def take_wire(wire: dict[str, Any]) -> None:
+        nonlocal found, abs_error_count, abs_error_sum
+        nonlocal latency_count, latency_sum, launched
+        for key, entry in (wire.get("h") or {}).items():
+            name = key.partition("|")[0]
+            if name == "sched_cost_model_abs_error_seconds":
+                found = True
+                abs_error_count += int(entry.get("n", 0))
+                abs_error_sum += float(entry.get("s", 0.0))
+            elif name == "master_unit_latency_seconds":
+                found = True
+                latency_count += int(entry.get("n", 0))
+                latency_sum += float(entry.get("s", 0.0))
+        for key, value in (wire.get("c") or {}).items():
+            name, _, label = key.partition("|")
+            if name == "sched_speculations_total":
+                found = True
+                outcome = label.partition("=")[2] or label or "total"
+                speculations[outcome] = speculations.get(outcome, 0.0) + float(
+                    value
+                )
+            elif name == "sched_speculations_launched_total":
+                found = True
+                launched += float(value)
+
+    _consume_metric_snapshots(metrics, take_registry, take_wire)
+    for snapshot in metrics:
+        written_at = float(snapshot.get("written_at", 0.0))
+        for section in ("prediction", "speculation"):
+            view = snapshot.get(section)
+            if isinstance(view, dict) and written_at >= live_at.get(section, -1.0):
+                live[section] = view
+                live_at[section] = written_at
+                found = True
+    if not found:
+        return None
+    out: dict[str, Any] = {}
+    if abs_error_count:
+        out["abs_error"] = {
+            "count": abs_error_count,
+            "mean_s": abs_error_sum / abs_error_count,
+        }
+    if latency_count:
+        out["unit_latency"] = {
+            "count": latency_count,
+            "mean_s": latency_sum / latency_count,
+        }
+    if speculations or launched:
+        out["speculations"] = {
+            "launched": launched,
+            "outcomes": speculations,
+        }
+    out.update(live)
+    return out
+
+
 _CHAOS_LEDGER_COUNTERS = (
     "master_frame_results_total",
     "master_duplicate_results_total",
@@ -601,6 +717,9 @@ def summarize_obs(
     sched = summarize_sched(metrics)
     if sched is not None:
         out["sched"] = sched
+    prediction = summarize_prediction(metrics)
+    if prediction is not None:
+        out["prediction"] = prediction
     if cluster_traces:
         from tpu_render_cluster.analysis.critical_path import (
             summarize_critical_path,

@@ -62,6 +62,27 @@ DEFAULT_FRAMES = 24
 DEFAULT_RENDER_SECONDS = 0.12
 
 
+def unit_latency_stats(unit_seconds: list[float]) -> dict[str, float]:
+    """Exact percentiles over the master's per-unit winning-result
+    latencies (state.unit_seconds) — the tail the predictive scheduler
+    is judged on (bench.py --speculation)."""
+    if not unit_seconds:
+        return {"count": 0}
+    ordered = sorted(unit_seconds)
+
+    def pct(q: float) -> float:
+        index = min(len(ordered) - 1, int(round(q * (len(ordered) - 1))))
+        return ordered[index]
+
+    return {
+        "count": len(ordered),
+        "p50_s": pct(0.50),
+        "p90_s": pct(0.90),
+        "p99_s": pct(0.99),
+        "max_s": ordered[-1],
+    }
+
+
 @dataclass
 class ChaosReport:
     """Everything a chaos run produced: schedule, audit, ledger."""
@@ -317,7 +338,10 @@ def run_chaos_job(
         "reconnects": counter_total(
             master_snapshot, "master_worker_reconnects_total"
         ),
+        "unit_latency": unit_latency_stats(manager.state.unit_seconds),
     }
+    if manager.speculation.config.enabled or manager.speculation.launched_total:
+        stats["speculation"] = manager.speculation.view()
     return ChaosReport(
         plan=plan, violations=violations, stats=stats, artifacts=artifacts
     )

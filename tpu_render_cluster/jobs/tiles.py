@@ -101,6 +101,45 @@ def tile_rc(tile: int, grid: tuple[int, int]) -> tuple[int, int]:
     return tile // cols, tile % cols
 
 
+def tile_pixel_fraction(
+    tile: int | None,
+    grid: tuple[int, int] | None,
+    *,
+    width: int | None = None,
+    height: int | None = None,
+) -> float:
+    """Fraction of the frame's pixels a tile covers (1.0 = whole frame).
+
+    With the render resolution the bounds are exact; without it the
+    even-split geometry guarantees every tile is within one pixel per
+    axis of ``1 / (rows * cols)``, so that is the resolution-free answer.
+    The scheduler's cost model uses this to price a ``(frame, tile)``
+    unit at its share of the frame instead of the whole frame's predicted
+    cost (tiled jobs were uniformly overpriced before).
+    """
+    if tile is None or grid is None:
+        return 1.0
+    rows, cols = grid
+    if width is not None and height is not None:
+        y0, x0, tile_height, tile_width = tile_bounds(
+            tile, grid, width=width, height=height
+        )
+        total = width * height
+        return (tile_height * tile_width) / total if total else 1.0
+    return 1.0 / (rows * cols)
+
+
+def unit_pixel_fraction(
+    unit: WorkUnit,
+    grid: tuple[int, int] | None,
+    *,
+    width: int | None = None,
+    height: int | None = None,
+) -> float:
+    """``tile_pixel_fraction`` keyed by a WorkUnit."""
+    return tile_pixel_fraction(unit.tile, grid, width=width, height=height)
+
+
 def tile_bounds(
     tile: int, grid: tuple[int, int], *, width: int, height: int
 ) -> tuple[int, int, int, int]:

@@ -26,6 +26,10 @@ from pathlib import Path
 from tpu_render_cluster import PROTOCOL_VERSION
 from tpu_render_cluster.jobs.models import BlenderJob
 from tpu_render_cluster.master.assembly import FrameAssemblyService
+from tpu_render_cluster.master.speculate import (
+    SpeculationService,
+    speculation_loop,
+)
 from tpu_render_cluster.master.state import ClusterManagerState, FrameStatus
 from tpu_render_cluster.master.strategies import run_strategy
 from tpu_render_cluster.master.worker_handle import WorkerHandle
@@ -124,6 +128,36 @@ class ClusterManager:
             metrics=self.metrics,
             span_tracer=self.span_tracer,
             base_directory=output_base_directory,
+        )
+        # Predictive scheduling (ROADMAP item 3): the shared cost model —
+        # warm-started from a ``TRC_COST_MODEL`` snapshot when one is set,
+        # refined online from every completion observation — plus the
+        # straggler-hedging speculation engine (master/speculate.py; off
+        # unless ``TRC_SPECULATION`` enables it). Imported lazily: the
+        # sched package's __init__ imports the scheduler, which imports
+        # this module.
+        from tpu_render_cluster.sched.cost_model import (
+            DEFAULT_COST_EMA_ALPHA,
+            CostModelService,
+            load_cost_model_from_env,
+        )
+
+        # A tpu-batch job's configured EMA alpha governs the shared model
+        # (a loaded TRC_COST_MODEL snapshot carries its own).
+        alpha = DEFAULT_COST_EMA_ALPHA
+        if (
+            job is not None
+            and job.frame_distribution_strategy.strategy_type == "tpu-batch"
+            and job.frame_distribution_strategy.tpu_batch is not None
+        ):
+            alpha = job.frame_distribution_strategy.tpu_batch.cost_ema_alpha
+        self.cost_service = CostModelService(
+            load_cost_model_from_env(), alpha=alpha, metrics=self.metrics
+        )
+        self.speculation = SpeculationService(
+            cost=self.cost_service,
+            metrics=self.metrics,
+            span_tracer=self.span_tracer,
         )
         # When set, a 1 Hz SnapshotWriter keeps this file fresh while the
         # job runs (live inspection), with a final write at shutdown.
@@ -262,6 +296,11 @@ class ClusterManager:
             },
             "jobs": jobs_view,
         }
+        prediction = self.cost_service.prediction_view()
+        if prediction.get("samples_observed") or prediction.get("predictions"):
+            view["prediction"] = prediction
+        if self.speculation.config.enabled or self.speculation.launched_total:
+            view["speculation"] = self.speculation.view()
         if worker_payloads:
             view["worker_metrics"] = worker_payloads
             # Payloads crossed the wire from workers we don't control;
@@ -442,7 +481,16 @@ class ClusterManager:
             if state is None:
                 continue  # the owning job is already gone
             record = state.frames.get(frame.unit)
-            if record is not None and record.status is not FrameStatus.FINISHED:
+            if (
+                record is not None
+                and record.status is not FrameStatus.FINISHED
+                and record.worker_id == worker.worker_id
+            ):
+                # Ownership check: this worker's mirror can hold units
+                # whose LIVE assignment is elsewhere (a speculative twin,
+                # a ghost copy from a superseded dispatch) — requeueing
+                # those would put a unit in play twice while its primary
+                # still renders it.
                 state.return_frame_to_pending(frame.unit)
         # No ghost assignments: a dead worker's mirror must not keep
         # offering steal candidates (or claim queue depth) for frames that
@@ -512,11 +560,35 @@ class ClusterManager:
             track="job",
             args={"strategy": strategy.strategy_type, "frames": len(self.state.frames)},
         ):
+            # Speculation sidecar: strategy-agnostic tail hedging (no-op
+            # unless TRC_SPECULATION enabled). Runs beside the strategy so
+            # the reference dispatch loops stay untouched.
+            spec_task = asyncio.create_task(
+                speculation_loop(
+                    self.job,
+                    self.state,
+                    self.live_workers,
+                    self.cancellation,
+                    self.speculation,
+                ),
+                name="speculation-loop",
+            )
             try:
                 await run_strategy(
-                    self.job, self.state, self.live_workers, self.cancellation
+                    self.job,
+                    self.state,
+                    self.live_workers,
+                    self.cancellation,
+                    cost_service=self.cost_service,
                 )
+                # Let the sidecar settle open races (outcomes accounted,
+                # losers unqueued) before the finalization sweep audits
+                # the mirrors; it exits promptly once all frames finished.
+                await spec_task
             finally:
+                if not spec_task.done():
+                    spec_task.cancel()
+                    await asyncio.gather(spec_task, return_exceptions=True)
                 # Accepted late results can finish a unit while its
                 # re-dispatched twin still sits queued on a live worker;
                 # the job is over, so those mirror entries are ghosts now

@@ -24,6 +24,7 @@ from tpu_render_cluster.master.cluster import ClusterManager
 from tpu_render_cluster.master.persist import (
     parse_worker_traces,
     print_results,
+    save_cost_model,
     save_processed_results,
     save_raw_traces,
 )
@@ -108,6 +109,19 @@ async def serve_command(args: argparse.Namespace) -> int:
         metrics_snapshot_path=results_directory / "metrics-live.json",
         output_base_directory=args.base_directory,
     )
+    # A restarted service re-learns worker speeds from its own previous
+    # shutdown snapshot (explicit TRC_COST_MODEL wins; saved again below).
+    from tpu_render_cluster.sched.cost_model import (
+        explicit_model_configured,
+        load_model_snapshot,
+        save_model_snapshot,
+    )
+
+    sched_model_path = results_directory / "sched_cost-model.json"
+    if not explicit_model_configured():
+        restored = load_model_snapshot(sched_model_path)
+        if restored is not None:
+            manager.cost_service.model = restored
     control = ControlServer(manager, args.host, args.control_port)
     await control.start()
     print(
@@ -120,6 +134,12 @@ async def serve_command(args: argparse.Namespace) -> int:
         await manager.serve()
     finally:
         await control.stop()
+        # Final drain of completion observations (the last frames' results
+        # can land after the scheduler loop's last ingest tick).
+        manager.cost_service.ingest(
+            manager.workers.values(), manager._job_for_name
+        )
+        save_model_snapshot(manager.cost_service.model, sched_model_path)
     prefix = f"sched-{datetime.now().strftime('%Y-%m-%d_%H-%M-%S')}"
     manager.span_tracer.export(results_directory / f"{prefix}_trace-events.json")
     export_cluster_trace(
@@ -154,9 +174,15 @@ async def run_job_command(args: argparse.Namespace) -> int:
         output_base_directory=args.base_directory,
     )
     if args.resume:
-        from tpu_render_cluster.master.resume import apply_resume
+        from tpu_render_cluster.master.resume import apply_resume, load_cost_model
 
         apply_resume(manager.state, job, args.base_directory)
+        # Restore the previous run's learned predictors too (an explicit
+        # TRC_COST_MODEL wins over the snapshot — load_cost_model
+        # returns None when it is set).
+        restored = load_cost_model(job, args.results_directory)
+        if restored is not None:
+            manager.cost_service.model = restored
         if manager.state.all_frames_finished():
             # Fully-resumed job: don't block on the worker barrier.
             from tpu_render_cluster.traces.master_trace import MasterTrace
@@ -202,6 +228,10 @@ async def run_job_command(args: argparse.Namespace) -> int:
         manager.metrics,
         extra=manager.cluster_view(),
     )
+    # Snapshot the run's learned cost model so --resume (or a plain
+    # re-run of the same job) starts with warm predictors instead of
+    # re-learning worker speeds from scratch.
+    save_cost_model(job, results_directory, manager.cost_service.model)
     performance = parse_worker_traces(worker_traces)
     save_processed_results(
         start_time,

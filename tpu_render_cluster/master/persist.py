@@ -34,6 +34,30 @@ def _file_prefix(start_time: datetime, job: BlenderJob) -> str:
     )
 
 
+def cost_model_snapshot_path(job: BlenderJob, output_directory: Path) -> Path:
+    """Where a job's learned cost model is snapshotted.
+
+    Deliberately UNtimestamped (unlike the trace artifacts): a resumed or
+    re-run master of the same job must find the newest model without
+    knowing the previous run's start time — each run overwrites it.
+    """
+    return (
+        Path(output_directory)
+        / f"job-{job.job_name.replace(' ', '_')}_cost-model.json"
+    )
+
+
+def save_cost_model(job: BlenderJob, output_directory: Path, model) -> Path | None:
+    """Snapshot the run's learned ``JointCostModel`` next to the results
+    (``sched/cost_model.save_model_snapshot`` semantics: cold models
+    skipped, failures warn instead of failing the completed job)."""
+    from tpu_render_cluster.sched.cost_model import save_model_snapshot
+
+    return save_model_snapshot(
+        model, cost_model_snapshot_path(job, output_directory)
+    )
+
+
 def save_raw_traces(
     start_time: datetime,
     job: BlenderJob,

@@ -90,6 +90,37 @@ def scan_rendered_frames(
     return found
 
 
+def load_cost_model(
+    job: BlenderJob, results_directory: Path | str, *, respect_env: bool = True
+):
+    """Restore the job's snapshotted ``JointCostModel``, or None.
+
+    The other half of resume: a restarted master re-learns which frames
+    are DONE by scanning the output directory (below), and re-learns how
+    fast each worker renders which frames from the cost-model snapshot
+    the previous run persisted (master/persist.save_cost_model) — instead
+    of cold-starting the predictors and re-paying the warmup misschedules.
+
+    An explicit ``TRC_COST_MODEL`` wins over the snapshot (it was already
+    loaded at master construction): with ``respect_env`` (the default)
+    this returns None whenever the variable is set.
+    """
+    from tpu_render_cluster.master.persist import cost_model_snapshot_path
+    from tpu_render_cluster.sched.cost_model import (
+        explicit_model_configured,
+        load_model_snapshot,
+    )
+
+    if respect_env and explicit_model_configured():
+        return None
+    model = load_model_snapshot(cost_model_snapshot_path(job, Path(results_directory)))
+    if model is not None:
+        logger.info(
+            "Resume: cost model restored (%d samples).", model.samples_observed
+        )
+    return model
+
+
 def apply_resume(
     state: ClusterManagerState,
     job: BlenderJob,

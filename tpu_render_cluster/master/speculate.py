@@ -1,0 +1,513 @@
+"""Straggler-aware speculative re-execution of predicted tail units.
+
+On tail-heavy workloads the makespan is gated by the last straggling unit
+(the per-worker straggler scores in analysis/critical_path.py prove it):
+once the pending pool is dry, every other worker idles while one slow (or
+silently degraded) worker grinds through its final unit, and stealing
+cannot help — a unit that is already RENDERING cannot be unqueued.
+
+This module closes that gap with duplicate-dispatch hedging, which the
+exactly-once dedup ledger (PR 4) makes safe by construction:
+
+- when the predicted completion of a job's tail unit exceeds
+  ``TRC_SPEC_THRESHOLD`` x the p50 predicted unit time of the in-flight
+  set (or the unit is overdue by the same factor — the model cannot
+  predict a hang) AND an idle worker exists, a byte-identical TWIN of the
+  ``(frame, tile)`` unit is dispatched to the fastest idle worker;
+- the first accepted ok result wins: the frame record still points at the
+  PRIMARY assignment, so a twin that finishes first lands through the
+  existing late-result acceptance path and the primary's copy is absorbed
+  as a duplicate (or vice versa) — ``ok_results - duplicate_results ==
+  units_total`` keeps holding under every interleaving;
+- the loser is unqueued through the same frame-queue-remove RPC steals
+  and preemption use (``already-rendering``/``already-finished`` races
+  silently tolerated — a loser that raced past removal resolves as an
+  absorbed duplicate).
+
+Everything is master-internal: the wire never learns a dispatch was
+speculative, C++ workers run unmodified, and speculation-off clusters are
+byte-identical to before.
+
+Outcomes (``sched_speculations_total{outcome}``):
+
+- ``won``   — the twin delivered first: the hedge cut the tail;
+- ``lost``  — the primary delivered first and the twin was cancelled
+  before it started rendering (the hedge cost one queue slot);
+- ``wasted``— the primary delivered first but the twin had already
+  rendered (or its result raced in): full duplicate work, absorbed by
+  the ledger.
+
+Configuration (env, read at master construction):
+
+- ``TRC_SPECULATION``       — enable (default 0/off);
+- ``TRC_SPEC_THRESHOLD``    — tail trigger multiple over the p50
+  predicted in-flight unit time (default 2.0);
+- ``TRC_SPEC_MIN_SAMPLES``  — cost-model observations required before
+  prediction-triggered speculation (overdue-triggered speculation works
+  from the first tick; default 3);
+- ``TRC_SPEC_MAX_ACTIVE``   — concurrent speculative twins per job
+  (default 2).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Callable, NamedTuple, Sequence
+
+from tpu_render_cluster.jobs.models import BlenderJob
+from tpu_render_cluster.jobs.tiles import WorkUnit
+from tpu_render_cluster.master.state import (
+    ClusterManagerState,
+    FrameStatus,
+    SpeculationRecord,
+)
+from tpu_render_cluster.utils.cancellation import CancellationToken
+from tpu_render_cluster.utils.env import env_float, env_int
+
+if TYPE_CHECKING:
+    # Type-only: importing sched.cost_model at runtime here would cycle
+    # (sched/__init__ -> sched.manager -> master.cluster -> this module).
+    from tpu_render_cluster.master.worker_handle import WorkerHandle
+    from tpu_render_cluster.obs import MetricsRegistry, Tracer
+    from tpu_render_cluster.sched.cost_model import CostModelService
+
+logger = logging.getLogger(__name__)
+
+SPECULATION_TICK = 0.05  # matches the strategy/scheduler tick cadence
+
+OUTCOME_WON = "won"
+OUTCOME_LOST = "lost"
+OUTCOME_WASTED = "wasted"
+
+
+@dataclass(frozen=True)
+class SpeculationConfig:
+    """Tuning knobs, each with a ``TRC_SPEC*`` environment override."""
+
+    enabled: bool = False
+    threshold: float = 2.0
+    min_samples: int = 3
+    max_active: int = 2
+
+    @classmethod
+    def from_env(cls) -> "SpeculationConfig":
+        return cls(
+            enabled=env_int("TRC_SPECULATION", 0) != 0,
+            threshold=env_float("TRC_SPEC_THRESHOLD", cls.threshold),
+            min_samples=env_int("TRC_SPEC_MIN_SAMPLES", cls.min_samples),
+            max_active=env_int("TRC_SPEC_MAX_ACTIVE", cls.max_active),
+        )
+
+
+class InFlightUnit(NamedTuple):
+    """One in-flight unit's speculation inputs (pure selection row)."""
+
+    unit: WorkUnit
+    worker_id: int
+    predicted_s: float
+    elapsed_s: float
+
+    @property
+    def tail_score(self) -> float:
+        """How long this unit plausibly still gates the job: the model's
+        prediction, or how long it has ALREADY run when that exceeds the
+        prediction (an overdue unit is evidence the prediction is wrong —
+        a hang or an unmodeled straggler)."""
+        return max(self.predicted_s, self.elapsed_s)
+
+
+def select_speculation_candidate(
+    units: Sequence[InFlightUnit], *, threshold: float
+) -> InFlightUnit | None:
+    """The tail unit worth hedging, or None.
+
+    Pure so the trigger's decision structure is unit-testable without a
+    cluster (the same design rule as fair_share.py / the makespan gate):
+    the worst tail score must exceed ``threshold`` x the p50 PREDICTED
+    unit time of the in-flight set — with a single in-flight unit the p50
+    is that unit's own prediction, so only overdue-ness (elapsed) can
+    trigger, never the prediction against itself.
+    """
+    if not units:
+        return None
+    predictions = sorted(u.predicted_s for u in units)
+    p50 = predictions[len(predictions) // 2]
+    best: InFlightUnit | None = None
+    for unit in units:
+        if unit.tail_score <= threshold * max(p50, 1e-9):
+            continue
+        if best is None or unit.tail_score > best.tail_score:
+            best = unit
+    return best
+
+
+class SpeculationService:
+    """Per-master speculation engine shared by every scheduler loop.
+
+    The live twin table lives on each job's ``ClusterManagerState``
+    (``state.speculations``) so result handling (worker_handle stamps the
+    winner) and this service's resolution never disagree about which job
+    a twin belongs to.
+    """
+
+    def __init__(
+        self,
+        config: SpeculationConfig | None = None,
+        *,
+        cost: "CostModelService",
+        metrics: "MetricsRegistry | None" = None,
+        span_tracer: "Tracer | None" = None,
+    ) -> None:
+        self.config = config if config is not None else SpeculationConfig.from_env()
+        self.cost = cost
+        self.metrics = metrics
+        self.span_tracer = span_tracer
+        self.launched_total = 0
+        self.outcomes: dict[str, int] = {
+            OUTCOME_WON: 0,
+            OUTCOME_LOST: 0,
+            OUTCOME_WASTED: 0,
+        }
+
+    # -- accounting ----------------------------------------------------------
+
+    def _count_outcome(self, outcome: str, record: SpeculationRecord) -> None:
+        self.outcomes[outcome] = self.outcomes.get(outcome, 0) + 1
+        if self.metrics is not None:
+            self.metrics.counter(
+                "sched_speculations_total",
+                "Resolved speculative twin dispatches by outcome "
+                "(won = twin delivered first, lost = twin cancelled "
+                "unrendered, wasted = duplicate work absorbed)",
+                labels=("outcome",),
+            ).inc(outcome=outcome)
+        if self.span_tracer is not None:
+            self.span_tracer.instant(
+                "speculation resolved",
+                cat="sched",
+                track="speculation",
+                args={
+                    "frame": record.unit.frame_index,
+                    **({"tile": record.unit.tile} if record.unit.tile is not None else {}),
+                    "outcome": outcome,
+                    "primary": f"{record.primary_worker_id:08x}",
+                    "twin": f"{record.twin_worker_id:08x}",
+                },
+            )
+
+    def view(self) -> dict:
+        """Live section for cluster_view / chaos reports."""
+        return {
+            "enabled": self.config.enabled,
+            "threshold": self.config.threshold,
+            "launched": self.launched_total,
+            "outcomes": dict(self.outcomes),
+        }
+
+    # -- resolution ----------------------------------------------------------
+
+    async def resolve(
+        self,
+        job: BlenderJob,
+        state: ClusterManagerState,
+        workers: Sequence["WorkerHandle"],
+    ) -> None:
+        """Settle every speculation whose race is decided (or broken)."""
+        if not state.speculations:
+            return
+        by_id = {worker.worker_id: worker for worker in workers}
+        for unit, record in list(state.speculations.items()):
+            frame_record = state.frames.get(unit)
+            if frame_record is None:
+                state.speculations.pop(unit, None)
+                continue
+            if frame_record.status is FrameStatus.FINISHED:
+                state.speculations.pop(unit, None)
+                await self._settle_finished(job, state, record, by_id)
+                continue
+            # Races that break the speculation before any result lands.
+            twin = by_id.get(record.twin_worker_id)
+            twin_entry = (
+                twin.queue.get(unit.frame_index, job.job_name, unit.tile)
+                if twin is not None and not twin.is_dead
+                else None
+            )
+            if frame_record.status is FrameStatus.PENDING:
+                # The primary died: eviction requeued the unit while the
+                # still-live twin already holds a copy — PROMOTE the twin
+                # to the live assignment instead of throwing the hedge
+                # away (and instead of letting dispatch put a third copy
+                # in play). Counted as a win: the hedge is what kept the
+                # unit warm through the primary's death.
+                state.speculations.pop(unit, None)
+                if twin_entry is not None:
+                    state.mark_frame_as_queued(
+                        unit, record.twin_worker_id, twin_entry.queued_at
+                    )
+                    self._count_outcome(OUTCOME_WON, record)
+                else:
+                    self._count_outcome(OUTCOME_LOST, record)
+                continue
+            # Twin died/was swept, or the primary assignment moved to a
+            # third worker (steal, or a re-dispatch that beat this tick):
+            # the unit is back in the ordinary dispatch machinery's hands
+            # and the dedup seam owns whatever the twin still does.
+            primary_moved = frame_record.worker_id not in (
+                record.primary_worker_id,
+                record.twin_worker_id,
+            )
+            if twin_entry is None or primary_moved:
+                state.speculations.pop(unit, None)
+                if twin_entry is not None:
+                    await self._unqueue_loser(job, twin, unit)
+                self._count_outcome(OUTCOME_LOST, record)
+
+    async def _settle_finished(
+        self,
+        job: BlenderJob,
+        state: ClusterManagerState,
+        record: SpeculationRecord,
+        by_id: dict[int, "WorkerHandle"],
+    ) -> None:
+        winner = record.winner_worker_id
+        if winner == record.twin_worker_id:
+            loser_id, outcome = record.primary_worker_id, OUTCOME_WON
+        else:
+            # Unknown winner (e.g. the unit was finished by resume or a
+            # third late result) settles conservatively as primary-won.
+            loser_id = record.twin_worker_id
+            outcome = OUTCOME_LOST
+        loser = by_id.get(loser_id)
+        wasted = False
+        if loser is not None and not loser.is_dead:
+            entry = loser.queue.get(
+                record.unit.frame_index, job.job_name, record.unit.tile
+            )
+            if entry is None:
+                # The loser's copy already delivered (absorbed as a
+                # duplicate) or was swept: the work happened.
+                wasted = True
+            else:
+                if entry.is_rendering:
+                    wasted = True
+                removed = await self._unqueue_loser(job, loser, record.unit)
+                if not removed:
+                    wasted = True
+        else:
+            # A dead loser rendered nothing further; its mirror was
+            # cleared by eviction. The race simply ended.
+            wasted = False
+        if outcome != OUTCOME_WON and wasted:
+            outcome = OUTCOME_WASTED
+        self._count_outcome(outcome, record)
+
+    @staticmethod
+    async def _unqueue_loser(
+        job: BlenderJob, worker: "WorkerHandle", unit: WorkUnit
+    ) -> bool:
+        """Remove the losing copy; tolerant of the remove-vs-render races
+        exactly like steals/preemption (an already-rendering loser keeps
+        going and its result is absorbed as a duplicate)."""
+        from tpu_render_cluster.protocol import messages as pm
+
+        try:
+            result = await worker.unqueue_frame(job.job_name, unit)
+        except Exception as e:  # noqa: BLE001 - worker failure mid-RPC
+            logger.warning(
+                "Speculation loser unqueue failed on %08x: %s",
+                worker.worker_id,
+                e,
+            )
+            return False
+        return result == pm.FRAME_QUEUE_REMOVE_RESULT_REMOVED
+
+    # -- launching -----------------------------------------------------------
+
+    def _in_flight_rows(
+        self,
+        job: BlenderJob,
+        state: ClusterManagerState,
+        live_ids: set[int],
+        now: float,
+    ) -> list[InFlightUnit]:
+        rows: list[InFlightUnit] = []
+        for unit, record in state.frames.items():
+            if record.status not in (
+                FrameStatus.QUEUED_ON_WORKER,
+                FrameStatus.RENDERING_ON_WORKER,
+            ):
+                continue
+            if record.worker_id not in live_ids or unit in state.speculations:
+                continue
+            rows.append(
+                InFlightUnit(
+                    unit=unit,
+                    worker_id=record.worker_id,
+                    predicted_s=self.cost.predict_unit_seconds(
+                        record.worker_id, unit, job
+                    ),
+                    elapsed_s=max(0.0, now - (record.queued_at or now)),
+                )
+            )
+        return rows
+
+    async def maybe_launch(
+        self,
+        job: BlenderJob,
+        state: ClusterManagerState,
+        workers: Sequence["WorkerHandle"],
+        *,
+        job_id: str | None = None,
+    ) -> bool:
+        """Dispatch at most one speculative twin; True when one launched.
+
+        Only fires at the job tail: dispatching pending work always takes
+        priority over hedging (an idle worker with pending frames should
+        receive a fresh frame, not a duplicate), so callers tick this
+        after their normal dispatch pass.
+        """
+        if not self.config.enabled:
+            return False
+        # O(1) amortized tail gate (pending_count() would scan the whole
+        # deque every 50 ms tick for the life of the job).
+        if state.next_pending_unit() is not None:
+            return False
+        if len(state.speculations) >= max(1, self.config.max_active):
+            return False
+        live = [w for w in workers if not w.is_dead]
+        idle = [w for w in live if len(w.queue) == 0]
+        if not idle:
+            return False
+        now = time.time()
+        live_ids = {w.worker_id for w in live}
+        rows = self._in_flight_rows(job, state, live_ids, now)
+        candidate = select_speculation_candidate(
+            rows, threshold=self.config.threshold
+        )
+        if candidate is None:
+            return False
+        if (
+            self.cost.model.samples_observed < self.config.min_samples
+            and candidate.elapsed_s < candidate.predicted_s
+        ):
+            # The PREDICTION trigger needs a minimally-warm model; the
+            # overdue trigger (elapsed dominating the prediction) works
+            # from the first tick — a hang needs no history to be real.
+            return False
+        targets = [w for w in idle if w.worker_id != candidate.worker_id]
+        if not targets:
+            return False
+        target = min(
+            targets,
+            key=lambda w: self.cost.model.worker_speed.predict(w.worker_id),
+        )
+        predicted_twin = self.cost.predict_unit_seconds(
+            target.worker_id, candidate.unit, job
+        )
+        if predicted_twin >= candidate.tail_score:
+            return False  # the hedge cannot beat the incumbent
+        record = SpeculationRecord(
+            unit=candidate.unit,
+            primary_worker_id=candidate.worker_id,
+            twin_worker_id=target.worker_id,
+            started_at=now,
+            predicted_primary_s=candidate.predicted_s,
+            predicted_twin_s=predicted_twin,
+        )
+        # Register BEFORE the dispatch await: a result racing the add-RPC
+        # must find the record to stamp its winner on.
+        state.speculations[candidate.unit] = record
+        try:
+            await target.queue_frame(
+                job, candidate.unit, job_id=job_id, speculative=True
+            )
+        except Exception as e:  # noqa: BLE001 - dispatch raced death/finish
+            state.speculations.pop(candidate.unit, None)
+            logger.debug(
+                "Speculative dispatch of unit %s to %08x aborted: %s",
+                candidate.unit.label,
+                target.worker_id,
+                e,
+            )
+            return False
+        self.launched_total += 1
+        if self.metrics is not None:
+            self.metrics.counter(
+                "sched_speculations_launched_total",
+                "Speculative twin dispatches issued",
+            ).inc()
+        if self.span_tracer is not None:
+            self.span_tracer.instant(
+                "speculate",
+                cat="sched",
+                track="speculation",
+                args={
+                    "frame": candidate.unit.frame_index,
+                    **(
+                        {"tile": candidate.unit.tile}
+                        if candidate.unit.tile is not None
+                        else {}
+                    ),
+                    "primary": f"{candidate.worker_id:08x}",
+                    "twin": f"{target.worker_id:08x}",
+                    "predicted_primary_s": round(candidate.predicted_s, 6),
+                    "predicted_twin_s": round(predicted_twin, 6),
+                    "elapsed_s": round(candidate.elapsed_s, 6),
+                },
+            )
+        logger.info(
+            "Speculating unit %s: primary %08x (predicted %.3fs, elapsed "
+            "%.3fs) -> twin on %08x (predicted %.3fs).",
+            candidate.unit.label,
+            candidate.worker_id,
+            candidate.predicted_s,
+            candidate.elapsed_s,
+            target.worker_id,
+            predicted_twin,
+        )
+        return True
+
+    async def tick(
+        self,
+        job: BlenderJob,
+        state: ClusterManagerState,
+        workers: Sequence["WorkerHandle"],
+        *,
+        job_id: str | None = None,
+    ) -> None:
+        await self.resolve(job, state, workers)
+        await self.maybe_launch(job, state, workers, job_id=job_id)
+
+
+async def speculation_loop(
+    job: BlenderJob,
+    state: ClusterManagerState,
+    workers_fn: Callable[[], Sequence["WorkerHandle"]],
+    cancellation: CancellationToken,
+    service: SpeculationService,
+) -> None:
+    """The single-job master's speculation sidecar.
+
+    Runs beside ``run_strategy`` (any strategy — the tail-hedging logic
+    is strategy-agnostic) at the shared tick cadence: ingest fresh
+    completion observations into the shared cost model (for strategies
+    that don't feed it themselves), resolve decided races, maybe hedge
+    the tail. Exits with the job; a final resolve pass settles
+    still-open races so every launched twin gets an outcome and losers'
+    mirror entries are removed before the finalization sweep audits them.
+    """
+    if not service.config.enabled:
+        return
+    job_for = lambda _job_name: job  # noqa: E731 - single-job loop
+    while not cancellation.is_cancelled() and not state.all_frames_finished():
+        workers = [w for w in workers_fn() if not w.is_dead]
+        service.cost.ingest(workers, job_for)
+        await service.tick(job, state, workers, job_id=state.sched_job_id)
+        await asyncio.sleep(SPECULATION_TICK)
+    service.cost.ingest(
+        [w for w in workers_fn() if not w.is_dead], job_for
+    )
+    await service.resolve(job, state, list(workers_fn()))

@@ -61,6 +61,28 @@ class FrameRecord:
         return self.unit.tile
 
 
+@dataclass
+class SpeculationRecord:
+    """One live speculative twin of an in-flight unit (master-internal).
+
+    The PRIMARY assignment owns the frame record as usual; the TWIN is a
+    byte-identical duplicate dispatch to a second worker, tracked only
+    here (the wire and the C++ workers cannot tell a twin from any other
+    assignment). ``winner_worker_id`` is stamped by the first accepted ok
+    result — the dedup ledger absorbs the loser's copy — and the
+    speculation loop (master/speculate.py) unqueues the loser and
+    accounts the outcome.
+    """
+
+    unit: WorkUnit
+    primary_worker_id: int
+    twin_worker_id: int
+    started_at: float
+    predicted_primary_s: float
+    predicted_twin_s: float
+    winner_worker_id: int | None = None
+
+
 class ClusterManagerState:
     """Per-job work-unit table; single event loop, so no locking is needed.
 
@@ -109,6 +131,14 @@ class ClusterManagerState:
         self._tiles_per_frame = job.tiles_per_frame()
         self._assembly: dict[int, set[int]] = {}
         self.frames_assembled = 0
+        # Live speculative twins keyed by unit (master/speculate.py): a
+        # unit under speculation is dispatched on TWO workers at once;
+        # first accepted ok result wins through the dedup ledger.
+        self.speculations: dict[WorkUnit, SpeculationRecord] = {}
+        # Per-unit queue-to-result latency of each unit's WINNING result
+        # (exact, one float per unit): the p99 the predictive scheduler is
+        # judged on (bench.py --speculation, chaos report stats).
+        self.unit_seconds: list[float] = []
 
     # -- queries -----------------------------------------------------------
 

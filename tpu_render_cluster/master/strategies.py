@@ -349,8 +349,17 @@ async def run_strategy(
     state: ClusterManagerState,
     workers_fn,
     cancellation: CancellationToken,
+    *,
+    cost_service=None,
 ) -> None:
-    """Dispatch on the job's strategy (reference: master/src/cluster/mod.rs:622-654)."""
+    """Dispatch on the job's strategy (reference: master/src/cluster/mod.rs:622-654).
+
+    ``cost_service`` is the master's shared predictive cost model
+    (sched/cost_model.CostModelService); the tpu-batch strategy prices
+    its auction off it (warm-started from ``TRC_COST_MODEL`` snapshots
+    and shared with the speculation loop). The reference strategies
+    ignore it — their dispatch order is fixed by contract.
+    """
     strategy = job.frame_distribution_strategy
     if strategy.strategy_type == "naive-fine":
         await naive_fine_strategy(job, state, workers_fn, cancellation)
@@ -364,7 +373,12 @@ async def run_strategy(
         from tpu_render_cluster.master.tpu_batch import tpu_batch_strategy
 
         await tpu_batch_strategy(
-            job, state, workers_fn, cancellation, strategy.tpu_batch
+            job,
+            state,
+            workers_fn,
+            cancellation,
+            strategy.tpu_batch,
+            cost_service=cost_service,
         )
     else:
         raise ValueError(f"Unknown strategy: {strategy.strategy_type}")

@@ -21,7 +21,6 @@ covers the cold-start case where no frame-time history exists yet.
 from __future__ import annotations
 
 import asyncio
-import bisect
 import logging
 import time
 from typing import TYPE_CHECKING, Sequence
@@ -33,11 +32,24 @@ from tpu_render_cluster.jobs.models import (
     DynamicStrategyOptions,
     TpuBatchStrategyOptions,
 )
+from tpu_render_cluster.jobs.tiles import WorkUnit, unit_pixel_fraction
 from tpu_render_cluster.master.state import ClusterManagerState
 from tpu_render_cluster.master.strategies import (
     check_job_failed,
     find_busiest_worker_and_frame_to_steal,
     steal_frame,
+)
+
+# The model classes grew into a first-class subsystem (offline training,
+# persistence, the shared online service) and moved to sched/cost_model.py;
+# re-exported here because this was their original definition site.
+from tpu_render_cluster.sched.cost_model import (  # noqa: F401 (re-exports)
+    DEFAULT_FRAME_TIME_GUESS,
+    CostModelService,
+    FrameComplexityModel,
+    JointCostModel,
+    WorkerCostModel,
+    load_cost_model_from_env,
 )
 from tpu_render_cluster.utils.cancellation import CancellationToken
 
@@ -47,7 +59,6 @@ if TYPE_CHECKING:
 logger = logging.getLogger(__name__)
 
 TPU_BATCH_TICK = 0.05
-DEFAULT_FRAME_TIME_GUESS = 5.0  # seconds, until history arrives
 # Each worker's queue is sized to cover this many seconds of predicted work
 # (bounded below by 1 and above by RATE_TARGET_CAP), so a fast worker's
 # queue holds several ticks of frames while a slow worker holds one or two.
@@ -62,109 +73,28 @@ RATE_TARGET_CAP = 16
 MAX_SLOTS_PER_TICK = 128
 
 
-class WorkerCostModel:
-    """Per-worker EMA frame-time predictor fed by finished events."""
+def unit_complexity_map(
+    units: Sequence[WorkUnit],
+    complexity_model: FrameComplexityModel,
+    tile_grid: tuple[int, int] | None,
+) -> dict[WorkUnit, float]:
+    """Per-UNIT complexity: the frame's predicted factor scaled by the
+    unit's pixel fraction.
 
-    def __init__(self, alpha: float) -> None:
-        self.alpha = alpha
-        self._ema: dict[int, float] = {}
-
-    def observe(self, worker_id: int, frame_seconds: float) -> None:
-        previous = self._ema.get(worker_id)
-        if previous is None:
-            self._ema[worker_id] = frame_seconds
-        else:
-            self._ema[worker_id] = (
-                self.alpha * frame_seconds + (1 - self.alpha) * previous
-            )
-
-    def has_history(self, worker_id: int) -> bool:
-        return worker_id in self._ema
-
-    def predict(self, worker_id: int) -> float:
-        if self._ema:
-            default = float(np.median(list(self._ema.values())))
-        else:
-            default = DEFAULT_FRAME_TIME_GUESS
-        return self._ema.get(worker_id, default)
-
-
-class FrameComplexityModel:
-    """Per-frame relative render-cost predictor.
-
-    Scenes are animated, so cost varies smoothly with frame index; unseen
-    frames are predicted by linear interpolation between the nearest
-    observed frame indices (nearest-neighbor at the edges). Observations
-    are worker-speed-normalized, so a heavy frame on a fast worker and a
-    light frame on a slow worker are distinguishable. Cold start predicts
-    a flat 1.0, which reduces the cost matrix to the pure worker-speed
-    model and tpu-batch to its round-2 behavior.
+    The complexity model stays keyed by FRAME index (tiles of one frame
+    share the scene, so they share the frame's factor), but a quarter-
+    frame tile is a quarter of the work — pricing a ``(frame, tile)``
+    unit at the whole frame's cost uniformly overpriced tiled jobs (and
+    distorted the makespan gate's unit arithmetic).
     """
-
-    def __init__(self, alpha: float = 0.5) -> None:
-        self.alpha = alpha
-        self._complexity: dict[int, float] = {}
-        self._sorted_indices: list[int] = []
-
-    def observe(self, frame_index: int, relative_complexity: float) -> None:
-        previous = self._complexity.get(frame_index)
-        if previous is None:
-            bisect.insort(self._sorted_indices, frame_index)
-            self._complexity[frame_index] = relative_complexity
-        else:
-            self._complexity[frame_index] = (
-                self.alpha * relative_complexity + (1 - self.alpha) * previous
-            )
-
-    def predict(self, frame_index: int) -> float:
-        if not self._sorted_indices:
-            return 1.0
-        known = self._complexity.get(frame_index)
-        if known is not None:
-            return known
-        position = bisect.bisect_left(self._sorted_indices, frame_index)
-        if position == 0:
-            return self._complexity[self._sorted_indices[0]]
-        if position == len(self._sorted_indices):
-            return self._complexity[self._sorted_indices[-1]]
-        left = self._sorted_indices[position - 1]
-        right = self._sorted_indices[position]
-        weight = (frame_index - left) / (right - left)
-        return (1 - weight) * self._complexity[left] + weight * self._complexity[right]
-
-    def predict_many(self, frames: Sequence[int]) -> dict[int, float]:
-        return {frame_index: self.predict(frame_index) for frame_index in frames}
-
-    def mean_observed(self) -> float:
-        """Mean complexity over observed frames (1.0 before any history).
-
-        Used to estimate the pending pool's total work without predicting
-        every pending frame each tick (pools can be 14400 frames)."""
-        if not self._complexity:
-            return 1.0
-        return float(np.mean(list(self._complexity.values())))
-
-
-class JointCostModel:
-    """Multiplicative decomposition t(worker, frame) ~ speed[worker] * complexity[frame].
-
-    ``speed`` is a per-worker EMA in seconds per complexity unit
-    (WorkerCostModel); ``complexity`` is the per-frame factor
-    (FrameComplexityModel). Each observation updates both: the worker EMA is
-    fed the complexity-normalized time, and the frame model the
-    speed-normalized time. The alternation converges because both models
-    start from flat priors (1.0 complexity, median speed).
-    """
-
-    def __init__(self, alpha: float) -> None:
-        self.worker_speed = WorkerCostModel(alpha)
-        self.frame_complexity = FrameComplexityModel(alpha)
-
-    def observe(self, worker_id: int, frame_index: int, seconds: float) -> None:
-        complexity_estimate = max(1e-6, self.frame_complexity.predict(frame_index))
-        self.worker_speed.observe(worker_id, seconds / complexity_estimate)
-        speed_estimate = max(1e-6, self.worker_speed.predict(worker_id))
-        self.frame_complexity.observe(frame_index, seconds / speed_estimate)
+    frame_predictions = complexity_model.predict_many(
+        sorted({unit.frame_index for unit in units})
+    )
+    return {
+        unit: frame_predictions[unit.frame_index]
+        * unit_pixel_fraction(unit, tile_grid)
+        for unit in units
+    }
 
 
 def build_cost_matrix(
@@ -239,13 +169,30 @@ async def tpu_batch_strategy(
     workers_fn,
     cancellation: CancellationToken,
     options: TpuBatchStrategyOptions,
+    *,
+    cost_service: CostModelService | None = None,
 ) -> None:
     from tpu_render_cluster.ops.assignment import solve_assignment
 
-    cost_model = JointCostModel(options.cost_ema_alpha)
+    # The model is shared master state now (sched/cost_model.py): the
+    # manager passes its service so the speculation loop and a persisted
+    # TRC_COST_MODEL snapshot warm-start the auction; standalone callers
+    # (tests) still get a private cold instance.
+    if cost_service is None:
+        cost_service = CostModelService(
+            load_cost_model_from_env(), alpha=options.cost_ema_alpha
+        )
+    cost_model = cost_service.model
+    scene = CostModelService.scene_key(job)
+    complexity_model = cost_model.complexity_model(scene)
+    # This loop runs one job: every completion observation is priced
+    # against it (the service keys scene + tile grid off the job).
+    job_for = lambda _job_name: job  # noqa: E731
     dynamic_options = _as_dynamic_options(options)
-    observed_frames: set[tuple[int, int]] = set()
     starved_since: float | None = None  # first fully-gated tick of a streak
+    # A tiled job's pending pool is counted in UNITS; the model-wide mean
+    # complexity is frame-equivalent, so pool work scales by the fraction.
+    pool_unit_fraction = 1.0 / job.tiles_per_frame()
 
     while not cancellation.is_cancelled():
         if state.all_frames_finished():
@@ -256,26 +203,26 @@ async def tpu_batch_strategy(
             await asyncio.sleep(TPU_BATCH_TICK)
             continue
 
-        # Feed the cost model with fresh completions.
-        for worker in workers:
-            for frame_index, seconds in worker.drain_completion_observations():
-                key = (worker.worker_id, frame_index)
-                if key not in observed_frames:
-                    observed_frames.add(key)
-                    cost_model.observe(worker.worker_id, frame_index, seconds)
+        # Feed the cost model with fresh completions (the shared service
+        # consumes each observation exactly once, normalizes tile pixel
+        # fractions, and accounts prediction error).
+        cost_service.ingest(workers, job_for)
 
         # Collect slots from queue deficits, with per-worker targets scaled
         # to each worker's predicted rate (uniform targets until history
         # arrives — the cold-start case falls back to eager-coarse shape).
         # Units are (frame, tile) under a tile grid; the complexity model
         # stays keyed by FRAME index (tiles of one frame share the scene,
-        # so they share the frame's complexity factor).
+        # so they share the frame's complexity factor), scaled per unit by
+        # its pixel fraction (unit_complexity_map).
         upcoming = state.pending_units(limit=2 * RATE_TARGET_CAP)
-        complexity_memo = cost_model.frame_complexity.predict_many(
-            [u.frame_index for u in upcoming]
+        upcoming_complexity = unit_complexity_map(
+            upcoming, complexity_model, job.tile_grid
         )
         batch_mean_complexity = (
-            float(np.mean(list(complexity_memo.values()))) if upcoming else 1.0
+            float(np.mean(list(upcoming_complexity.values())))
+            if upcoming
+            else 1.0
         )
         # Slots are interleaved breadth-first by position (every worker's
         # front slot before any second slot): the slot-cap truncation below
@@ -333,11 +280,9 @@ async def tpu_batch_strategy(
         if slots:
             units = state.pending_units(limit=len(slots))
             if units:
-                complexity = {
-                    u: complexity_memo.get(u.frame_index)
-                    or cost_model.frame_complexity.predict(u.frame_index)
-                    for u in units
-                }
+                complexity = unit_complexity_map(
+                    units, complexity_model, job.tile_grid
+                )
                 cost = build_cost_matrix(
                     units,
                     slots,
@@ -366,13 +311,23 @@ async def tpu_batch_strategy(
                 # sum of per-frame predictions (queues are small), and the
                 # candidate frame via its own prediction — so the
                 # subtraction in rest_units below is unit-consistent.
-                pool_units = state.pending_count() * (
-                    cost_model.frame_complexity.mean_observed()
+                pool_units = (
+                    state.pending_count()
+                    * complexity_model.mean_observed()
+                    * pool_unit_fraction
+                )
+                mirrored_complexity = unit_complexity_map(
+                    [
+                        f.unit
+                        for worker in workers
+                        for f in worker.queue.all_frames()
+                    ],
+                    complexity_model,
+                    job.tile_grid,
                 )
                 queued_units = {
                     worker.worker_id: sum(
-                        complexity_memo.get(f.frame_index)
-                        or cost_model.frame_complexity.predict(f.frame_index)
+                        mirrored_complexity[f.unit]
                         for f in worker.queue.all_frames()
                     )
                     for worker in workers

@@ -144,7 +144,7 @@ class WorkerHandle:
         # Observed per-unit render durations (for scheduler cost models),
         # keyed (job_name, unit) — frame indices alias across jobs.
         self._rendering_started_at: dict[tuple[str, WorkUnit], float] = {}
-        self._completion_observations: list[tuple[int, float]] = []
+        self._completion_observations: list[tuple[str, WorkUnit, float]] = []
         self._on_dead = on_dead
         self.logger = WorkerLogger(
             logging.getLogger("master.worker"),
@@ -322,6 +322,7 @@ class WorkerHandle:
         *,
         stolen_from: int | None = None,
         job_id: str | None = None,
+        speculative: bool = False,
     ) -> None:
         """RPC a work unit onto this worker's queue; sync mirror + state.
 
@@ -331,6 +332,14 @@ class WorkerHandle:
         ``unit.tile`` rides the same optional-key idiom — whole-frame
         dispatch encodes byte-identically to before (a bare int is
         accepted as a whole-frame unit for legacy callers/tests).
+
+        ``speculative=True`` dispatches a duplicate TWIN of a unit whose
+        live assignment stays on its PRIMARY worker: the wire message is
+        byte-identical to any other dispatch (workers cannot tell), the
+        mirror gains a normal entry here, but the frame record is NOT
+        re-pointed — the primary still owns it, so the first accepted ok
+        result wins through the existing dedup seam exactly as a
+        late-result race would (master/speculate.py resolves the loser).
         """
         if isinstance(unit, int):
             unit = WorkUnit(unit)
@@ -442,13 +451,14 @@ class WorkerHandle:
             )
         )
         self._update_queue_depth_gauge()
-        state.mark_frame_as_queued(
-            unit,
-            self.worker_id,
-            now,
-            stolen_from=stolen_from,
-            stolen_at=now if stolen_from is not None else None,
-        )
+        if not speculative:
+            state.mark_frame_as_queued(
+                unit,
+                self.worker_id,
+                now,
+                stolen_from=stolen_from,
+                stolen_at=now if stolen_from is not None else None,
+            )
 
     async def unqueue_frame(self, job_name: str, unit: WorkUnit | int) -> str:
         """RPC-remove a work unit (the steal primitive); returns the result
@@ -524,8 +534,12 @@ class WorkerHandle:
             self._update_queue_depth_gauge()
         return removed
 
-    def drain_completion_observations(self) -> list[tuple[int, float]]:
-        """Take (frame_index, seconds) samples observed since the last call."""
+    def drain_completion_observations(
+        self,
+    ) -> list[tuple[str, WorkUnit, float]]:
+        """Take (job_name, unit, seconds) samples observed since the last
+        call (consumed by the shared CostModelService — exactly once no
+        matter which scheduler loop ticks first)."""
         observations, self._completion_observations = self._completion_observations, []
         return observations
 
@@ -627,6 +641,23 @@ class WorkerHandle:
         if self._job_generation_mismatch(state, event.job_id):
             state = None
         record = state.frames.get(unit) if state is not None else None
+        speculation = (
+            state.speculations.get(unit) if state is not None else None
+        )
+        if (
+            speculation is not None
+            and self.worker_id == speculation.twin_worker_id
+        ):
+            # A speculative twin starting to render is BY DESIGN, not an
+            # anomaly: record its render-start clock on this handle (the
+            # cost observation measures render time if the twin wins) but
+            # leave the frame record pointed at the primary — the dedup
+            # seam arbitrates the race by first result, not by state.
+            self.logger.debug(
+                "Speculative twin of unit %s started rendering.", unit.label
+            )
+            self._rendering_started_at[(event.job_name, unit)] = time.time()
+            return
         if state is None or not self._is_current_assignment(record):
             # E.g. the queue-add ack timed out (frame requeued elsewhere)
             # but the add had landed, and the superseded copy now renders;
@@ -711,17 +742,20 @@ class WorkerHandle:
         # timeline: the flow arrow from "assign frame" through the
         # worker's phases ends here. Prefer the trace the event echoed
         # (exact even across re-queues); fall back to the mirror's record
-        # (a C++ worker echoes nothing). Only the CURRENT assignment gets
-        # the terminal arrowhead: eviction already closed a dead worker's
-        # flows, and a duplicate/late result must not double-terminate the
-        # chain its winning copy closes.
+        # (a C++ worker echoes nothing). The arrowhead belongs to the
+        # event that POPPED the mirror entry: a still-mirrored assignment
+        # is a still-open chain (eviction, steals, drains, and sweeps all
+        # close the flow exactly when they remove the entry), so a late
+        # WINNING result — e.g. a speculative twin beating its straggling
+        # primary — terminates its own chain, while a result whose entry
+        # was already swept must not double-terminate it.
         trace = event.trace
         if trace is None and frame_on_worker is not None:
             trace = frame_on_worker.trace
         self._complete_frame_flow(
             "frame result",
             unit,
-            trace if current else None,
+            trace if frame_on_worker is not None else None,
             start_wall=received_wall,
             duration=time.perf_counter() - received_mono,
             extra_args={"result": event.result},
@@ -760,15 +794,19 @@ class WorkerHandle:
                     "assignment.",
                     unit.label,
                 )
+                # The late result IS the unit's winning (first) result —
+                # a speculative twin racing a straggling primary lands
+                # here by design — so it carries the latency and cost
+                # observation the schedulers learn from.
+                self._record_winning_result(
+                    state, event.job_name, unit, started, frame_on_worker
+                )
                 self._finish_unit(state, unit)
                 return
             self.logger.debug("Unit %s finished.", unit.label)
-            if started is None and frame_on_worker is not None:
-                started = frame_on_worker.queued_at
-            if started is not None:
-                self._completion_observations.append(
-                    (event.frame_index, max(1e-4, time.time() - started))
-                )
+            self._record_winning_result(
+                state, event.job_name, unit, started, frame_on_worker
+            )
             self._finish_unit(state, unit)
         else:
             state.ledger["errored_results"] += 1
@@ -813,11 +851,67 @@ class WorkerHandle:
             )
             state.return_frame_to_pending(unit)
 
+    def _record_winning_result(
+        self,
+        state: ClusterManagerState,
+        job_name: str,
+        unit: WorkUnit,
+        started: float | None,
+        frame_on_worker,
+    ) -> None:
+        """Account the unit's FIRST accepted ok result: the cost-model
+        observation, the exact per-unit latency log, and its histogram.
+        Duplicate copies (the speculation loser, a re-delivered send)
+        never reach here — they return through the dedup branches.
+
+        Two different clocks on purpose: the COST observation measures
+        processing time (render start when the rendering event was seen)
+        — what the predictors model — while the LATENCY log measures
+        dispatch-to-result (queue-add to result received) — what a unit
+        actually waited, the tail the speculation bench is judged on. The
+        latency clock starts at the unit's EARLIEST live dispatch, not
+        the winning copy's: a hedged unit that waited on a straggler
+        before its twin was even launched must carry that wait, or the
+        speculation A/B would compare incommensurable clocks."""
+        now = time.time()
+        queued_at = (
+            frame_on_worker.queued_at if frame_on_worker is not None else None
+        )
+        processing_from = started if started is not None else queued_at
+        if processing_from is None:
+            return  # mirror already swept and no rendering event seen
+        self._completion_observations.append(
+            (job_name, unit, max(1e-4, now - processing_from))
+        )
+        record = state.frames.get(unit)
+        dispatch_times = [
+            t
+            for t in (
+                queued_at,
+                record.queued_at if record is not None else None,
+            )
+            if t is not None
+        ]
+        latency_from = min(dispatch_times) if dispatch_times else processing_from
+        latency = max(1e-4, now - latency_from)
+        state.unit_seconds.append(latency)
+        if self.metrics is not None:
+            self.metrics.histogram(
+                "master_unit_latency_seconds",
+                "Dispatch-to-result latency of each unit's winning "
+                "assignment (queue-add to result received)",
+            ).observe(latency)
+
     def _finish_unit(self, state: ClusterManagerState, unit: WorkUnit) -> None:
         """Mark a unit finished; when it completes its whole frame, fire
         the master's frame-complete hook (assembly of tiled frames). The
         transition returns True exactly once per frame, so a duplicate or
-        late copy of the final tile can never assemble a frame twice."""
+        late copy of the final tile can never assemble a frame twice.
+        Also stamps a live speculation's winner — the speculation loop
+        resolves the loser off this mark."""
+        speculation = state.speculations.get(unit)
+        if speculation is not None and speculation.winner_worker_id is None:
+            speculation.winner_worker_id = self.worker_id
         frame_completed = state.mark_frame_as_finished(unit)
         if (
             frame_completed

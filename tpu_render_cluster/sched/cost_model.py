@@ -1,0 +1,791 @@
+"""The predictive-scheduling cost model: trace-trained, persistable, online.
+
+ROADMAP item 3 (DOPPLER, PAPERS.md): the tpu-batch auction already prices
+assignments from a per-worker speed EMA times a per-frame complexity
+factor, but the model was cold-started every run, tile-blind, and private
+to one strategy loop. This module makes it a first-class subsystem:
+
+- ``JointCostModel`` — the multiplicative decomposition
+  ``t(worker, unit) ~ speed[worker] * complexity[scene, frame] * pixels``,
+  now with a SCENE dimension (per-(scene, worker) predictors — one worker
+  speed table shared across scenes, one complexity curve per scene) and
+  pixel-fraction normalization so a ``(frame, tile)`` unit is priced at
+  its share of the frame, not the whole frame.
+- **Offline training** — ``fit_cost_model`` fits the model from recorded
+  per-unit render samples (``samples_from_cluster_trace`` extracts them
+  from a merged cluster timeline; ``samples_from_statistics`` recovers
+  coarse per-worker speed priors from a ``statistics.json``), smoothing
+  the complexity curve with a pure-numpy ridge polynomial
+  (``ComplexityCurve``) that also extrapolates to unseen frames.
+- **Persistence** — ``to_dict``/``from_dict``/``save``/``load`` round-trip
+  the whole model as JSON; ``load_cost_model_from_env`` loads it at master
+  start from ``TRC_COST_MODEL``, and master/persist.py snapshots it next
+  to the run's results so a resumed master starts warm.
+- ``CostModelService`` — the shared ONLINE ingestion point: one instance
+  per master drains every worker's completion observations exactly once,
+  folds them into the model through the same EMA the auction always used,
+  and accounts prediction quality (``sched_cost_model_abs_error_seconds``)
+  for the ``prediction`` section of statistics.json.
+
+The model classes started life in master/tpu_batch.py (which re-exports
+them for compatibility); the strategy file keeps only the auction/tick
+machinery.
+
+CLI (offline training)::
+
+    python -m tpu_render_cluster.sched.cost_model \
+        results/cluster-runs/..._cluster_trace-events.json -o model.json
+    TRC_COST_MODEL=model.json python -m tpu_render_cluster.master.main ...
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import logging
+import os
+from collections import deque
+from pathlib import Path
+from typing import TYPE_CHECKING, Any, Callable, Iterable, NamedTuple, Sequence
+
+import numpy as np
+
+from tpu_render_cluster.jobs.tiles import WorkUnit, unit_pixel_fraction
+
+if TYPE_CHECKING:
+    from tpu_render_cluster.jobs.models import BlenderJob
+    from tpu_render_cluster.master.worker_handle import WorkerHandle
+    from tpu_render_cluster.obs import MetricsRegistry
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_FRAME_TIME_GUESS = 5.0  # seconds, until history arrives
+DEFAULT_COST_EMA_ALPHA = 0.3  # matches TpuBatchStrategyOptions.cost_ema_alpha
+# Default scene key: single-scene masters and legacy callers that never
+# name a scene all share one complexity curve.
+DEFAULT_SCENE = ""
+
+MODEL_FORMAT_VERSION = 1
+
+
+class WorkerCostModel:
+    """Per-worker EMA frame-time predictor fed by finished events."""
+
+    def __init__(self, alpha: float) -> None:
+        self.alpha = alpha
+        self._ema: dict[int, float] = {}
+
+    def observe(self, worker_id: int, frame_seconds: float) -> None:
+        previous = self._ema.get(worker_id)
+        if previous is None:
+            self._ema[worker_id] = frame_seconds
+        else:
+            self._ema[worker_id] = (
+                self.alpha * frame_seconds + (1 - self.alpha) * previous
+            )
+
+    def has_history(self, worker_id: int) -> bool:
+        return worker_id in self._ema
+
+    def any_history(self) -> bool:
+        return bool(self._ema)
+
+    def predict(self, worker_id: int) -> float:
+        value = self._ema.get(worker_id)
+        if value is not None:
+            # Hot path (scheduler ticks predict known workers O(jobs x
+            # in-flight) times per tick): no median over the whole table.
+            return value
+        if self._ema:
+            return float(np.median(list(self._ema.values())))
+        return DEFAULT_FRAME_TIME_GUESS
+
+    def to_dict(self) -> dict[str, Any]:
+        # Worker ids are ints; JSON keys must be strings.
+        return {
+            "alpha": self.alpha,
+            "ema": {str(worker_id): v for worker_id, v in self._ema.items()},
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "WorkerCostModel":
+        model = cls(float(data.get("alpha", DEFAULT_COST_EMA_ALPHA)))
+        for worker_id, value in (data.get("ema") or {}).items():
+            model._ema[int(worker_id)] = float(value)
+        return model
+
+
+class ComplexityCurve:
+    """Ridge-fitted polynomial complexity-over-frame-index prior.
+
+    Pure numpy, closed-form ridge over a normalized frame axis; used by
+    ``FrameComplexityModel`` to predict frames the online EMA has never
+    seen (a trace-trained model knows the SHAPE of the scene's cost curve
+    even for frame ranges a previous run never rendered). Clamped light
+    extrapolation: a cubic fit must not explode outside the training
+    range."""
+
+    def __init__(
+        self, coefficients: Sequence[float], frame_lo: int, frame_hi: int
+    ) -> None:
+        self.coefficients = np.asarray(coefficients, dtype=np.float64)
+        self.frame_lo = int(frame_lo)
+        self.frame_hi = int(frame_hi)
+
+    def _features(self, frame_index: np.ndarray) -> np.ndarray:
+        span = max(1, self.frame_hi - self.frame_lo)
+        t = (frame_index - self.frame_lo) / span
+        t = np.clip(t, -0.25, 1.25)
+        return np.stack(
+            [t**d for d in range(len(self.coefficients))], axis=-1
+        )
+
+    def predict(self, frame_index: int) -> float:
+        value = float(
+            self._features(np.asarray([frame_index], dtype=np.float64))[0]
+            @ self.coefficients
+        )
+        return max(1e-6, value)
+
+    @classmethod
+    def fit(
+        cls,
+        frames: Sequence[int],
+        values: Sequence[float],
+        *,
+        degree: int = 3,
+        ridge_lambda: float = 1e-3,
+    ) -> "ComplexityCurve":
+        frames_arr = np.asarray(frames, dtype=np.float64)
+        values_arr = np.asarray(values, dtype=np.float64)
+        frame_lo, frame_hi = int(frames_arr.min()), int(frames_arr.max())
+        # Never fit more coefficients than distinct support points.
+        degree = max(0, min(degree, len(set(map(int, frames))) - 1))
+        curve = cls(np.zeros(degree + 1), frame_lo, frame_hi)
+        features = curve._features(frames_arr)
+        gram = features.T @ features + ridge_lambda * np.eye(degree + 1)
+        curve.coefficients = np.linalg.solve(gram, features.T @ values_arr)
+        return curve
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "coefficients": [float(c) for c in self.coefficients],
+            "frame_lo": self.frame_lo,
+            "frame_hi": self.frame_hi,
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "ComplexityCurve":
+        return cls(
+            data["coefficients"], int(data["frame_lo"]), int(data["frame_hi"])
+        )
+
+
+class FrameComplexityModel:
+    """Per-frame relative render-cost predictor.
+
+    Scenes are animated, so cost varies smoothly with frame index; unseen
+    frames are predicted by linear interpolation between the nearest
+    observed frame indices (nearest-neighbor at the edges). Observations
+    are worker-speed-normalized, so a heavy frame on a fast worker and a
+    light frame on a slow worker are distinguishable. Cold start predicts
+    the trace-trained ridge curve when one is attached, else a flat 1.0
+    (which reduces the cost matrix to the pure worker-speed model).
+    """
+
+    def __init__(self, alpha: float = 0.5) -> None:
+        self.alpha = alpha
+        self._complexity: dict[int, float] = {}
+        self._sorted_indices: list[int] = []
+        # Offline-fit prior for frames outside the observed support
+        # (fit_cost_model attaches it; online observations always win).
+        self.curve: ComplexityCurve | None = None
+
+    def observe(self, frame_index: int, relative_complexity: float) -> None:
+        previous = self._complexity.get(frame_index)
+        if previous is None:
+            bisect.insort(self._sorted_indices, frame_index)
+            self._complexity[frame_index] = relative_complexity
+        else:
+            self._complexity[frame_index] = (
+                self.alpha * relative_complexity + (1 - self.alpha) * previous
+            )
+
+    def predict(self, frame_index: int) -> float:
+        if not self._sorted_indices:
+            if self.curve is not None:
+                return self.curve.predict(frame_index)
+            return 1.0
+        known = self._complexity.get(frame_index)
+        if known is not None:
+            return known
+        position = bisect.bisect_left(self._sorted_indices, frame_index)
+        if position == 0 or position == len(self._sorted_indices):
+            # Outside the observed support: the fitted curve (when
+            # present) knows the scene's shape beyond the edge; the
+            # nearest-neighbor edge value is the cold fallback.
+            if self.curve is not None:
+                return self.curve.predict(frame_index)
+            edge = 0 if position == 0 else -1
+            return self._complexity[self._sorted_indices[edge]]
+        left = self._sorted_indices[position - 1]
+        right = self._sorted_indices[position]
+        weight = (frame_index - left) / (right - left)
+        return (1 - weight) * self._complexity[left] + weight * self._complexity[right]
+
+    def predict_many(self, frames: Sequence[int]) -> dict[int, float]:
+        return {frame_index: self.predict(frame_index) for frame_index in frames}
+
+    def mean_observed(self) -> float:
+        """Mean complexity over observed frames (1.0 before any history).
+
+        Used to estimate the pending pool's total work without predicting
+        every pending frame each tick (pools can be 14400 frames)."""
+        if not self._complexity:
+            return 1.0
+        return float(np.mean(list(self._complexity.values())))
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "alpha": self.alpha,
+            "complexity": {str(f): v for f, v in self._complexity.items()},
+        }
+        if self.curve is not None:
+            out["curve"] = self.curve.to_dict()
+        return out
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "FrameComplexityModel":
+        model = cls(float(data.get("alpha", 0.5)))
+        for frame_index, value in (data.get("complexity") or {}).items():
+            model.observe(int(frame_index), float(value))
+        if data.get("curve"):
+            model.curve = ComplexityCurve.from_dict(data["curve"])
+        return model
+
+
+class JointCostModel:
+    """Multiplicative decomposition t ~ speed[worker] * complexity[scene, frame].
+
+    ``speed`` is a per-worker EMA in seconds per complexity unit
+    (WorkerCostModel), shared across scenes (hardware speed is a property
+    of the worker); ``complexity`` is a per-scene ``FrameComplexityModel``
+    (scene content is what varies over frames). Each observation updates
+    both: the worker EMA is fed the complexity-normalized time, and the
+    frame model the speed-normalized time. The alternation converges
+    because both models start from flat priors (1.0 complexity, median
+    speed). A ``(frame, tile)`` unit's time is normalized by its pixel
+    fraction before entering the model, so tiled and whole-frame
+    observations feed ONE frame-equivalent scale.
+    """
+
+    def __init__(self, alpha: float = DEFAULT_COST_EMA_ALPHA) -> None:
+        self.alpha = alpha
+        self.worker_speed = WorkerCostModel(alpha)
+        self._scenes: dict[str, FrameComplexityModel] = {
+            DEFAULT_SCENE: FrameComplexityModel(alpha)
+        }
+        self.samples_observed = 0
+
+    @property
+    def frame_complexity(self) -> FrameComplexityModel:
+        """The default scene's complexity model (single-scene callers)."""
+        return self._scenes[DEFAULT_SCENE]
+
+    def complexity_model(self, scene: str = DEFAULT_SCENE) -> FrameComplexityModel:
+        model = self._scenes.get(scene)
+        if model is None:
+            model = self._scenes[scene] = FrameComplexityModel(self.alpha)
+        return model
+
+    def scenes(self) -> list[str]:
+        return list(self._scenes)
+
+    def has_history(self) -> bool:
+        return self.worker_speed.any_history()
+
+    def observe(
+        self,
+        worker_id: int,
+        frame_index: int,
+        seconds: float,
+        *,
+        scene: str = DEFAULT_SCENE,
+        pixel_fraction: float = 1.0,
+    ) -> None:
+        # Frame-equivalent time: a quarter-frame tile that took 1 s means
+        # the whole frame costs ~4 s on this worker.
+        seconds = seconds / max(1e-9, pixel_fraction)
+        complexity = self.complexity_model(scene)
+        complexity_estimate = max(1e-6, complexity.predict(frame_index))
+        self.worker_speed.observe(worker_id, seconds / complexity_estimate)
+        speed_estimate = max(1e-6, self.worker_speed.predict(worker_id))
+        complexity.observe(frame_index, seconds / speed_estimate)
+        self.samples_observed += 1
+
+    def predict_unit_seconds(
+        self,
+        worker_id: int,
+        frame_index: int,
+        *,
+        scene: str = DEFAULT_SCENE,
+        pixel_fraction: float = 1.0,
+    ) -> float:
+        return (
+            self.worker_speed.predict(worker_id)
+            * max(1e-6, self.complexity_model(scene).predict(frame_index))
+            * pixel_fraction
+        )
+
+    # -- persistence ---------------------------------------------------------
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "format_version": MODEL_FORMAT_VERSION,
+            "alpha": self.alpha,
+            "samples_observed": self.samples_observed,
+            "worker_speed": self.worker_speed.to_dict(),
+            "scenes": {
+                scene: model.to_dict() for scene, model in self._scenes.items()
+            },
+        }
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "JointCostModel":
+        version = int(data.get("format_version", MODEL_FORMAT_VERSION))
+        if version > MODEL_FORMAT_VERSION:
+            raise ValueError(
+                f"Cost model format {version} is newer than this build "
+                f"understands ({MODEL_FORMAT_VERSION})."
+            )
+        model = cls(float(data.get("alpha", DEFAULT_COST_EMA_ALPHA)))
+        model.samples_observed = int(data.get("samples_observed", 0))
+        model.worker_speed = WorkerCostModel.from_dict(
+            data.get("worker_speed") or {}
+        )
+        for scene, scene_data in (data.get("scenes") or {}).items():
+            model._scenes[scene] = FrameComplexityModel.from_dict(scene_data)
+        model._scenes.setdefault(
+            DEFAULT_SCENE, FrameComplexityModel(model.alpha)
+        )
+        return model
+
+    def save(self, path: str | Path) -> Path:
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        # Atomic: a reader (a resuming master) must never see a torn file.
+        tmp = path.with_name(path.name + ".tmp")
+        tmp.write_text(json.dumps(self.to_dict(), indent=1), encoding="utf-8")
+        tmp.replace(path)
+        return path
+
+    @classmethod
+    def load(cls, path: str | Path) -> "JointCostModel":
+        return cls.from_dict(
+            json.loads(Path(path).read_text(encoding="utf-8"))
+        )
+
+
+def load_model_snapshot(path: str | Path) -> JointCostModel | None:
+    """Load a model snapshot, degrading to None (cold start) with a loud
+    warning on a missing or rotted file — the master must come up (and
+    re-learn online) regardless. The single definition of the degrade
+    semantics: TRC_COST_MODEL loading, resume restore, and the serve
+    service's restart snapshot all go through here."""
+    path = Path(path)
+    if not path.is_file():
+        return None
+    try:
+        model = JointCostModel.load(path)
+    except Exception as e:  # noqa: BLE001 - degrade to cold start
+        logger.warning(
+            "Cost model snapshot %s could not be loaded (%s); "
+            "cold-starting.",
+            path,
+            e,
+        )
+        return None
+    logger.info(
+        "Cost model loaded from %s (%d samples, %d scene(s)).",
+        path,
+        model.samples_observed,
+        len(model.scenes()),
+    )
+    return model
+
+
+def save_model_snapshot(
+    model: JointCostModel, path: str | Path
+) -> Path | None:
+    """Snapshot a model; returns None (with a warning) on failure —
+    persistence must never fail a completed run. Cold models are skipped:
+    an empty snapshot would overwrite a previously-learned one with
+    nothing."""
+    if not model.has_history():
+        return None
+    path = Path(path)
+    try:
+        model.save(path)
+    except OSError as e:
+        logger.warning("Could not snapshot the cost model to %s: %s", path, e)
+        return None
+    logger.info(
+        "Cost model snapshotted to %s (%d samples).",
+        path,
+        model.samples_observed,
+    )
+    return path
+
+
+def explicit_model_configured() -> bool:
+    """True when ``TRC_COST_MODEL`` names an explicit startup model — the
+    precedence gate snapshot-restore paths (resume, the serve service)
+    consult so they never overwrite an operator-chosen model."""
+    return bool(os.environ.get("TRC_COST_MODEL", "").strip())
+
+
+def load_cost_model_from_env() -> JointCostModel | None:
+    """The ``TRC_COST_MODEL`` startup model, or None (cold start)."""
+    path = os.environ.get("TRC_COST_MODEL", "").strip()
+    if not path:
+        return None
+    model = load_model_snapshot(path)
+    if model is None and not Path(path).is_file():
+        logger.warning("TRC_COST_MODEL=%s does not exist; cold-starting.", path)
+    return model
+
+
+# -- offline training --------------------------------------------------------
+
+
+class TraceSample(NamedTuple):
+    """One recorded unit render: the offline trainer's input row."""
+
+    worker_id: int
+    frame_index: int
+    seconds: float
+    scene: str = DEFAULT_SCENE
+    pixel_fraction: float = 1.0
+
+
+def _worker_id_from_process_name(name: str) -> int | None:
+    """``worker-<8 hex>`` (obs export convention) -> the worker id int."""
+    prefix, _, suffix = name.partition("-")
+    if prefix != "worker" or not suffix:
+        return None
+    try:
+        return int(suffix.split("-")[0], 16)
+    except ValueError:
+        return None
+
+
+def samples_from_cluster_trace(
+    document: dict[str, Any], *, scene: str = DEFAULT_SCENE
+) -> list[TraceSample]:
+    """Per-unit render samples from a merged cluster timeline.
+
+    Walks the worker process rows' ``render`` phase spans (worker/queue.py
+    emits one per unit, args carrying ``frame`` and optionally ``tile``)
+    and returns one ``TraceSample`` each. Tile pixel fractions are
+    recovered as ``1 / tiles_seen`` — the grid itself never rides the
+    trace, but an even-split grid's tiles differ by at most a pixel per
+    axis, so the count is the fraction.
+    """
+    events = document.get("traceEvents")
+    if not isinstance(events, list):
+        return []
+    process_names: dict[Any, str] = {}
+    for event in events:
+        if (
+            isinstance(event, dict)
+            and event.get("ph") == "M"
+            and event.get("name") == "process_name"
+        ):
+            name = (event.get("args") or {}).get("name")
+            if isinstance(name, str):
+                process_names[event.get("pid")] = name
+    raw: list[tuple[int, int, int | None, float]] = []
+    tiles_seen: set[int] = set()
+    for event in events:
+        if not isinstance(event, dict) or event.get("ph") != "X":
+            continue
+        if event.get("name") != "render":
+            continue
+        worker_id = _worker_id_from_process_name(
+            process_names.get(event.get("pid"), "")
+        )
+        if worker_id is None:
+            continue
+        args = event.get("args") or {}
+        frame = args.get("frame")
+        duration_us = event.get("dur")
+        if not isinstance(frame, int) or not isinstance(duration_us, (int, float)):
+            continue
+        tile = args.get("tile") if isinstance(args.get("tile"), int) else None
+        if tile is not None:
+            tiles_seen.add(tile)
+        raw.append((worker_id, frame, tile, float(duration_us) / 1e6))
+    tile_fraction = 1.0 / max(1, len(tiles_seen))
+    return [
+        TraceSample(
+            worker_id=worker_id,
+            frame_index=frame,
+            seconds=max(1e-6, seconds),
+            scene=scene,
+            pixel_fraction=tile_fraction if tile is not None else 1.0,
+        )
+        for worker_id, frame, tile, seconds in raw
+    ]
+
+
+def samples_from_statistics(
+    statistics: dict[str, Any], *, scene: str = DEFAULT_SCENE
+) -> list[TraceSample]:
+    """Coarse per-worker speed priors from a ``statistics.json``.
+
+    The ``critical_path`` sections carry per-worker median processing
+    times (analysis/critical_path.straggler_scores) but no per-frame
+    breakdown, so each worker contributes ONE flat sample at frame 0 —
+    enough to warm the speed table, not the complexity curve. Prefer
+    ``samples_from_cluster_trace`` when the merged timeline is available.
+    """
+    samples: list[TraceSample] = []
+    for section in (statistics.get("critical_path") or {}).values():
+        if not isinstance(section, dict):
+            continue
+        for label, entry in (section.get("workers") or {}).items():
+            if not isinstance(entry, dict):
+                continue
+            p50 = entry.get("processing_p50_s")
+            worker_id = _worker_id_from_process_name(f"worker-{label}")
+            if worker_id is None:
+                worker_id = _worker_id_from_process_name(str(label))
+            if worker_id is None or not isinstance(p50, (int, float)) or p50 <= 0:
+                continue
+            samples.append(
+                TraceSample(
+                    worker_id=worker_id,
+                    frame_index=0,
+                    seconds=float(p50),
+                    scene=scene,
+                )
+            )
+    return samples
+
+
+def fit_cost_model(
+    samples: Iterable[TraceSample],
+    *,
+    alpha: float = DEFAULT_COST_EMA_ALPHA,
+    sweeps: int = 4,
+    curve_degree: int = 3,
+    ridge_lambda: float = 1e-3,
+) -> JointCostModel:
+    """Fit a ``JointCostModel`` offline from recorded samples.
+
+    Several alternating EMA sweeps converge the speed/complexity
+    decomposition (the same update rule the online path uses, so the
+    trained model is bit-compatible with online refinement), then a ridge
+    polynomial (``ComplexityCurve``) is fit per scene over the
+    speed-normalized times and attached as the out-of-support prior.
+    """
+    samples = list(samples)
+    model = JointCostModel(alpha)
+    if not samples:
+        return model
+    for _sweep in range(max(1, sweeps)):
+        for sample in samples:
+            model.observe(
+                sample.worker_id,
+                sample.frame_index,
+                sample.seconds,
+                scene=sample.scene,
+                pixel_fraction=sample.pixel_fraction,
+            )
+    # samples_observed should reflect distinct recorded renders, not the
+    # convergence sweeps.
+    model.samples_observed = len(samples)
+    per_scene: dict[str, tuple[list[int], list[float]]] = {}
+    for sample in samples:
+        speed = max(1e-6, model.worker_speed.predict(sample.worker_id))
+        frames, values = per_scene.setdefault(sample.scene, ([], []))
+        frames.append(sample.frame_index)
+        values.append(
+            sample.seconds / max(1e-9, sample.pixel_fraction) / speed
+        )
+    for scene, (frames, values) in per_scene.items():
+        if len(set(frames)) < 2:
+            continue  # a flat scene needs no curve
+        model.complexity_model(scene).curve = ComplexityCurve.fit(
+            frames, values, degree=curve_degree, ridge_lambda=ridge_lambda
+        )
+    return model
+
+
+# -- online service ----------------------------------------------------------
+
+
+class CostModelService:
+    """The master's shared cost-model instance + its online feed.
+
+    One per master process: every strategy loop (tpu-batch, the
+    speculation loop, the multi-job scheduler tick) calls ``ingest`` to
+    drain worker completion observations — each observation is consumed
+    exactly once no matter how many loops tick, because draining is
+    destructive and the master records exactly one observation per unit
+    per job generation (the winning result's; duplicates and errored
+    results never produce one) — and reads predictions off the shared
+    model. Prediction error is accounted BEFORE the observation updates
+    the model (``sched_cost_model_abs_error_seconds``) so the histogram
+    measures what the scheduler actually acted on.
+    """
+
+    PREDICTION_LOG_LIMIT = 4096
+
+    def __init__(
+        self,
+        model: JointCostModel | None = None,
+        *,
+        alpha: float = DEFAULT_COST_EMA_ALPHA,
+        metrics: "MetricsRegistry | None" = None,
+    ) -> None:
+        self.model = model if model is not None else JointCostModel(alpha)
+        self.metrics = metrics
+        # Recent (predicted, actual) pairs for the live prediction view.
+        self.prediction_log: deque[dict[str, Any]] = deque(
+            maxlen=self.PREDICTION_LOG_LIMIT
+        )
+
+    @staticmethod
+    def scene_key(job: "BlenderJob | None") -> str:
+        """Scene identity = the project file path (stable across runs)."""
+        return job.project_file_path if job is not None else DEFAULT_SCENE
+
+    def predict_unit_seconds(
+        self, worker_id: int, unit: WorkUnit, job: "BlenderJob | None"
+    ) -> float:
+        grid = job.tile_grid if job is not None else None
+        return self.model.predict_unit_seconds(
+            worker_id,
+            unit.frame_index,
+            scene=self.scene_key(job),
+            pixel_fraction=unit_pixel_fraction(unit, grid),
+        )
+
+    def ingest(
+        self,
+        workers: Iterable["WorkerHandle"],
+        job_for: Callable[[str | None], "BlenderJob | None"] | None = None,
+    ) -> int:
+        """Drain + fold every worker's fresh completion observations.
+
+        ``job_for(job_name)`` resolves the owning job (scene key + tile
+        grid); None prices everything as the default scene's whole
+        frames. Returns how many observations were folded in.
+        """
+        folded = 0
+        for worker in workers:
+            for job_name, unit, seconds in worker.drain_completion_observations():
+                job = job_for(job_name) if job_for is not None else None
+                scene = self.scene_key(job)
+                fraction = unit_pixel_fraction(
+                    unit, job.tile_grid if job is not None else None
+                )
+                predicted: float | None = None
+                if self.model.worker_speed.has_history(worker.worker_id):
+                    predicted = self.model.predict_unit_seconds(
+                        worker.worker_id,
+                        unit.frame_index,
+                        scene=scene,
+                        pixel_fraction=fraction,
+                    )
+                    if self.metrics is not None:
+                        self.metrics.histogram(
+                            "sched_cost_model_abs_error_seconds",
+                            "Absolute error of the cost model's per-unit "
+                            "render-time prediction at observation time",
+                        ).observe(abs(predicted - seconds))
+                self.model.observe(
+                    worker.worker_id,
+                    unit.frame_index,
+                    seconds,
+                    scene=scene,
+                    pixel_fraction=fraction,
+                )
+                self.prediction_log.append(
+                    {
+                        "worker": worker.worker_id,
+                        "job": job_name,
+                        "frame": unit.frame_index,
+                        "tile": unit.tile,
+                        "predicted_s": predicted,
+                        "actual_s": seconds,
+                    }
+                )
+                folded += 1
+        return folded
+
+    def prediction_view(self) -> dict[str, Any]:
+        """Live predicted-vs-actual summary (cluster_view ``prediction``)."""
+        pairs = [
+            (entry["predicted_s"], entry["actual_s"])
+            for entry in self.prediction_log
+            if entry["predicted_s"] is not None
+        ]
+        out: dict[str, Any] = {
+            "samples_observed": self.model.samples_observed,
+            "scenes": len(self.model.scenes()),
+            "predictions": len(pairs),
+        }
+        if pairs:
+            errors = sorted(abs(p - a) for p, a in pairs)
+            out["abs_error_mean_s"] = sum(errors) / len(errors)
+            out["abs_error_p50_s"] = errors[len(errors) // 2]
+            out["abs_error_max_s"] = errors[-1]
+        return out
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def main(argv: list[str] | None = None) -> int:
+    """Offline trainer: merged cluster trace(s)/statistics.json -> model."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="trc-cost-model",
+        description="Fit a predictive-scheduling cost model from recorded "
+        "cluster traces (load it at master start via TRC_COST_MODEL).",
+    )
+    parser.add_argument(
+        "inputs",
+        nargs="+",
+        help="Merged *_cluster_trace-events.json files and/or "
+        "statistics.json files.",
+    )
+    parser.add_argument("-o", "--output", required=True)
+    parser.add_argument("--scene", default=DEFAULT_SCENE)
+    parser.add_argument("--alpha", type=float, default=DEFAULT_COST_EMA_ALPHA)
+    args = parser.parse_args(argv)
+    samples: list[TraceSample] = []
+    for path in args.inputs:
+        document = json.loads(Path(path).read_text(encoding="utf-8"))
+        if isinstance(document, dict) and "traceEvents" in document:
+            found = samples_from_cluster_trace(document, scene=args.scene)
+        else:
+            found = samples_from_statistics(document, scene=args.scene)
+        print(f"{path}: {len(found)} sample(s)")
+        samples.extend(found)
+    model = fit_cost_model(samples, alpha=args.alpha)
+    model.save(args.output)
+    print(
+        f"Wrote {args.output}: {model.samples_observed} samples, "
+        f"{len(model.scenes())} scene(s)."
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

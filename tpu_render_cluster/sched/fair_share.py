@@ -12,11 +12,16 @@ capped by the job's *demand* (it can never use more slots than it has
 frames left), with the leftover water-filling down to lower classes.
 
 Dispatch follows the classic weighted-fair-queueing rule — serve the
-runnable job with the smallest normalized load ``in_flight / weight`` —
-which converges to the weight-proportional allocation without ever
-needing the target values; the targets exist for preemption decisions and
-observability (``sched_job_share`` gauges, the acceptance criterion's
-achieved-vs-target comparison).
+runnable job with the smallest normalized load ``load / weight``, where
+load is the job's in-flight work in PREDICTED SECONDS when the cost model
+(sched/cost_model.py) has priced the inputs and the in-flight unit count
+before any history exists — which converges to the weight-proportional
+allocation without ever needing the target values; the targets exist for
+preemption decisions and observability (``sched_job_share`` gauges, the
+acceptance criterion's achieved-vs-target comparison). Targets and
+preemption stay in SLOT units: slots are what the pool physically offers
+(worker queue positions), and a seconds-denominated target would preempt
+a job for merely holding slow units it cannot help holding.
 """
 
 from __future__ import annotations
@@ -32,18 +37,39 @@ _EPS = 1e-9
 
 @dataclass(frozen=True)
 class JobShareInput:
-    """One running job's instantaneous scheduling inputs."""
+    """One running job's instantaneous scheduling inputs.
+
+    ``in_flight_cost`` is the job's in-flight work in PREDICTED SECONDS
+    (the cost model's per-unit predictions summed over its queued +
+    rendering units). When present, the WFQ dispatch pick meters load by
+    it instead of the unit count, so a job holding one predicted-slow
+    unit is not treated as lighter than a job holding three fast ones.
+    Callers must supply it uniformly across one tick's inputs (all jobs
+    or none) — mixing seconds with counts would compare incommensurable
+    loads; ``pick_job_to_dispatch`` falls back to the count for any job
+    missing it.
+    """
 
     job_id: str
     weight: float
     priority: int
     in_flight: int
     pending: int
+    in_flight_cost: float | None = None
 
     @property
     def demand(self) -> int:
         """Max slots this job can usefully hold right now."""
         return self.in_flight + self.pending
+
+    @property
+    def load(self) -> float:
+        """The WFQ load measure: predicted seconds when known, else units."""
+        return (
+            self.in_flight_cost
+            if self.in_flight_cost is not None
+            else float(self.in_flight)
+        )
 
 
 def compute_slot_targets(
@@ -93,9 +119,10 @@ def pick_job_to_dispatch(
     runnable (no pending frames anywhere).
 
     Highest priority class with pending work wins outright; within it,
-    the weighted-fair-queueing pick: minimal ``in_flight / weight``,
-    ties broken by input order (submit order, so the allocation is
-    deterministic).
+    the weighted-fair-queueing pick: minimal ``load / weight`` — load in
+    predicted seconds when the cost model priced the inputs
+    (``in_flight_cost``), else the in-flight unit count — ties broken by
+    input order (submit order, so the allocation is deterministic).
     """
     runnable = [job for job in jobs if job.pending > 0]
     if not runnable:
@@ -105,7 +132,7 @@ def pick_job_to_dispatch(
     for job in runnable:
         if job.priority != top:
             continue
-        if best is None or job.in_flight / job.weight < best.in_flight / best.weight - _EPS:
+        if best is None or job.load / job.weight < best.load / best.weight - _EPS:
             best = job
     assert best is not None
     return best.job_id

@@ -38,7 +38,7 @@ from pathlib import Path
 from typing import Any
 
 from tpu_render_cluster.master.cluster import ClusterManager
-from tpu_render_cluster.master.state import ClusterManagerState
+from tpu_render_cluster.master.state import ClusterManagerState, FrameStatus
 from tpu_render_cluster.master.strategies import (
     dispatch_one_pending,
     preempt_frame,
@@ -144,6 +144,15 @@ class JobManager(ClusterManager):
             return None
         run = self._active_by_name.get(job_name)
         return run.state if run is not None else None
+
+    def _job_for_name(self, job_name: str | None):
+        """Resolve an ACTIVE job for the cost model (scene key + tile
+        grid); a defunct job's late observations price as the default
+        scene — still useful worker-speed signal."""
+        if job_name is None:
+            return None
+        run = self._active_by_name.get(job_name)
+        return run.spec.job if run is not None else None
 
     def _active_job_announcements(self) -> list[tuple[int | None, str | None]]:
         out: list[tuple[int | None, str | None]] = []
@@ -344,11 +353,29 @@ class JobManager(ClusterManager):
             if self._draining and not self._admission and not self._running:
                 return
             if self._running:
-                targets = self._compute_targets()
-                self._account_shares(dt, targets)
-                await self._dispatch_tick()
+                # Fold fresh completion observations into the shared cost
+                # model first: this tick's WFQ pick and speculation
+                # decisions price off the newest evidence.
+                self.cost_service.ingest(self.live_workers(), self._job_for_name)
+                inputs = self._share_inputs()
+                targets = self._compute_targets(inputs)
+                self._account_shares(dt, targets, inputs)
+                await self._dispatch_tick(inputs)
                 if self.config.preemption:
                     await self._preempt_tick()
+                if self.speculation.config.enabled:
+                    # Tail hedging per running job AFTER dispatch: an idle
+                    # worker only receives a speculative twin when no
+                    # pending work exists for it (maybe_launch gates on
+                    # the job's own pool; the dispatch pass above already
+                    # consumed every globally-runnable frame this tick).
+                    workers = self.live_workers()
+                    for job_id in list(self._running):
+                        run = self._runs[job_id]
+                        if run.state is not None:
+                            await self.speculation.tick(
+                                run.spec.job, run.state, workers, job_id=job_id
+                            )
                 self._finalize_finished_jobs(time.time())
             await asyncio.sleep(self.config.tick_seconds)
 
@@ -478,14 +505,19 @@ class JobManager(ClusterManager):
             if (
                 run.state is not None
                 and run.state.all_frames_finished()
-                and self.assembly.has_pending(run.job_name)
+                and (
+                    self.assembly.has_pending(run.job_name)
+                    or run.state.speculations
+                )
             ):
-                # A tiled job's last stitches are still writing: stay
-                # RUNNING (and keep the name reserved) until they land —
-                # a status poll must never say "finished" before the
-                # frame files exist, and a same-name resubmit must not
-                # race the old stitcher on the same output path. The
-                # next tick finalizes.
+                # A tiled job's last stitches are still writing — or a
+                # speculation race is unresolved (the winner just landed;
+                # the next speculation tick must unqueue the loser and
+                # account the outcome): stay RUNNING (and keep the name
+                # reserved) until both settle — a status poll must never
+                # say "finished" before the frame files exist, and a
+                # same-name resubmit must not race the old stitcher on
+                # the same output path. The next tick finalizes.
                 continue
             if run.state is not None and run.state.all_frames_finished():
                 # Ghost copies of units an accepted late result finished:
@@ -507,6 +539,25 @@ class JobManager(ClusterManager):
     def _total_slots(self) -> int:
         return self.config.target_queue_size * len(self.live_workers())
 
+    def _in_flight_cost(self, run: JobRun) -> float | None:
+        """The job's in-flight work in predicted seconds, or None before
+        the cost model has any worker history (all jobs fall back to unit
+        counts together — the inputs stay commensurable)."""
+        if not self.cost_service.model.has_history():
+            return None
+        assert run.state is not None
+        total = 0.0
+        for unit, record in run.state.frames.items():
+            if record.status not in (
+                FrameStatus.QUEUED_ON_WORKER,
+                FrameStatus.RENDERING_ON_WORKER,
+            ) or record.worker_id is None:
+                continue
+            total += self.cost_service.predict_unit_seconds(
+                record.worker_id, unit, run.spec.job
+            )
+        return total
+
     def _share_inputs(self) -> list[fair_share.JobShareInput]:
         out = []
         for job_id in self._running:
@@ -519,20 +570,32 @@ class JobManager(ClusterManager):
                     priority=run.spec.priority,
                     in_flight=run.state.in_flight_count(),
                     pending=run.state.pending_count(),
+                    in_flight_cost=self._in_flight_cost(run),
                 )
             )
         return out
 
-    def _compute_targets(self) -> dict[str, float]:
-        return fair_share.compute_slot_targets(
-            self._share_inputs(), self._total_slots()
-        )
+    def _compute_targets(
+        self, inputs: list[fair_share.JobShareInput] | None = None
+    ) -> dict[str, float]:
+        # ``inputs`` lets the tick loop compute _share_inputs (an
+        # O(frames)-per-job scan for the predicted in-flight cost) ONCE
+        # and reuse it across targets/accounting/dispatch.
+        if inputs is None:
+            inputs = self._share_inputs()
+        return fair_share.compute_slot_targets(inputs, self._total_slots())
 
-    def _account_shares(self, dt: float, targets: dict[str, float]) -> None:
+    def _account_shares(
+        self,
+        dt: float,
+        targets: dict[str, float],
+        inputs: list[fair_share.JobShareInput] | None = None,
+    ) -> None:
         """Fold one tick into the share gauges + overlap-window integrals."""
         if dt <= 0.0:
             return
-        inputs = self._share_inputs()
+        if inputs is None:
+            inputs = self._share_inputs()
         total_slots = self._total_slots()
         total_in_flight = sum(job.in_flight for job in inputs)
         overlapping = len(inputs) >= 2
@@ -563,13 +626,19 @@ class JobManager(ClusterManager):
                 run.overlap_target_integral += target_share * dt
                 run.overlap_seconds += dt
 
-    async def _dispatch_tick(self) -> None:
+    async def _dispatch_tick(
+        self, inputs: list[fair_share.JobShareInput] | None = None
+    ) -> None:
         """Fill every under-target worker with the fairest job's frames."""
         # Local counters adjusted as dispatches land, so one tick's fills
         # interleave jobs fairly instead of recounting O(frames) per slot.
-        counts: dict[str, list[int]] = {}
-        for job in self._share_inputs():
-            counts[job.job_id] = [job.in_flight, job.pending]
+        # The third element is the job's predicted in-flight seconds
+        # (None before cost-model history): the WFQ pick meters load by
+        # it, and each dispatch folds its unit's prediction in so one
+        # tick's fills stay cost-fair too.
+        counts: dict[str, list] = {}
+        for job in inputs if inputs is not None else self._share_inputs():
+            counts[job.job_id] = [job.in_flight, job.pending, job.in_flight_cost]
 
         def inputs_now() -> list[fair_share.JobShareInput]:
             out = []
@@ -577,7 +646,7 @@ class JobManager(ClusterManager):
                 if job_id not in counts:
                     continue
                 run = self._runs[job_id]
-                in_flight, pending = counts[job_id]
+                in_flight, pending, in_flight_cost = counts[job_id]
                 out.append(
                     fair_share.JobShareInput(
                         job_id=job_id,
@@ -585,6 +654,7 @@ class JobManager(ClusterManager):
                         priority=run.spec.priority,
                         in_flight=in_flight,
                         pending=pending,
+                        in_flight_cost=in_flight_cost,
                     )
                 )
             return out
@@ -600,11 +670,24 @@ class JobManager(ClusterManager):
                     return  # nothing pending anywhere
                 run = self._runs[job_id]
                 assert run.state is not None
+                # Price the unit dispatch_one_pending is about to claim
+                # (the pool head) BEFORE the await so the local cost
+                # ledger can fold it in when the RPC lands.
+                next_unit = run.state.next_pending_unit()
+                predicted = (
+                    self.cost_service.predict_unit_seconds(
+                        worker.worker_id, next_unit, run.spec.job
+                    )
+                    if next_unit is not None
+                    else 0.0
+                )
                 if await dispatch_one_pending(
                     worker, run.spec.job, run.state, job_id=job_id
                 ):
                     counts[job_id][0] += 1
                     counts[job_id][1] -= 1
+                    if counts[job_id][2] is not None:
+                        counts[job_id][2] += predicted
                 else:
                     # Dispatch failed (worker died mid-RPC, cancel raced,
                     # or the pending pool emptied under us): stop filling
@@ -616,8 +699,12 @@ class JobManager(ClusterManager):
         # 0 legitimately disables per-tick preemption without touching
         # TRC_SCHED_PREEMPTION.
         for _ in range(max(0, self.config.max_preemptions_per_tick)):
-            targets = self._compute_targets()
-            decision = fair_share.pick_preemption(self._share_inputs(), targets)
+            # Recomputed per iteration on purpose (dispatch and any prior
+            # preemption changed the in-flight picture) — but ONCE per
+            # iteration, shared by targets and the preemption pick.
+            inputs = self._share_inputs()
+            targets = self._compute_targets(inputs)
+            decision = fair_share.pick_preemption(inputs, targets)
             if decision is None:
                 return
             over_id, starved_id = decision
