@@ -1091,6 +1091,15 @@ def main() -> int:
             record["wasted_lane_fraction"] = round(wasted, 4)
     except Exception as e:  # noqa: BLE001 - the probe must not kill the bench
         print(f"warning: lane occupancy probe failed: {e}", file=sys.stderr)
+    # Per-kernel roofline placements captured during this run (the
+    # occupancy probe's wavefront launches and any instrumented renderer
+    # the timed windows exercised) — obs/profiling.py's view, the same
+    # section statistics.json folds from run artifacts.
+    from tpu_render_cluster.obs.profiling import get_profiler
+
+    roofline = get_profiler().view()
+    if roofline:
+        record["roofline"] = roofline
     print(json.dumps(record))
     return 0
 

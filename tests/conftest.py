@@ -63,4 +63,10 @@ def _isolated_render_compile_tracking():
     compaction = sys.modules.get("tpu_render_cluster.render.compaction")
     if compaction is not None:
         compaction.reset_compile_tracking()
+    # Same reasoning for the kernel roofline profiler (obs/profiling.py):
+    # its capture/execution store is process-global and cumulative, so
+    # per-kernel assertions must start from a clean slate each test.
+    profiling = sys.modules.get("tpu_render_cluster.obs.profiling")
+    if profiling is not None:
+        profiling.get_profiler().reset()
     yield

@@ -622,6 +622,7 @@ class TestMirrorJobIsolation:
         handle._rendering_started_at = {}
         handle._completion_observations = []
         handle._on_frame_complete = None
+        handle._on_unit_latency = None
         handle.logger = WorkerLogger(
             _logging.getLogger("test"), "000000ab", "test"
         )

@@ -607,6 +607,139 @@ def summarize_prediction(metrics: list[dict[str, Any]]) -> dict[str, Any] | None
     return out
 
 
+def summarize_slo(metrics: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """Roll the SLO engine's evidence up (obs/slo.py).
+
+    Two sources: the live ``slo`` section a master's cluster_view stamps
+    into its snapshots (per-job attainment, burn windows, firing set, and
+    the bounded alert log — newest snapshot wins, it is cumulative), and
+    the ``slo_alerts_total`` registry counter (fire/clear edges per job
+    and kind, summed across snapshot families). None when no snapshot
+    carries either — jobs without an ``[slo]`` table get no section.
+    """
+    alerts_total: dict[str, float] = {}
+    live: dict[str, Any] | None = None
+    live_at = -1.0
+
+    def take_registry(names: dict[str, Any]) -> bool:
+        counter = names.get("slo_alerts_total")
+        if not counter:
+            return False
+        for label, value in counter.get("series", {}).items():
+            alerts_total[label or "total"] = alerts_total.get(
+                label or "total", 0.0
+            ) + float(value)
+        return True
+
+    def take_wire(wire: dict[str, Any]) -> None:
+        for key, value in (wire.get("c") or {}).items():
+            name, _, label = key.partition("|")
+            if name == "slo_alerts_total":
+                alerts_total[label or "total"] = alerts_total.get(
+                    label or "total", 0.0
+                ) + float(value)
+
+    _consume_metric_snapshots(metrics, take_registry, take_wire)
+    for snapshot in metrics:
+        written_at = float(snapshot.get("written_at", 0.0))
+        view = snapshot.get("slo")
+        if isinstance(view, dict) and view and written_at >= live_at:
+            live = view
+            live_at = written_at
+    if live is None and not alerts_total:
+        return None
+    out: dict[str, Any] = {}
+    if live is not None:
+        if isinstance(live.get("jobs"), dict):
+            out["jobs"] = live["jobs"]
+        if live.get("alerts"):
+            out["alerts"] = live["alerts"]
+    if alerts_total:
+        out["alerts_total"] = alerts_total
+    return out
+
+
+def summarize_roofline(metrics: list[dict[str, Any]]) -> dict[str, Any] | None:
+    """Roll the kernel roofline evidence up (obs/profiling.py).
+
+    The full per-kernel view (FLOPs, bytes, executions, measured seconds,
+    achieved-vs-peak placement) rides in the ``roofline`` section workers
+    / the harness / bench stamp into their metrics snapshots; kernels are
+    merged across snapshots with newest-wins per kernel key (each
+    process's profiler is cumulative). The registry-only gauge family
+    (``render_kernel_*``) additionally contributes FLOPs/bytes/achieved
+    rows for kernels whose process exported metrics but no stamped
+    section (e.g. a heartbeat-wire-only worker). None when nothing was
+    profiled.
+    """
+    kernels: dict[str, dict[str, Any]] = {}
+    kernel_at: dict[str, float] = {}
+    peaks: dict[str, Any] | None = None
+    peaks_at = -1.0
+    gauge_rows: dict[str, dict[str, float]] = {}
+
+    def _fold_gauge(names: dict[str, Any], metric: str, field: str) -> bool:
+        entry = names.get(metric)
+        if not entry:
+            return False
+        for label, value in entry.get("series", {}).items():
+            kernel = label.partition("=")[2] or label
+            gauge_rows.setdefault(kernel, {})[field] = float(value)
+        return True
+
+    def take_registry(names: dict[str, Any]) -> bool:
+        took = False
+        for metric, field in (
+            ("render_kernel_flops", "flops"),
+            ("render_kernel_bytes", "bytes_accessed"),
+            (
+                "render_kernel_achieved_flops_per_second",
+                "achieved_flops_per_second",
+            ),
+        ):
+            took = _fold_gauge(names, metric, field) or took
+        return took
+
+    def take_wire(wire: dict[str, Any]) -> None:
+        for key, value in (wire.get("g") or {}).items():
+            name, _, label = key.partition("|")
+            kernel = label.partition("=")[2] or label
+            if name == "render_kernel_flops":
+                gauge_rows.setdefault(kernel, {})["flops"] = float(value)
+            elif name == "render_kernel_bytes":
+                gauge_rows.setdefault(kernel, {})["bytes_accessed"] = float(value)
+            elif name == "render_kernel_achieved_flops_per_second":
+                gauge_rows.setdefault(kernel, {})[
+                    "achieved_flops_per_second"
+                ] = float(value)
+
+    _consume_metric_snapshots(metrics, take_registry, take_wire)
+    for snapshot in metrics:
+        written_at = float(snapshot.get("written_at", 0.0))
+        section = snapshot.get("roofline")
+        if not isinstance(section, dict):
+            continue
+        if isinstance(section.get("peaks"), dict) and written_at >= peaks_at:
+            peaks = section["peaks"]
+            peaks_at = written_at
+        for kernel, entry in (section.get("kernels") or {}).items():
+            if isinstance(entry, dict) and written_at >= kernel_at.get(
+                kernel, -1.0
+            ):
+                kernels[kernel] = entry
+                kernel_at[kernel] = written_at
+    # Gauge-only kernels (no stamped section covered them) still get a row.
+    for kernel, fields in gauge_rows.items():
+        if kernel not in kernels:
+            kernels[kernel] = dict(fields)
+    if not kernels:
+        return None
+    out: dict[str, Any] = {"kernels": kernels}
+    if peaks is not None:
+        out["peaks"] = peaks
+    return out
+
+
 _CHAOS_LEDGER_COUNTERS = (
     "master_frame_results_total",
     "master_duplicate_results_total",
@@ -720,6 +853,12 @@ def summarize_obs(
     prediction = summarize_prediction(metrics)
     if prediction is not None:
         out["prediction"] = prediction
+    slo = summarize_slo(metrics)
+    if slo is not None:
+        out["slo"] = slo
+    roofline = summarize_roofline(metrics)
+    if roofline is not None:
+        out["roofline"] = roofline
     if cluster_traces:
         from tpu_render_cluster.analysis.critical_path import (
             summarize_critical_path,

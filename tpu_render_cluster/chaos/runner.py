@@ -108,7 +108,7 @@ class ChaosReport:
 
 
 def _make_job(
-    plan: FaultPlan, frames: int, strategy, tile_grid=None
+    plan: FaultPlan, frames: int, strategy, tile_grid=None, slo=None
 ) -> BlenderJob:
     if strategy is None:
         # Dynamic (work-stealing) by default: the strategy with the most
@@ -135,6 +135,7 @@ def _make_job(
         output_file_name_format="rendered-#####",
         output_file_format="PNG",
         tile_grid=tile_grid,
+        slo=slo,
     )
 
 
@@ -257,6 +258,7 @@ def run_chaos_job(
     render_seconds: float = DEFAULT_RENDER_SECONDS,
     timeout: float = 180.0,
     tile_grid: tuple[int, int] | None = None,
+    slo=None,
 ) -> ChaosReport:
     """Run one seeded chaos job end to end and audit the invariants.
 
@@ -265,8 +267,13 @@ def run_chaos_job(
     duplicates, and drains against sub-frame units and the master's
     per-frame assembly ledger — audited at tile granularity
     (``invariants.check_tile_invariants``).
+
+    ``slo`` (a ``jobs.models.JobSlo``) declares objectives on the chaos
+    job so seeded fault schedules can drive the SLO engine into breach;
+    the report's ``stats["slo"]`` then carries the final per-job
+    attainment/burn view and the alert edge ledger.
     """
-    job = _make_job(plan, frames, strategy, tile_grid)
+    job = _make_job(plan, frames, strategy, tile_grid, slo)
     registries = [MetricsRegistry() for _ in range(plan.workers)]
     controllers = [
         WorkerChaosController(slot, plan.events_for(slot), registry=registries[slot])
@@ -342,6 +349,8 @@ def run_chaos_job(
     }
     if manager.speculation.config.enabled or manager.speculation.launched_total:
         stats["speculation"] = manager.speculation.view()
+    if manager.slo.tracked():
+        stats["slo"] = manager.slo.view()
     return ChaosReport(
         plan=plan, violations=violations, stats=stats, artifacts=artifacts
     )

@@ -62,6 +62,73 @@ class TpuBatchStrategyOptions:
     cost_ema_alpha: float = 0.3
 
 
+@dataclass(frozen=True)
+class JobSlo:
+    """Per-job service-level objectives (new; absent from reference TOMLs).
+
+    Declared in the job TOML as an ``[slo]`` table; the master's SLO
+    engine (obs/slo.py) tracks attainment and multi-window burn rate
+    online and fires structured alerts when an objective burns.
+
+    - ``unit_latency_p99_seconds``: 99% of work units must go
+      dispatch-to-result within this bound (measured on the
+      ``master_unit_latency_seconds`` stream);
+    - ``deadline_seconds``: the whole job must finish within this many
+      seconds of starting.
+    """
+
+    unit_latency_p99_seconds: float | None = None
+    deadline_seconds: float | None = None
+
+    def __post_init__(self) -> None:
+        problems = []
+        for name in ("unit_latency_p99_seconds", "deadline_seconds"):
+            value = getattr(self, name)
+            # bool is an int subclass: `deadline_seconds = true` in TOML
+            # must be an error, not a 1-second objective.
+            if value is not None and (
+                isinstance(value, bool)
+                or not isinstance(value, (int, float))
+                or not value > 0
+            ):
+                problems.append(f"slo.{name} must be a positive number, got {value!r}")
+        if (
+            self.unit_latency_p99_seconds is None
+            and self.deadline_seconds is None
+        ):
+            problems.append(
+                "[slo] table declares no objective (set "
+                "unit_latency_p99_seconds and/or deadline_seconds)"
+            )
+        if problems:
+            raise ValueError("; ".join(problems))
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        if self.unit_latency_p99_seconds is not None:
+            out["unit_latency_p99_seconds"] = self.unit_latency_p99_seconds
+        if self.deadline_seconds is not None:
+            out["deadline_seconds"] = self.deadline_seconds
+        return out
+
+    @classmethod
+    def from_dict(cls, data: dict[str, Any]) -> "JobSlo":
+        if not isinstance(data, dict):
+            raise ValueError(f"slo must be a table, got {data!r}")
+        unknown = set(data) - {"unit_latency_p99_seconds", "deadline_seconds"}
+        if unknown:
+            raise ValueError(f"unknown slo key(s): {sorted(unknown)}")
+        def _num(key: str):
+            value = data.get(key)
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                return float(value)
+            return value  # __post_init__ rejects non-numbers (incl. bools)
+        return cls(
+            unit_latency_p99_seconds=_num("unit_latency_p99_seconds"),
+            deadline_seconds=_num("deadline_seconds"),
+        )
+
+
 STRATEGY_NAIVE_FINE = "naive-fine"
 STRATEGY_EAGER_NAIVE_COARSE = "eager-naive-coarse"
 STRATEGY_DYNAMIC = "dynamic"
@@ -194,6 +261,10 @@ class BlenderJob:
     # re-assembles (master/assembly.py). None (the reference contract)
     # keeps whole-frame units and byte-identical wire traffic.
     tile_grid: tuple[int, int] | None = None
+    # New (optional): per-job service-level objectives ([slo] TOML table).
+    # Master-side only — workers ignore it; absent = no SLO tracking and
+    # reference-identical serialization.
+    slo: JobSlo | None = None
 
     def __post_init__(self) -> None:
         """Reject structurally-broken jobs at load time, not mid-dispatch.
@@ -248,6 +319,14 @@ class BlenderJob:
                     validate_tile_grid(grid)
                 except ValueError as e:
                     problems.append(str(e))
+        if self.slo is not None and not isinstance(self.slo, JobSlo):
+            # Raw TOML table through from_dict: normalize like tile_grid,
+            # landing malformed declarations in the aggregated report.
+            try:
+                object.__setattr__(self, "slo", JobSlo.from_dict(self.slo))
+            except ValueError as e:
+                problems.append(str(e))
+                object.__setattr__(self, "slo", None)
         if problems:
             raise ValueError(
                 f"Invalid job {self.job_name!r}: " + "; ".join(problems)
@@ -301,6 +380,8 @@ class BlenderJob:
             out["render_backend"] = self.render_backend
         if self.tile_grid is not None:
             out["tiles"] = list(self.tile_grid)
+        if self.slo is not None:
+            out["slo"] = self.slo.to_dict()
         return out
 
     @classmethod
@@ -324,6 +405,7 @@ class BlenderJob:
             # malformed tiles key gets the aggregated 'Invalid job' error
             # instead of a bare int() traceback here.
             tile_grid=data.get("tiles"),
+            slo=data.get("slo"),
         )
 
     @classmethod

@@ -42,6 +42,8 @@ from tpu_render_cluster.obs import (
     merge_wire,
     tracer_process,
 )
+from tpu_render_cluster.obs.http import TelemetryServer
+from tpu_render_cluster.obs.slo import SloService, slo_loop
 from tpu_render_cluster.protocol import messages as pm
 from tpu_render_cluster.traces.master_trace import MasterTrace
 from tpu_render_cluster.traces.worker_trace import WorkerTrace
@@ -97,6 +99,7 @@ class ClusterManager:
         metrics_snapshot_path: str | Path | None = None,
         dispatch_delay_fn=None,
         output_base_directory: str | Path | None = None,
+        telemetry_port: int | None = None,
     ) -> None:
         self.host = host
         self.port = port
@@ -159,6 +162,25 @@ class ClusterManager:
             metrics=self.metrics,
             span_tracer=self.span_tracer,
         )
+        # Per-job SLO engine (obs/slo.py): fed by every winning result's
+        # dispatch-to-result latency, ticked by a sidecar (single-job) or
+        # the scheduler loop (service mode). Inert for jobs without an
+        # [slo] table.
+        self.slo = SloService(metrics=self.metrics, span_tracer=self.span_tracer)
+        # Pull-based telemetry endpoints (obs/http.py): /metrics (Prom
+        # text exposition), /healthz, /clusterz (cluster_view). None =
+        # disabled; 0 = ephemeral port (resolved after _bind_server).
+        self.telemetry = (
+            TelemetryServer(
+                self.metrics,
+                host=host,
+                port=telemetry_port,
+                clusterz_fn=self.cluster_view,
+                healthz_fn=self._healthz_view,
+            )
+            if telemetry_port is not None
+            else None
+        )
         # When set, a 1 Hz SnapshotWriter keeps this file fresh while the
         # job runs (live inspection), with a final write at shutdown.
         self._snapshot_writer = (
@@ -209,9 +231,21 @@ class ClusterManager:
         logger.info("Master listening on %s:%d", self.host, actual_port)
         if self._snapshot_writer is not None:
             self._snapshot_writer.start()
+        if self.telemetry is not None:
+            await self.telemetry.start()
+
+    def _healthz_view(self) -> dict:
+        return {
+            "role": "master",
+            "workers_connected": len(self.workers),
+            "workers_live": len(self.live_workers()),
+            "job_started": self._job_started,
+        }
 
     async def _shutdown_server(self) -> None:
         """Stop the writer, cancel, close worker sockets, close the server."""
+        if self.telemetry is not None:
+            await self.telemetry.stop()
         if self._snapshot_writer is not None:
             await self._snapshot_writer.stop()
         self.cancellation.cancel()
@@ -301,6 +335,8 @@ class ClusterManager:
             view["prediction"] = prediction
         if self.speculation.config.enabled or self.speculation.launched_total:
             view["speculation"] = self.speculation.view()
+        if self.slo.tracked():
+            view["slo"] = self.slo.view()
         if worker_payloads:
             view["worker_metrics"] = worker_payloads
             # Payloads crossed the wire from workers we don't control;
@@ -457,6 +493,7 @@ class ClusterManager:
             dispatch_delay_fn=dispatch_delay_fn,
             state_resolver=self._state_for_job,
             on_frame_complete=self.assembly.schedule,
+            on_unit_latency=self.slo.observe_unit_latency,
         )
         self.workers[worker_id] = worker
         worker.start()
@@ -551,9 +588,10 @@ class ClusterManager:
             await worker.send_job_started()
 
         self.metrics.gauge(
-            "master_frames_total", "Frames in the job's frame table"
+            "master_job_units", "Work units in the job's frame table"
         ).set(len(self.state.frames))
         start = time.time()
+        self.slo.register_job(self.job, started_at=start)
         with self.span_tracer.span(
             "run job",
             cat="master",
@@ -573,6 +611,16 @@ class ClusterManager:
                 ),
                 name="speculation-loop",
             )
+            # SLO sidecar: periodic burn/deadline evaluation while the
+            # strategy runs (only for jobs that declared objectives).
+            slo_task = (
+                asyncio.create_task(
+                    slo_loop(self.slo, self.state, self.cancellation),
+                    name="slo-loop",
+                )
+                if self.job.slo is not None
+                else None
+            )
             try:
                 await run_strategy(
                     self.job,
@@ -589,6 +637,13 @@ class ClusterManager:
                 if not spec_task.done():
                     spec_task.cancel()
                     await asyncio.gather(spec_task, return_exceptions=True)
+                if slo_task is not None:
+                    slo_task.cancel()
+                    await asyncio.gather(slo_task, return_exceptions=True)
+                # Final SLO evaluation at the job's true end time — the
+                # deadline verdict and the closing attainment are stamped
+                # whether the strategy finished or raised.
+                self.slo.finish_job(self.job.job_name)
                 # Accepted late results can finish a unit while its
                 # re-dispatched twin still sits queued on a live worker;
                 # the job is over, so those mirror entries are ghosts now

@@ -24,6 +24,7 @@ from tpu_render_cluster.master.cluster import ClusterManager
 from tpu_render_cluster.master.persist import (
     parse_worker_traces,
     print_results,
+    run_file_prefix,
     save_cost_model,
     save_processed_results,
     save_raw_traces,
@@ -37,6 +38,18 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=9901)
     parser.add_argument("--logFilePath", dest="log_file_path", default=None)
+    parser.add_argument(
+        "--telemetryPort",
+        dest="telemetry_port",
+        type=int,
+        default=None,
+        help="Serve live pull-based telemetry over HTTP on this port: "
+        "/metrics (Prometheus text exposition), /healthz, /clusterz "
+        "(the live cluster_view). 0 picks an ephemeral port (printed). "
+        "Defaults to the TRC_OBS_PORT environment variable; omit both to "
+        "disable. This is the live path — metrics-live.json stays for "
+        "file-based consumers.",
+    )
     subparsers = parser.add_subparsers(dest="command", required=True)
     run_job = subparsers.add_parser("run-job", help="Run a job to completion")
     run_job.add_argument("job_file_path")
@@ -94,6 +107,13 @@ def build_parser() -> argparse.ArgumentParser:
     return parser
 
 
+def resolved_telemetry_port(args: argparse.Namespace) -> int | None:
+    """The CLI flag, else the ``TRC_OBS_PORT`` env default, else disabled."""
+    from tpu_render_cluster.obs.http import resolve_telemetry_port
+
+    return resolve_telemetry_port(args.telemetry_port, "TRC_OBS_PORT")
+
+
 async def serve_command(args: argparse.Namespace) -> int:
     from tpu_render_cluster.sched.control import ControlServer
     from tpu_render_cluster.sched.manager import JobManager
@@ -108,6 +128,7 @@ async def serve_command(args: argparse.Namespace) -> int:
         args.port,
         metrics_snapshot_path=results_directory / "metrics-live.json",
         output_base_directory=args.base_directory,
+        telemetry_port=resolved_telemetry_port(args),
     )
     # A restarted service re-learns worker speeds from its own previous
     # shutdown snapshot (explicit TRC_COST_MODEL wins; saved again below).
@@ -130,28 +151,56 @@ async def serve_command(args: argparse.Namespace) -> int:
         f"python -m tpu_render_cluster.sched.submit --host {args.host} "
         f"--controlPort {control.port} submit <job.toml>."
     )
+    if manager.telemetry is not None:
+        # The resolved (possibly ephemeral) port is logged by
+        # TelemetryServer.start() once serve() binds.
+        print(
+            "Telemetry endpoints (once bound): /metrics /healthz /clusterz "
+            f"on port {manager.telemetry.port or '<ephemeral>'}"
+        )
     try:
         await manager.serve()
     finally:
         await control.stop()
-        # Final drain of completion observations (the last frames' results
-        # can land after the scheduler loop's last ingest tick).
-        manager.cost_service.ingest(
-            manager.workers.values(), manager._job_for_name
-        )
-        save_model_snapshot(manager.cost_service.model, sched_model_path)
-    prefix = f"sched-{datetime.now().strftime('%Y-%m-%d_%H-%M-%S')}"
-    manager.span_tracer.export(results_directory / f"{prefix}_trace-events.json")
-    export_cluster_trace(
-        results_directory / f"{prefix}_cluster_trace-events.json",
-        manager.cluster_timeline_processes(),
-        extra_other_data=manager.timeline_other_data(),
-    )
-    write_metrics_snapshot(
-        results_directory / f"{prefix}_metrics.json",
-        manager.metrics,
-        extra=manager.cluster_view(),
-    )
+
+        # Artifact export runs on FAILURE paths too (same pattern as the
+        # assembly drain): a service that died mid-run is exactly the one
+        # whose partial timeline and final ledger snapshot matter most.
+        # Guarded per step so an export failure can neither mask the
+        # service's real exception nor take the later writers down.
+        def _save_model() -> None:
+            # Final drain of completion observations (the last frames'
+            # results can land after the scheduler loop's last ingest
+            # tick).
+            manager.cost_service.ingest(
+                manager.workers.values(), manager._job_for_name
+            )
+            save_model_snapshot(manager.cost_service.model, sched_model_path)
+
+        def _export_obs_artifacts() -> None:
+            prefix = f"sched-{datetime.now().strftime('%Y-%m-%d_%H-%M-%S')}"
+            manager.span_tracer.export(
+                results_directory / f"{prefix}_trace-events.json"
+            )
+            export_cluster_trace(
+                results_directory / f"{prefix}_cluster_trace-events.json",
+                manager.cluster_timeline_processes(),
+                extra_other_data=manager.timeline_other_data(),
+            )
+            write_metrics_snapshot(
+                results_directory / f"{prefix}_metrics.json",
+                manager.metrics,
+                extra=manager.cluster_view(),
+            )
+
+        for step in (_save_model, _export_obs_artifacts):
+            try:
+                step()
+            except Exception as e:  # noqa: BLE001 - obs must not mask the run error
+                print(
+                    f"warning: obs artifact export failed: {e}",
+                    file=sys.stderr,
+                )
     view = manager.scheduler_view()
     print(json.dumps({"jobs": view["jobs"]}, indent=2, default=str))
     return 0
@@ -172,6 +221,7 @@ async def run_job_command(args: argparse.Namespace) -> int:
         # Tiled jobs: the assembly stitcher resolves the job's %BASE%
         # output prefix with the same base directory resume does.
         output_base_directory=args.base_directory,
+        telemetry_port=resolved_telemetry_port(args),
     )
     if args.resume:
         from tpu_render_cluster.master.resume import apply_resume, load_cost_model
@@ -203,35 +253,59 @@ async def run_job_command(args: argparse.Namespace) -> int:
     from tpu_render_cluster.ops import assignment as assignment_ops
 
     assignment_ops.reset_greedy_fallback_count()
-    master_trace, worker_traces = await manager.initialize_server_and_run_job()
-
     results_directory = Path(args.results_directory)
-    raw_path = save_raw_traces(
+    prefix = run_file_prefix(start_time, job)
+    try:
+        master_trace, worker_traces = await manager.initialize_server_and_run_job()
+    finally:
+        # Obs artifacts are written even when the job RAISES (worker-pool
+        # collapse, unit error budget, operator interrupt): the partial
+        # span timeline, merged cluster trace, and final metrics/ledger
+        # snapshot matter most in exactly those runs. Same pattern as the
+        # assembly drain-on-failure. The prefix matches the raw trace the
+        # success path writes below. Each writer is guarded independently:
+        # an export failure (full disk, revoked permissions) must neither
+        # mask the job's real exception nor take the later writers down.
+        def _export_obs_artifacts() -> None:
+            manager.span_tracer.export(
+                results_directory / f"{prefix}_trace-events.json"
+            )
+            # Merged cluster timeline: the workers' span events
+            # (piggybacked on their job-finished responses) rebased onto
+            # the master clock by the heartbeat clock-offset estimates —
+            # one Perfetto file with a process row per worker and flow
+            # arrows per frame lifecycle.
+            export_cluster_trace(
+                results_directory / f"{prefix}_cluster_trace-events.json",
+                manager.cluster_timeline_processes(),
+            )
+            write_metrics_snapshot(
+                results_directory / f"{prefix}_metrics.json",
+                manager.metrics,
+                extra=manager.cluster_view(),
+            )
+
+        for step in (
+            _export_obs_artifacts,
+            # Snapshot the run's learned cost model so --resume (or a
+            # plain re-run of the same job) starts with warm predictors
+            # instead of re-learning worker speeds from scratch. Failure
+            # paths keep it too — exactly what a resume restores.
+            lambda: save_cost_model(
+                job, results_directory, manager.cost_service.model
+            ),
+        ):
+            try:
+                step()
+            except Exception as e:  # noqa: BLE001 - obs must not mask the run error
+                print(
+                    f"warning: obs artifact export failed: {e}",
+                    file=sys.stderr,
+                )
+
+    save_raw_traces(
         start_time, job, results_directory, master_trace, worker_traces
     )
-    # Master-side obs artifacts next to the raw trace: live span timeline
-    # (Perfetto-loadable) + final metrics snapshot with the aggregated
-    # per-worker heartbeat payloads. The live 1 Hz snapshot the manager
-    # kept during the run is replaced by this final write.
-    prefix = raw_path.name.replace("_raw-trace.json", "")
-    manager.span_tracer.export(results_directory / f"{prefix}_trace-events.json")
-    # Merged cluster timeline: the workers' span events (piggybacked on
-    # their job-finished responses) rebased onto the master clock by the
-    # heartbeat clock-offset estimates — one Perfetto file with a process
-    # row per worker and flow arrows for every frame's lifecycle.
-    export_cluster_trace(
-        results_directory / f"{prefix}_cluster_trace-events.json",
-        manager.cluster_timeline_processes(),
-    )
-    write_metrics_snapshot(
-        results_directory / f"{prefix}_metrics.json",
-        manager.metrics,
-        extra=manager.cluster_view(),
-    )
-    # Snapshot the run's learned cost model so --resume (or a plain
-    # re-run of the same job) starts with warm predictors instead of
-    # re-learning worker speeds from scratch.
-    save_cost_model(job, results_directory, manager.cost_service.model)
     performance = parse_worker_traces(worker_traces)
     save_processed_results(
         start_time,

@@ -27,11 +27,18 @@ from tpu_render_cluster.traces.worker_trace import WorkerTrace
 logger = logging.getLogger(__name__)
 
 
-def _file_prefix(start_time: datetime, job: BlenderJob) -> str:
+def run_file_prefix(start_time: datetime, job: BlenderJob) -> str:
+    """The shared ``<timestamp>_job-<name>`` artifact prefix — public so
+    the CLI's failure path can name obs artifacts BEFORE the raw trace
+    (whose writer derives the same prefix) exists."""
     return (
         f"{start_time.strftime('%Y-%m-%d_%H-%M-%S')}"
         f"_job-{job.job_name.replace(' ', '_')}"
     )
+
+
+# Internal alias kept for the writers below.
+_file_prefix = run_file_prefix
 
 
 def cost_model_snapshot_path(job: BlenderJob, output_directory: Path) -> Path:

@@ -105,6 +105,8 @@ class WorkerHandle:
         | None = None,
         on_frame_complete: Callable[[ClusterManagerState, int], None]
         | None = None,
+        on_unit_latency: Callable[[ClusterManagerState, WorkUnit, float], None]
+        | None = None,
     ) -> None:
         self.worker_id = worker_id
         self.connection = connection
@@ -141,6 +143,9 @@ class WorkerHandle:
         # implementation schedules its own task so event handling never
         # blocks on image stitching.
         self._on_frame_complete = on_frame_complete
+        # Fires with each unit's winning-result dispatch-to-result latency
+        # (the master_unit_latency_seconds stream) — the SLO engine's feed.
+        self._on_unit_latency = on_unit_latency
         # Observed per-unit render durations (for scheduler cost models),
         # keyed (job_name, unit) — frame indices alias across jobs.
         self._rendering_started_at: dict[tuple[str, WorkUnit], float] = {}
@@ -901,6 +906,8 @@ class WorkerHandle:
                 "Dispatch-to-result latency of each unit's winning "
                 "assignment (queue-add to result received)",
             ).observe(latency)
+        if self._on_unit_latency is not None:
+            self._on_unit_latency(state, unit, latency)
 
     def _finish_unit(self, state: ClusterManagerState, unit: WorkUnit) -> None:
         """Mark a unit finished; when it completes its whole frame, fire
@@ -1121,12 +1128,15 @@ class WorkerHandle:
         label = self._worker_label()
         self.metrics.gauge(
             "master_worker_clock_offset_seconds",
-            "Estimated worker-minus-master wall clock offset "
-            "(median of the heartbeat NTP window)",
+            "Estimated worker-minus-master wall clock offset in SECONDS "
+            "(median of the heartbeat NTP window; positive = the worker "
+            "clock reads ahead of the master)",
             labels=("worker",),
         ).set(self.clock_offset.offset(), worker=label)
         self.metrics.gauge(
             "master_worker_clock_drift_ppm",
-            "Estimated worker clock drift rate vs the master (ppm)",
+            "Estimated worker clock drift rate vs the master in "
+            "parts-per-million (microseconds of divergence per elapsed "
+            "second; positive = the worker clock runs fast)",
             labels=("worker",),
         ).set(self.clock_offset.drift_ppm(), worker=label)

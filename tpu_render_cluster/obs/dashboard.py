@@ -1,0 +1,278 @@
+"""Live terminal dashboard over the pull-based telemetry endpoints.
+
+``python -m tpu_render_cluster.obs.dashboard --port <telemetryPort>``
+polls a master's ``/metrics`` (Prometheus text exposition, parsed with
+``obs.prometheus.parse_prometheus``) and ``/clusterz`` (the live
+``cluster_view()``) and redraws a one-screen operator view:
+
+- cluster totals + per-worker queue depth;
+- per-job progress and achieved-vs-target fair share;
+- unit-latency percentiles reconstructed from the
+  ``master_unit_latency_seconds`` histogram buckets;
+- the speculation and assembly ledgers;
+- SLO attainment/burn per job and the most recent alert edges.
+
+Stdlib-only (urllib + ANSI clears), like the rest of ``obs``: the
+dashboard must run on any operator box that can reach the master, with
+nothing installed. All rendering is pure (``render_dashboard``) so the
+tier-1 tests exercise it against canned endpoint payloads; ``--once``
+prints a single frame and exits (scripts, smoke tests).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Iterable
+
+from tpu_render_cluster.obs.prometheus import parse_prometheus
+
+__all__ = [
+    "fetch_endpoints",
+    "histogram_quantiles",
+    "render_dashboard",
+    "main",
+]
+
+Samples = dict[str, list[tuple[dict[str, str], float]]]
+
+_CLEAR = "\x1b[2J\x1b[H"
+
+
+def fetch_endpoints(
+    host: str, port: int, timeout: float = 5.0
+) -> tuple[Samples, dict[str, Any]]:
+    """One poll: parsed ``/metrics`` samples + the ``/clusterz`` JSON.
+
+    A worker endpoint (no cluster view, /clusterz is 404) yields an empty
+    dict for the second element rather than failing the poll.
+    """
+    base = f"http://{host}:{port}"
+    with urllib.request.urlopen(f"{base}/metrics", timeout=timeout) as resp:
+        metrics = parse_prometheus(resp.read().decode("utf-8"))
+    try:
+        with urllib.request.urlopen(f"{base}/clusterz", timeout=timeout) as resp:
+            clusterz = json.loads(resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        if e.code != 404:
+            raise
+        clusterz = {}
+    return metrics, clusterz
+
+
+def histogram_quantiles(
+    samples: Samples, name: str, quantiles: Iterable[float]
+) -> dict[float, float] | None:
+    """Quantile estimates from a histogram's ``_bucket`` expansion.
+
+    The classic cumulative-bucket walk with linear interpolation inside
+    the landing bucket (what promql's histogram_quantile does); the +Inf
+    bucket clamps to the previous finite bound. Buckets with differing
+    labels (multi-series histograms) are summed — the dashboard shows the
+    cluster-wide distribution. Returns None when the histogram is absent
+    or empty.
+    """
+    rows = samples.get(f"{name}_bucket")
+    if not rows:
+        return None
+    by_bound: dict[float, float] = {}
+    for labels, value in rows:
+        le = labels.get("le")
+        if le is None:
+            continue
+        bound = float("inf") if le == "+Inf" else float(le)
+        by_bound[bound] = by_bound.get(bound, 0.0) + value
+    bounds = sorted(by_bound)
+    if not bounds:
+        return None
+    total = by_bound[bounds[-1]]
+    if total <= 0:
+        return None
+    out: dict[float, float] = {}
+    for q in quantiles:
+        rank = q * total
+        previous_bound = 0.0
+        previous_count = 0.0
+        for bound in bounds:
+            count = by_bound[bound]
+            if count >= rank:
+                if bound == float("inf"):
+                    out[q] = previous_bound
+                elif count == previous_count:
+                    out[q] = bound
+                else:
+                    fraction = (rank - previous_count) / (count - previous_count)
+                    out[q] = previous_bound + fraction * (bound - previous_bound)
+                break
+            previous_bound, previous_count = bound, count
+        else:
+            out[q] = bounds[-2] if len(bounds) > 1 else bounds[-1]
+    return out
+
+
+def _sample_value(
+    samples: Samples, name: str, **labels: str
+) -> float | None:
+    for sample_labels, value in samples.get(name, ()):
+        if all(sample_labels.get(k) == v for k, v in labels.items()):
+            return value
+    return None
+
+
+def _fmt_seconds(value: float | None) -> str:
+    if value is None:
+        return "-"
+    if value < 1e-3:
+        return f"{value * 1e6:.0f}us"
+    if value < 1.0:
+        return f"{value * 1e3:.1f}ms"
+    return f"{value:.2f}s"
+
+
+def _fmt_share(value: Any) -> str:
+    return f"{value:.2f}" if isinstance(value, (int, float)) else "-"
+
+
+def render_dashboard(
+    samples: Samples, clusterz: dict[str, Any], *, now: float | None = None
+) -> str:
+    """One dashboard frame as plain text (pure: canned payloads in, text
+    out — the tests and --once path share it with the live loop)."""
+    lines: list[str] = []
+    cluster = clusterz.get("cluster") or {}
+    stamp = time.strftime(
+        "%H:%M:%S", time.localtime(now if now is not None else time.time())
+    )
+    lines.append(f"tpu-render-cluster telemetry  [{stamp}]")
+    lines.append("=" * 72)
+
+    frames_total = cluster.get("frames_total", 0)
+    frames_finished = cluster.get("frames_finished", 0)
+    frames_pending = cluster.get("frames_pending", 0)
+    lines.append(
+        f"units: {frames_finished}/{frames_total} finished, "
+        f"{frames_pending} pending"
+    )
+
+    workers = cluster.get("workers") or {}
+    if workers:
+        lines.append("")
+        lines.append(f"{'worker':<28} {'queue':>5} {'stolen':>6}  state")
+        for worker_id, info in sorted(workers.items()):
+            state = "DEAD" if info.get("is_dead") else "live"
+            lines.append(
+                f"{worker_id:<28} {info.get('queue_depth', 0):>5} "
+                f"{info.get('frames_stolen', 0):>6}  {state}"
+            )
+
+    jobs = clusterz.get("jobs") or {}
+    if jobs:
+        lines.append("")
+        lines.append(
+            f"{'job':<24} {'state':<9} {'done':>9} "
+            f"{'share':>6} {'target':>6}"
+        )
+        for name, info in sorted(jobs.items()):
+            done = f"{info.get('frames_finished', 0)}/{info.get('frames_total', 0)}"
+            lines.append(
+                f"{name:<24} {str(info.get('state', '-')):<9} {done:>9} "
+                f"{_fmt_share(info.get('share_achieved')):>6} "
+                f"{_fmt_share(info.get('share_target')):>6}"
+            )
+
+    quantiles = histogram_quantiles(
+        samples, "master_unit_latency_seconds", (0.5, 0.9, 0.99)
+    )
+    if quantiles:
+        lines.append("")
+        lines.append(
+            "unit latency  p50 "
+            f"{_fmt_seconds(quantiles.get(0.5))}   p90 "
+            f"{_fmt_seconds(quantiles.get(0.9))}   p99 "
+            f"{_fmt_seconds(quantiles.get(0.99))}"
+        )
+
+    speculation = clusterz.get("speculation") or {}
+    if speculation.get("launched"):
+        outcomes = speculation.get("outcomes") or {}
+        lines.append(
+            f"speculation   launched {speculation['launched']}  "
+            + "  ".join(f"{k} {v}" for k, v in sorted(outcomes.items()))
+        )
+
+    assembled = [
+        (name, info["assembly"])
+        for name, info in sorted(jobs.items())
+        if isinstance(info.get("assembly"), dict)
+    ]
+    for name, assembly in assembled:
+        lines.append(
+            f"assembly      {name}: {assembly.get('frames_assembled', 0)} "
+            f"stitched, {assembly.get('frames_partial', 0)} partial "
+            f"({assembly.get('tiles_per_frame', 1)} tiles/frame)"
+        )
+
+    slo = clusterz.get("slo") or {}
+    slo_jobs = slo.get("jobs") or {}
+    if slo_jobs:
+        lines.append("")
+        lines.append(
+            f"{'SLO job':<24} {'attain':>7} {'burn_s':>7} {'burn_l':>7}  firing"
+        )
+        for name, info in sorted(slo_jobs.items()):
+            attainment = info.get("attainment")
+            attain_str = f"{attainment:.3f}" if attainment is not None else "-"
+            burn = info.get("burn") or {}
+            firing = ",".join(info.get("firing") or ()) or "-"
+            lines.append(
+                f"{name:<24} {attain_str:>7} "
+                f"{burn.get('short', 0.0):>7.2f} "
+                f"{burn.get('long', 0.0):>7.2f}  {firing}"
+            )
+    alerts = slo.get("alerts") or []
+    for alert in alerts[-5:]:
+        at = time.strftime("%H:%M:%S", time.localtime(alert.get("at", 0)))
+        lines.append(
+            f"alert  [{at}] {alert.get('job_name')} {alert.get('kind')} "
+            f"{str(alert.get('transition', '')).upper()}"
+        )
+
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Live terminal dashboard over the telemetry endpoints"
+    )
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument(
+        "--port", type=int, required=True,
+        help="The master's --telemetryPort (or TRC_OBS_PORT)",
+    )
+    parser.add_argument("--interval", type=float, default=1.0)
+    parser.add_argument(
+        "--once", action="store_true",
+        help="Print one frame and exit (scripts, smoke tests)",
+    )
+    args = parser.parse_args(argv)
+    while True:
+        try:
+            samples, clusterz = fetch_endpoints(args.host, args.port)
+        except (OSError, urllib.error.URLError, ValueError) as e:
+            frame = f"telemetry endpoint unreachable: {e}\n"
+        else:
+            frame = render_dashboard(samples, clusterz)
+        if args.once:
+            sys.stdout.write(frame)
+            return 0
+        sys.stdout.write(_CLEAR + frame)
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,202 @@
+"""Pull-based telemetry endpoints: a minimal asyncio HTTP/1.1 server.
+
+The live path of the telemetry plane. One ``TelemetryServer`` per
+process-with-a-registry:
+
+- ``GET /metrics``  — the registry in Prometheus text exposition format
+  (obs/prometheus.py; Content-Type ``text/plain; version=0.0.4``);
+- ``GET /healthz``  — JSON liveness (``healthz_fn``, or a bare
+  ``{"ok": true}``);
+- ``GET /clusterz`` — JSON live cluster view (``clusterz_fn``, the
+  master's ``cluster_view()``; 404 on processes that have none, e.g. a
+  worker daemon).
+
+Replaces file-polling of ``metrics-live.json`` as the LIVE inspection
+path (the snapshot writer stays for post-hoc artifacts): an operator —
+or the terminal dashboard (obs/dashboard.py), or an actual Prometheus —
+scrapes the master and workers over plain HTTP while jobs run.
+
+Deliberately stdlib-only and GET-only, in the spirit of the JSON-lines
+control plane (sched/control.py): no framework, no TLS, no mutation. The
+``clusterz_fn``/``healthz_fn`` callables run on the event loop and must
+stay cheap (``cluster_view()`` is a dict build over live state); the
+registry snapshot + render go to a thread so a large registry never
+stalls heartbeat service.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Any, Callable
+
+from tpu_render_cluster.obs.prometheus import CONTENT_TYPE, render_prometheus
+from tpu_render_cluster.obs.registry import MetricsRegistry
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["TelemetryServer", "resolve_telemetry_port"]
+
+
+def resolve_telemetry_port(
+    flag_value: int | None, env_name: str
+) -> int | None:
+    """One definition of the CLI/env port contract: an explicit flag wins;
+    otherwise the env variable enables the endpoints when set to >= 0
+    (0 = ephemeral); absent/negative = disabled (None)."""
+    if flag_value is not None:
+        return flag_value
+    from tpu_render_cluster.utils.env import env_int
+
+    port = env_int(env_name, -1)
+    return port if port >= 0 else None
+
+_MAX_REQUEST_BYTES = 64 * 1024
+_JSON_CONTENT_TYPE = "application/json; charset=utf-8"
+
+
+class TelemetryServer:
+    """Serve one registry (and optional live views) over HTTP."""
+
+    def __init__(
+        self,
+        registry: MetricsRegistry,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        clusterz_fn: Callable[[], dict[str, Any]] | None = None,
+        healthz_fn: Callable[[], dict[str, Any]] | None = None,
+    ) -> None:
+        self.registry = registry
+        self.host = host
+        self.port = port
+        self.clusterz_fn = clusterz_fn
+        self.healthz_fn = healthz_fn
+        self.started_at = time.time()
+        self._server: asyncio.Server | None = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_connection, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("Telemetry endpoints on http://%s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 5.0)
+            except asyncio.TimeoutError:
+                logger.warning("Telemetry server close timed out.")
+            self._server = None
+
+    # -- request handling ---------------------------------------------------
+
+    async def _on_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        peer = writer.get_extra_info("peername")
+        try:
+            request_line = await asyncio.wait_for(reader.readline(), 10.0)
+            if not request_line:
+                return
+            # Drain headers (bounded); GET carries no body we care about.
+            consumed = len(request_line)
+            while True:
+                line = await asyncio.wait_for(reader.readline(), 10.0)
+                consumed += len(line)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                if consumed > _MAX_REQUEST_BYTES:
+                    writer.close()
+                    return
+            try:
+                method, target, _version = (
+                    request_line.decode("latin-1").strip().split(None, 2)
+                )
+            except ValueError:
+                await self._respond(
+                    writer, 400, _JSON_CONTENT_TYPE,
+                    json.dumps({"ok": False, "error": "malformed request"}),
+                )
+                return
+            if method not in ("GET", "HEAD"):
+                await self._respond(
+                    writer, 405, _JSON_CONTENT_TYPE,
+                    json.dumps({"ok": False, "error": "GET only"}),
+                    head_only=method == "HEAD",
+                )
+                return
+            path = target.partition("?")[0]
+            try:
+                status, content_type, body = await self._route(path)
+            except Exception as e:  # noqa: BLE001 - one bad scrape must not kill the plane
+                # Answer with a self-diagnosing 500 instead of slamming the
+                # socket: a lint-refused metric or a clusterz_fn raising
+                # mid-shutdown should tell the operator WHAT broke, not
+                # show up as an opaque connection reset in the scraper.
+                logger.warning("Telemetry handler for %s failed: %s", path, e)
+                status, content_type, body = (
+                    500,
+                    _JSON_CONTENT_TYPE,
+                    json.dumps({"ok": False, "error": str(e)}),
+                )
+            await self._respond(
+                writer, status, content_type, body, head_only=method == "HEAD"
+            )
+        except (ConnectionError, asyncio.TimeoutError, asyncio.IncompleteReadError):
+            pass  # scraper went away; nothing to answer
+        except Exception as e:  # noqa: BLE001 - one bad scrape must not kill the plane
+            logger.warning("Telemetry request from %s failed: %s", peer, e)
+        finally:
+            writer.close()
+
+    async def _route(self, path: str) -> tuple[int, str, str]:
+        if path == "/metrics":
+            # Snapshot + render in a thread: the registry lock is cheap but
+            # serialization of a big registry is not.
+            body = await asyncio.to_thread(
+                lambda: render_prometheus(self.registry.snapshot())
+            )
+            return 200, CONTENT_TYPE, body
+        if path == "/healthz":
+            payload = {"ok": True, "uptime_seconds": time.time() - self.started_at}
+            if self.healthz_fn is not None:
+                payload.update(self.healthz_fn())
+            return 200, _JSON_CONTENT_TYPE, json.dumps(payload, default=str)
+        if path == "/clusterz":
+            if self.clusterz_fn is None:
+                return 404, _JSON_CONTENT_TYPE, json.dumps(
+                    {"ok": False, "error": "no cluster view on this process"}
+                )
+            view = self.clusterz_fn()
+            return 200, _JSON_CONTENT_TYPE, json.dumps(view, default=str)
+        return 404, _JSON_CONTENT_TYPE, json.dumps(
+            {"ok": False, "error": f"unknown path {path!r}",
+             "paths": ["/metrics", "/healthz", "/clusterz"]}
+        )
+
+    @staticmethod
+    async def _respond(
+        writer: asyncio.StreamWriter,
+        status: int,
+        content_type: str,
+        body: str,
+        *,
+        head_only: bool = False,
+    ) -> None:
+        reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                  405: "Method Not Allowed"}.get(status, "Error")
+        payload = body.encode("utf-8")
+        head = (
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: {content_type}\r\n"
+            f"Content-Length: {len(payload)}\r\n"
+            f"Connection: close\r\n"
+            f"\r\n"
+        ).encode("latin-1")
+        writer.write(head if head_only else head + payload)
+        await writer.drain()

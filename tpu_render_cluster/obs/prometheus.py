@@ -1,0 +1,290 @@
+"""Prometheus text-exposition rendering of a ``MetricsRegistry`` snapshot.
+
+The live pull path of the telemetry plane (obs/http.py serves this at
+``/metrics``): the registry's counters/gauges/histograms rendered in the
+text exposition format (version 0.0.4) any Prometheus-compatible scraper
+ingests. Stdlib-only, like the rest of ``obs``.
+
+Naming is enforced, not hoped for: ``lint_snapshot`` checks every metric
+name and label against the conventions below, and ``render_prometheus``
+refuses to emit a series that fails them — the exporter can never produce
+an invalid exposition line, and the tier-1 lint test keeps the whole
+registry population conforming.
+
+Conventions (prometheus.io/docs/practices/naming, narrowed):
+
+- metric names match ``[a-z][a-z0-9_]*`` (we never emit the colon forms);
+- label names match ``[a-z][a-z0-9_]*`` and never start with ``__``;
+- counters end in ``_total``;
+- gauges and histograms end in a unit (or documented dimensionless)
+  suffix from ``UNIT_SUFFIXES`` — and never in ``_total``/``_count``/
+  ``_sum``/``_bucket``, which belong to counters and histogram expansions.
+
+``parse_prometheus`` is the minimal inverse used by the terminal
+dashboard (obs/dashboard.py) and the round-trip tests.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Iterator
+
+__all__ = [
+    "CONTENT_TYPE",
+    "UNIT_SUFFIXES",
+    "lint_metric",
+    "lint_snapshot",
+    "render_prometheus",
+    "parse_prometheus",
+]
+
+# The content type every text-exposition scraper expects.
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_LABEL_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+# Unit (and documented dimensionless) suffixes a gauge or histogram may
+# end in. Dimensionless entries: *_fraction / *_ratio / *_share /
+# *_occupancy are 0..1 proportions; *_depth / *_units are discrete counts
+# sampled as gauges (a count that can go DOWN is a gauge, and `_total`
+# on a gauge would read as a counter to every scraper).
+UNIT_SUFFIXES = (
+    "_seconds",
+    "_bytes",
+    "_ppm",
+    "_flops",
+    "_per_second",
+    "_fraction",
+    "_ratio",
+    "_share",
+    "_occupancy",
+    "_depth",
+    "_units",
+)
+
+# Suffixes the exposition format reserves for expansions of other types.
+_RESERVED_SUFFIXES = ("_count", "_sum", "_bucket")
+
+
+def lint_metric(
+    name: str, kind: str, label_names: tuple[str, ...] | list[str]
+) -> list[str]:
+    """Convention violations for one metric declaration (empty = clean)."""
+    problems: list[str] = []
+    if not _NAME_RE.match(name):
+        problems.append(f"{name!r}: name must match [a-z][a-z0-9_]*")
+    for label in label_names:
+        if not _LABEL_RE.match(str(label)):
+            problems.append(
+                f"{name!r}: label {label!r} must match [a-z][a-z0-9_]*"
+            )
+    if kind == "counter":
+        if not name.endswith("_total"):
+            problems.append(f"{name!r}: counter names must end in _total")
+    elif kind in ("gauge", "histogram"):
+        if name.endswith("_total"):
+            problems.append(
+                f"{name!r}: _total is reserved for counters ({kind})"
+            )
+        for reserved in _RESERVED_SUFFIXES:
+            if name.endswith(reserved):
+                problems.append(
+                    f"{name!r}: {reserved} is reserved for histogram "
+                    f"expansions ({kind})"
+                )
+        if not name.endswith(UNIT_SUFFIXES):
+            problems.append(
+                f"{name!r}: {kind} names must end in a unit suffix "
+                f"({', '.join(UNIT_SUFFIXES)})"
+            )
+    else:
+        problems.append(f"{name!r}: unknown metric kind {kind!r}")
+    return problems
+
+
+def lint_snapshot(snapshot: dict[str, Any]) -> list[str]:
+    """Lint every metric in a ``MetricsRegistry.snapshot()`` document."""
+    problems: list[str] = []
+    for name, entry in snapshot.items():
+        problems.extend(
+            lint_metric(
+                str(name),
+                str(entry.get("type", "")),
+                tuple(entry.get("labels") or ()),
+            )
+        )
+    return problems
+
+
+def _escape_label_value(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(value: str) -> str:
+    return value.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _parse_label_string(
+    label_str: str, label_names: tuple[str, ...] | list[str] = ()
+) -> list[tuple[str, str]]:
+    """Split a snapshot series key (``name=value,...``) into pairs.
+
+    Registry label VALUES may themselves contain ``,`` or ``=`` (job
+    names, file paths), making the flat key ambiguous on its own — but
+    the snapshot entry DECLARES its label names, so the split anchors on
+    the known ``<name>=`` prefixes in declared order: each value runs to
+    the next ``,<next-name>=`` occurrence (or the end). Without declared
+    names (legacy callers) it falls back to the name-grammar heuristic.
+    """
+    if not label_str:
+        return []
+    names = [str(n) for n in label_names]
+    if names and label_str.startswith(f"{names[0]}="):
+        pairs: list[tuple[str, str]] = []
+        rest = label_str
+        for i, name in enumerate(names):
+            prefix = f"{name}="
+            if not rest.startswith(prefix):
+                break  # key disagrees with the declaration; fall back
+            rest = rest[len(prefix):]
+            if i + 1 < len(names):
+                separator = f",{names[i + 1]}="
+                cut = rest.find(separator)
+                if cut < 0:
+                    break
+                value, rest = rest[:cut], rest[cut + 1:]
+            else:
+                value = rest
+            pairs.append((name, value))
+        if len(pairs) == len(names):
+            return pairs
+    pairs = []
+    for chunk in re.split(r",(?=[a-zA-Z_][a-zA-Z0-9_]*=)", label_str):
+        name, _, value = chunk.partition("=")
+        pairs.append((name, value))
+    return pairs
+
+
+def _format_value(value: float) -> str:
+    value = float(value)
+    if math.isinf(value):
+        return "+Inf" if value > 0 else "-Inf"
+    if math.isnan(value):
+        return "NaN"
+    if value == int(value) and abs(value) < 1e15:
+        return str(int(value))
+    return repr(value)
+
+
+def _render_labels(pairs: list[tuple[str, str]]) -> str:
+    if not pairs:
+        return ""
+    inner = ",".join(
+        f'{name}="{_escape_label_value(str(value))}"' for name, value in pairs
+    )
+    return "{" + inner + "}"
+
+
+def _render_metric_lines(
+    name: str, entry: dict[str, Any]
+) -> Iterator[str]:
+    kind = str(entry.get("type"))
+    help_text = str(entry.get("help") or "")
+    label_names = tuple(entry.get("labels") or ())
+    if help_text:
+        yield f"# HELP {name} {_escape_help(help_text)}"
+    yield f"# TYPE {name} {kind}"
+    series = entry.get("series") or {}
+    if kind in ("counter", "gauge"):
+        for label_str, value in series.items():
+            pairs = _parse_label_string(str(label_str), label_names)
+            yield f"{name}{_render_labels(pairs)} {_format_value(value)}"
+        return
+    # Histogram: cumulative buckets + the +Inf overflow, then sum/count.
+    bounds = [float(b) for b in entry.get("bucket_bounds") or []]
+    for label_str, data in series.items():
+        pairs = _parse_label_string(str(label_str), label_names)
+        counts = list(data.get("bucket_counts") or [])
+        cumulative = 0
+        for bound, count in zip(bounds, counts):
+            cumulative += int(count)
+            bucket_pairs = pairs + [("le", _format_value(bound))]
+            yield f"{name}_bucket{_render_labels(bucket_pairs)} {cumulative}"
+        overflow = int(counts[len(bounds)]) if len(counts) > len(bounds) else 0
+        cumulative += overflow
+        inf_pairs = pairs + [("le", "+Inf")]
+        yield f"{name}_bucket{_render_labels(inf_pairs)} {cumulative}"
+        yield f"{name}_sum{_render_labels(pairs)} {_format_value(data.get('sum', 0.0))}"
+        yield f"{name}_count{_render_labels(pairs)} {int(data.get('count', 0))}"
+
+
+def render_prometheus(snapshot: dict[str, Any]) -> str:
+    """Render a registry snapshot as one text-exposition document.
+
+    Raises ``ValueError`` on the first convention violation: a metric
+    that fails the lint never reaches a scraper half-formed.
+    """
+    lines: list[str] = []
+    for name in sorted(snapshot):
+        entry = snapshot[name]
+        problems = lint_metric(
+            str(name),
+            str(entry.get("type", "")),
+            tuple(entry.get("labels") or ()),
+        )
+        if problems:
+            raise ValueError(
+                "Refusing to export non-conforming metric: "
+                + "; ".join(problems)
+            )
+        lines.extend(_render_metric_lines(str(name), entry))
+    return "\n".join(lines) + "\n" if lines else ""
+
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?\s+(?P<value>\S+)\s*$"
+)
+_LABEL_PAIR_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:\\.|[^"\\])*)"')
+
+
+def _unescape_label_value(value: str) -> str:
+    return (
+        value.replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+    )
+
+
+def parse_prometheus(
+    text: str,
+) -> dict[str, list[tuple[dict[str, str], float]]]:
+    """Parse an exposition document into ``name -> [(labels, value)]``.
+
+    Minimal (no TYPE/HELP retention, exemplars, or native histograms) —
+    enough for the terminal dashboard and the round-trip tests. Histogram
+    expansions appear under their ``_bucket``/``_sum``/``_count`` names.
+    """
+    out: dict[str, list[tuple[dict[str, str], float]]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        match = _SAMPLE_RE.match(line)
+        if match is None:
+            raise ValueError(f"Malformed exposition line: {line!r}")
+        labels = {
+            name: _unescape_label_value(value)
+            for name, value in _LABEL_PAIR_RE.findall(match.group("labels") or "")
+        }
+        raw = match.group("value")
+        if raw == "+Inf":
+            value = math.inf
+        elif raw == "-Inf":
+            value = -math.inf
+        elif raw == "NaN":
+            value = math.nan
+        else:
+            value = float(raw)
+        out.setdefault(match.group("name"), []).append((labels, value))
+    return out

@@ -1,0 +1,488 @@
+"""Per-job SLO engine: attainment + multi-window burn rate, online.
+
+Jobs declare objectives in their TOML (``[slo]`` table ->
+``jobs.models.JobSlo``); the master tracks them live off the same
+per-unit winning-result latency stream that feeds
+``master_unit_latency_seconds`` (worker_handle._record_winning_result):
+
+- **attainment**: the fraction of units meeting the latency objective,
+  cumulative over the job;
+- **burn ratio**: over each sliding window, the violation fraction
+  divided by the error budget (a p99 objective leaves a 1% budget) — a
+  burn of 1.0 means the budget is being consumed exactly as fast as it
+  accrues; sustained burn > threshold means the objective will be missed.
+  Two windows (short + long, the classic multi-window rule): a transient
+  blip clears on its own once it slides out of the short window, while a
+  sustained regression keeps both windows burning. With a 1% budget any
+  violation in a sparse window reads as a large burn, so
+  ``TRC_SLO_MIN_WINDOW_SAMPLES`` can demand a minimum observation count
+  per window before its burn is considered meaningful (default 1: every
+  violation is eligible to fire — small jobs have few samples total);
+- **deadline**: elapsed wall time since job start vs
+  ``slo.deadline_seconds``, fired once when exceeded.
+
+Alert lifecycle is a per-(job, kind) state machine with exactly-once
+edges: one ``fire`` when the breach condition becomes true, one ``clear``
+when it recovers (latency only — a missed deadline stays missed). Every
+transition lands in three places: the ``slo_alerts_total`` counter, a
+Perfetto instant on the master's "alerts" track, and the bounded
+structured alert log the control plane serves (``{"op": "alerts"}``) and
+``cluster_view()['slo']`` mirrors into ``/clusterz`` + metrics-live.json.
+
+Tuning (read at call time): ``TRC_SLO_SHORT_WINDOW_SECONDS`` /
+``TRC_SLO_LONG_WINDOW_SECONDS`` / ``TRC_SLO_BURN_THRESHOLD`` /
+``TRC_SLO_MIN_WINDOW_SAMPLES`` / ``TRC_SLO_TICK_SECONDS``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Any
+
+from tpu_render_cluster.utils.env import env_float
+
+if TYPE_CHECKING:
+    from tpu_render_cluster.jobs.models import BlenderJob, JobSlo
+    from tpu_render_cluster.obs.registry import MetricsRegistry
+    from tpu_render_cluster.obs.tracer import Tracer
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["SloService", "SloTracker", "SloAlert", "slo_loop"]
+
+# A p99 latency objective: 1% of units may miss it before the SLO does.
+LATENCY_TARGET = 0.99
+ERROR_BUDGET = 1.0 - LATENCY_TARGET
+
+KIND_UNIT_LATENCY = "unit_latency_p99"
+KIND_DEADLINE = "deadline"
+
+TRANSITION_FIRE = "fire"
+TRANSITION_CLEAR = "clear"
+
+
+def short_window_seconds() -> float:
+    return env_float("TRC_SLO_SHORT_WINDOW_SECONDS", 60.0)
+
+
+def long_window_seconds() -> float:
+    return env_float("TRC_SLO_LONG_WINDOW_SECONDS", 300.0)
+
+
+def burn_threshold() -> float:
+    return env_float("TRC_SLO_BURN_THRESHOLD", 1.0)
+
+
+def tick_seconds() -> float:
+    return env_float("TRC_SLO_TICK_SECONDS", 0.5)
+
+
+def min_window_samples() -> int:
+    return int(env_float("TRC_SLO_MIN_WINDOW_SAMPLES", 1))
+
+
+class _WindowCounter:
+    """Rolling violation counts over one sliding window.
+
+    Each observation is appended once and pruned once, so burn queries
+    are amortized O(1) regardless of the unit rate — the tracker is
+    evaluated inline on the master event loop for EVERY winning result,
+    and a tiled job can push thousands of units through a window.
+    """
+
+    __slots__ = ("window", "_q", "total", "violated")
+
+    def __init__(self, window: float) -> None:
+        self.window = window
+        self._q: deque[tuple[float, bool]] = deque()
+        self.total = 0
+        self.violated = 0
+
+    def add(self, now: float, violated: bool) -> None:
+        self._q.append((now, violated))
+        self.total += 1
+        self.violated += violated
+
+    def prune(self, now: float) -> None:
+        horizon = now - self.window
+        while self._q and self._q[0][0] < horizon:
+            _at, violated = self._q.popleft()
+            self.total -= 1
+            self.violated -= violated
+
+    def burn(self, now: float, min_samples: int = 1) -> float:
+        """Violation fraction over the window / the error budget.
+
+        A window with fewer than ``min_samples`` observations reports
+        0.0: with a 1% budget ANY violation in a sparse window would
+        read as a huge burn, so operators can demand a minimum sample
+        count before the burn is considered meaningful
+        (``TRC_SLO_MIN_WINDOW_SAMPLES``).
+        """
+        self.prune(now)
+        if self.total == 0 or self.total < min_samples:
+            return 0.0
+        return (self.violated / self.total) / ERROR_BUDGET
+
+
+@dataclass(frozen=True)
+class SloAlert:
+    """One alert edge, as served on the control plane (``to_dict``)."""
+
+    at: float
+    job_name: str
+    kind: str  # KIND_UNIT_LATENCY | KIND_DEADLINE
+    transition: str  # TRANSITION_FIRE | TRANSITION_CLEAR
+    detail: dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "at": self.at,
+            "job_name": self.job_name,
+            "kind": self.kind,
+            "transition": self.transition,
+            **self.detail,
+        }
+
+
+class SloTracker:
+    """One job's objectives, observations, and alert state machines."""
+
+    def __init__(
+        self,
+        job_name: str,
+        slo: "JobSlo",
+        *,
+        started_at: float,
+        short_window: float | None = None,
+        long_window: float | None = None,
+        threshold: float | None = None,
+        min_samples: int | None = None,
+    ) -> None:
+        self.job_name = job_name
+        self.slo = slo
+        self.started_at = started_at
+        self.short_window = (
+            short_window if short_window is not None else short_window_seconds()
+        )
+        self.long_window = max(
+            self.short_window,
+            long_window if long_window is not None else long_window_seconds(),
+        )
+        self.threshold = threshold if threshold is not None else burn_threshold()
+        self.min_samples = (
+            min_samples if min_samples is not None else min_window_samples()
+        )
+        self.finished_at: float | None = None
+        # Rolling per-window violation counts (amortized O(1) per query).
+        self._short = _WindowCounter(self.short_window)
+        self._long = _WindowCounter(self.long_window)
+        self.units_observed = 0
+        self.units_violating = 0
+        # kind -> currently firing; fires/clears are exactly-once edges.
+        self.firing: dict[str, bool] = {}
+        self.fires: dict[str, int] = {}
+        self.clears: dict[str, int] = {}
+
+    # -- observations --------------------------------------------------------
+
+    def observe(self, latency_seconds: float, now: float) -> None:
+        objective = self.slo.unit_latency_p99_seconds
+        if objective is None:
+            return
+        violated = latency_seconds > objective
+        self.units_observed += 1
+        if violated:
+            self.units_violating += 1
+        self._short.add(now, violated)
+        self._long.add(now, violated)
+
+    def _burn(self, now: float, window: float) -> float:
+        """Burn over one of the two tracked windows (rolling counters)."""
+        if window == self.short_window:
+            return self._short.burn(now, self.min_samples)
+        if window == self.long_window:
+            return self._long.burn(now, self.min_samples)
+        raise ValueError(
+            f"Untracked window {window}; tracked: "
+            f"{self.short_window}/{self.long_window}"
+        )
+
+    def attainment(self) -> float | None:
+        if self.units_observed == 0:
+            return None
+        return 1.0 - self.units_violating / self.units_observed
+
+    # -- evaluation ----------------------------------------------------------
+
+    def evaluate(self, now: float) -> list[SloAlert]:
+        """Advance the alert state machines; returns the edges crossed.
+
+        Exactly-once semantics: while a breach persists, evaluate() can
+        run every tick (and after every observation) without re-firing;
+        the next fire requires an intervening clear.
+        """
+        alerts: list[SloAlert] = []
+        if self.slo.unit_latency_p99_seconds is not None:
+            burn_short = self._burn(now, self.short_window)
+            burn_long = self._burn(now, self.long_window)
+            breaching = (
+                burn_short >= self.threshold and burn_long >= self.threshold
+            )
+            detail = {
+                "objective_seconds": self.slo.unit_latency_p99_seconds,
+                "burn_short": round(burn_short, 4),
+                "burn_long": round(burn_long, 4),
+                "attainment": self.attainment(),
+            }
+            alerts.extend(
+                self._transition(KIND_UNIT_LATENCY, breaching, now, detail)
+            )
+        if self.slo.deadline_seconds is not None:
+            end = self.finished_at if self.finished_at is not None else now
+            missed = (end - self.started_at) > self.slo.deadline_seconds
+            # A missed deadline never recovers: only the fire edge exists.
+            if missed and not self.firing.get(KIND_DEADLINE, False):
+                alerts.extend(
+                    self._transition(
+                        KIND_DEADLINE,
+                        True,
+                        now,
+                        {
+                            "deadline_seconds": self.slo.deadline_seconds,
+                            "elapsed_seconds": round(end - self.started_at, 3),
+                        },
+                    )
+                )
+        return alerts
+
+    def _transition(
+        self, kind: str, breaching: bool, now: float, detail: dict[str, Any]
+    ) -> list[SloAlert]:
+        was_firing = self.firing.get(kind, False)
+        if breaching == was_firing:
+            return []
+        self.firing[kind] = breaching
+        transition = TRANSITION_FIRE if breaching else TRANSITION_CLEAR
+        ledger = self.fires if breaching else self.clears
+        ledger[kind] = ledger.get(kind, 0) + 1
+        return [
+            SloAlert(
+                at=now,
+                job_name=self.job_name,
+                kind=kind,
+                transition=transition,
+                detail=detail,
+            )
+        ]
+
+    def finish(self, now: float) -> None:
+        self.finished_at = now
+
+    # -- views ---------------------------------------------------------------
+
+    def view(self, now: float | None = None) -> dict[str, Any]:
+        now = now if now is not None else time.time()
+        out: dict[str, Any] = {
+            "objectives": self.slo.to_dict(),
+            "units_observed": self.units_observed,
+            "units_violating": self.units_violating,
+            "attainment": self.attainment(),
+            "firing": sorted(k for k, v in self.firing.items() if v),
+            "fires": dict(self.fires),
+            "clears": dict(self.clears),
+            "finished": self.finished_at is not None,
+        }
+        if self.slo.unit_latency_p99_seconds is not None:
+            out["burn"] = {
+                "short_window_seconds": self.short_window,
+                "long_window_seconds": self.long_window,
+                "threshold": self.threshold,
+                "min_samples": self.min_samples,
+                "short": self._burn(now, self.short_window),
+                "long": self._burn(now, self.long_window),
+            }
+        if self.slo.deadline_seconds is not None:
+            end = self.finished_at if self.finished_at is not None else now
+            out["deadline"] = {
+                "deadline_seconds": self.slo.deadline_seconds,
+                "elapsed_seconds": end - self.started_at,
+            }
+        return out
+
+
+class SloService:
+    """All tracked jobs' SLOs + the shared alert log and metrics export."""
+
+    MAX_ALERTS = 256
+
+    def __init__(
+        self,
+        *,
+        metrics: "MetricsRegistry | None" = None,
+        span_tracer: "Tracer | None" = None,
+    ) -> None:
+        self.metrics = metrics
+        self.span_tracer = span_tracer
+        self.trackers: dict[str, SloTracker] = {}
+        self.alerts: deque[SloAlert] = deque(maxlen=self.MAX_ALERTS)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def register_job(
+        self, job: "BlenderJob", started_at: float | None = None
+    ) -> SloTracker | None:
+        """Track a job's objectives from ``started_at`` on (no-op without
+        an ``[slo]`` table). Re-registering a name replaces the tracker —
+        the scheduler releases names at finish, so a resubmit is a new
+        job."""
+        if job.slo is None:
+            return None
+        tracker = SloTracker(
+            job.job_name,
+            job.slo,
+            started_at=started_at if started_at is not None else time.time(),
+        )
+        self.trackers[job.job_name] = tracker
+        if self.metrics is not None:
+            for kind, objective in (
+                (KIND_UNIT_LATENCY, job.slo.unit_latency_p99_seconds),
+                (KIND_DEADLINE, job.slo.deadline_seconds),
+            ):
+                if objective is not None:
+                    self.metrics.gauge(
+                        "slo_objective_seconds",
+                        "Declared per-job SLO objective",
+                        labels=("job", "objective"),
+                    ).set(objective, job=job.job_name, objective=kind)
+        return tracker
+
+    def observe_unit_latency(self, state, unit, latency_seconds: float) -> None:
+        """The worker-handle hook: one winning result's dispatch-to-result
+        latency (the ``master_unit_latency_seconds`` stream). Evaluates
+        immediately so a breach alerts on the unit that crossed the line,
+        not the next tick."""
+        tracker = self.trackers.get(state.job.job_name)
+        if tracker is None or tracker.finished_at is not None:
+            return
+        now = time.time()
+        tracker.observe(latency_seconds, now)
+        self._apply(tracker, now)
+
+    def finish_job(self, job_name: str) -> None:
+        """Final evaluation at job end (finish or cancel): the deadline is
+        judged against the actual end time, and a still-firing latency
+        alert stays on record (the view keeps it) without further ticks."""
+        tracker = self.trackers.get(job_name)
+        if tracker is None or tracker.finished_at is not None:
+            return
+        now = time.time()
+        tracker.finish(now)
+        self._apply(tracker, now)
+
+    def tick(self, now: float | None = None) -> None:
+        """Periodic evaluation: burns decay as windows slide (clearing
+        recovered alerts) and deadlines fire even when the observation
+        stream has stalled — exactly the case a latency-only hook misses."""
+        now = now if now is not None else time.time()
+        for tracker in self.trackers.values():
+            if tracker.finished_at is None:
+                self._apply(tracker, now)
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _apply(self, tracker: SloTracker, now: float) -> None:
+        for alert in tracker.evaluate(now):
+            self.alerts.append(alert)
+            self._emit(alert)
+        if self.metrics is not None:
+            attainment = tracker.attainment()
+            if attainment is not None:
+                self.metrics.gauge(
+                    "slo_attainment_ratio",
+                    "Fraction of units meeting the latency objective "
+                    "(cumulative per job)",
+                    labels=("job",),
+                ).set(attainment, job=tracker.job_name)
+            if tracker.slo.unit_latency_p99_seconds is not None:
+                burn_gauge = self.metrics.gauge(
+                    "slo_burn_ratio",
+                    "Error-budget burn per window (1.0 = budget consumed "
+                    "exactly as fast as it accrues)",
+                    labels=("job", "window"),
+                )
+                burn_gauge.set(
+                    tracker._burn(now, tracker.short_window),
+                    job=tracker.job_name,
+                    window="short",
+                )
+                burn_gauge.set(
+                    tracker._burn(now, tracker.long_window),
+                    job=tracker.job_name,
+                    window="long",
+                )
+
+    def _emit(self, alert: SloAlert) -> None:
+        log = logger.warning if alert.transition == TRANSITION_FIRE else logger.info
+        log(
+            "SLO %s %s for job %r: %s",
+            alert.kind,
+            alert.transition,
+            alert.job_name,
+            alert.detail,
+        )
+        if self.metrics is not None:
+            self.metrics.counter(
+                "slo_alerts_total",
+                "SLO alert state transitions (exactly one fire per breach "
+                "episode, one clear per recovery)",
+                labels=("job", "kind", "transition"),
+            ).inc(
+                job=alert.job_name,
+                kind=alert.kind,
+                transition=alert.transition,
+            )
+        if self.span_tracer is not None:
+            self.span_tracer.instant(
+                f"slo {alert.kind} {alert.transition}",
+                cat="slo",
+                track="alerts",
+                args=alert.to_dict(),
+            )
+
+    # -- views ---------------------------------------------------------------
+
+    def tracked(self) -> bool:
+        return bool(self.trackers)
+
+    def view(self) -> dict[str, Any]:
+        """The ``slo`` section of ``cluster_view()`` (-> /clusterz,
+        metrics-live.json, and the statistics.json fold)."""
+        if not self.trackers:
+            return {}
+        now = time.time()
+        return {
+            "jobs": {
+                name: tracker.view(now)
+                for name, tracker in self.trackers.items()
+            },
+            "alerts": self.alerts_view(),
+        }
+
+    def alerts_view(self) -> list[dict[str, Any]]:
+        return [alert.to_dict() for alert in self.alerts]
+
+
+async def slo_loop(service: SloService, state, cancellation) -> None:
+    """Single-job sidecar (the scheduler loop ticks inline instead):
+    evaluate periodically until the job's frames are done or the run is
+    cancelled, so deadline breaches and window-slide recoveries surface
+    even while no results are arriving."""
+    interval = tick_seconds()
+    while not cancellation.is_cancelled() and not state.all_frames_finished():
+        service.tick()
+        await asyncio.sleep(interval)

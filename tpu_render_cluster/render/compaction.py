@@ -328,6 +328,26 @@ def trace_paths_wavefront(
         occupancy.set(live / bucket)
         launched.observe(live / bucket)
         _count_compile(kind, "bounce", bucket, max_bounces)
+        # Roofline profiling: the bucket program's identity is (kind,
+        # bucket, bounces) — the same identity the bucketed-jit cache
+        # compiles per. The capture args are stashed BEFORE the step
+        # reassigns them, but the lowering itself runs after the bounce's
+        # duration stamp so it never inflates a measured bounce.
+        from tpu_render_cluster.obs.profiling import get_profiler, kernel_key
+
+        profiler = get_profiler()
+        step_key = kernel_key(
+            f"wavefront_{kind}_bounce", None, bucket=bucket, b=max_bounces
+        )
+        capture_args = None
+        if not profiler.captured(step_key):
+            capture_args = (
+                (scene, mesh, origins, directions, throughput, alive, lane,
+                 rng, live_dev, seed, bounce, radiance_total)
+                if mesh is not None
+                else (scene, origins, directions, throughput, alive, lane,
+                      rng, live_dev, seed, bounce, radiance_total)
+            )
         if mesh is not None:
             origins, directions, throughput, alive, radiance_total = (
                 _mesh_step(
@@ -344,9 +364,20 @@ def trace_paths_wavefront(
                     total_bounces=max_bounces,
                 )
             )
+        bounce_seconds = time.perf_counter() - start_mono
+        # Measured-time pairing for the roofline view: the host-driven
+        # loop syncs once per bounce, so the bounce wall time (compact +
+        # live-count sync + step dispatch) is the tier's honest per-launch
+        # cost — there is no tighter device fence to pair with.
+        profiler.record_execute(step_key, bounce_seconds)
+        if capture_args is not None:
+            step = _mesh_step if mesh is not None else _sphere_step
+            profiler.capture(
+                step_key, step, *capture_args, total_bounces=max_bounces
+            )
         tracer.complete(
             "wavefront_bounce", cat="render", start_wall=start_wall,
-            duration=time.perf_counter() - start_mono,
+            duration=bounce_seconds,
             track="wavefront",
             args={"bounce": bounce, "live": live, "bucket": bucket,
                   "alive_fraction": round(live / n0, 4)},

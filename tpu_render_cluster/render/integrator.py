@@ -706,7 +706,19 @@ def fused_frame_renderer(
         )
         return tonemap(linear)
 
-    return render
+    # Roofline profiling (obs/profiling.py): the first call captures the
+    # program's XLA cost analysis (FLOPs/bytes) under the masked tier's
+    # kernel key; the lru_cache above caches the instrumented wrapper, so
+    # later frames pay one flag check.
+    from tpu_render_cluster.obs.profiling import get_profiler, kernel_key
+
+    return get_profiler().instrument(
+        kernel_key(
+            "masked", scene_name,
+            w=width, h=height, s=samples, b=max_bounces,
+        ),
+        render,
+    )
 
 
 @functools.lru_cache(maxsize=64)
@@ -770,7 +782,18 @@ def fused_region_renderer(
             tile_height, tile_width, 3
         )
 
-    return render
+    # Roofline profiling: one cost capture per tile SHAPE (matching the
+    # one-compile-per-shape contract of this renderer).
+    from tpu_render_cluster.obs.profiling import get_profiler, kernel_key
+
+    return get_profiler().instrument(
+        kernel_key(
+            "region", scene_name,
+            w=width, h=height, th=tile_height, tw=tile_width,
+            s=samples, b=max_bounces,
+        ),
+        render,
+    )
 
 
 def render_frame_region(

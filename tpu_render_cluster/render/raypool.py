@@ -724,6 +724,33 @@ def render_batch_raypool(
             np.asarray(stats[5]), np.asarray(stats[6]),
         )
         duration = time.perf_counter() - start_mono
+        # Roofline profiling: capture the pool program's cost analysis
+        # once per pool config (the same identity note_compile tracks;
+        # one extra lowering, no second backend compile) — AFTER the
+        # duration stamp so the capture never inflates the first batch's
+        # measured time. The batch is ONE device dispatch fenced by the
+        # np.asarray above, so `duration` is the program's true wall time
+        # (per BATCH — the view divides by executions).
+        from tpu_render_cluster.obs.profiling import get_profiler, kernel_key
+
+        profiler = get_profiler()
+        pool_key = kernel_key(
+            "raypool", scene_name,
+            w=width, h=height, s=samples, b=max_bounces,
+            pool=pool, frames=f_cap,
+            tile="-" if region is None else f"{region[2]}x{region[3]}",
+        )
+        if not profiler.captured(pool_key):
+            profiler.capture(
+                pool_key, _raypool_batch, scene_name,
+                jnp.asarray(padded, jnp.float32), jnp.int32(len(chunk)),
+                jnp.int32(0 if region is None else region[0]),
+                jnp.int32(0 if region is None else region[1]),
+                width=width, height=height, samples=samples,
+                max_bounces=max_bounces, pool_width=pool,
+                tile_shape=None if region is None else (region[2], region[3]),
+            )
+        profiler.record_execute(pool_key, duration)
         _emit_batch_obs(
             scene_name=scene_name, n_chunk_frames=len(chunk), pool=pool,
             start_wall=start_wall, duration=duration,

@@ -11,6 +11,10 @@ scheduler with ``nc``. Operations:
 - ``{"op": "status", "job_id": "job-0001"}`` -> ``{"ok": true, "job": {...}}``
 - ``{"op": "cancel", "job_id": "job-0001"}`` -> ``{"ok": true, "cancelled": bool}``
 - ``{"op": "drain"}`` -> stop admitting; the service exits when idle
+- ``{"op": "alerts"}`` -> ``{"ok": true, "alerts": [...], "slo": {...}}``
+  — the SLO engine's structured alert log (obs/slo.py: one ``fire`` per
+  breach episode, one ``clear`` per recovery) plus the live per-job
+  attainment/burn view
 - ``{"op": "ping"}`` -> liveness
 
 Errors come back as ``{"ok": false, "error": "..."}``; the connection
@@ -62,6 +66,12 @@ async def handle_request(manager: "JobManager", request: dict[str, Any]) -> dict
         if op == "drain":
             manager.request_drain()
             return {"ok": True, "draining": True}
+        if op == "alerts":
+            return {
+                "ok": True,
+                "alerts": manager.slo.alerts_view(),
+                "slo": manager.slo.view(),
+            }
         return {"ok": False, "error": f"unknown op: {op!r}"}
     except (ValueError, RuntimeError, KeyError, TypeError) as e:
         return {"ok": False, "error": str(e)}

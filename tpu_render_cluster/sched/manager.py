@@ -115,6 +115,7 @@ class JobManager(ClusterManager):
         metrics_snapshot_path: str | Path | None = None,
         dispatch_delay_fn=None,
         output_base_directory: str | Path | None = None,
+        telemetry_port: int | None = None,
     ) -> None:
         super().__init__(
             host,
@@ -125,6 +126,7 @@ class JobManager(ClusterManager):
             metrics_snapshot_path=metrics_snapshot_path,
             dispatch_delay_fn=dispatch_delay_fn,
             output_base_directory=output_base_directory,
+            telemetry_port=telemetry_port,
         )
         self.config = config if config is not None else SchedulerConfig.from_env()
         self._runs: dict[str, JobRun] = {}  # job_id -> run, submit order
@@ -320,6 +322,10 @@ class JobManager(ClusterManager):
             dt, last = now - last, now
             await self._admit_ready_jobs(now)
             self._finalize_finished_jobs(now)
+            # SLO tick inline (the single-job master runs a sidecar task
+            # instead): window-slide recoveries and deadline breaches
+            # surface even for jobs whose result stream has stalled.
+            self.slo.tick(now)
             # A job whose unit exhausted its error budget (deterministic
             # render failure — worker_handle sets failed_reason) must not
             # spin redispatch forever: cancel it, releasing the pool.
@@ -428,6 +434,9 @@ class JobManager(ClusterManager):
         run.admitted_at = now
         self._running.append(run.job_id)
         self._active_by_name[run.job_name] = run
+        # SLO tracking from admission (the job's clock starts when it can
+        # actually run, not while parked in the admission queue).
+        self.slo.register_job(run.spec.job, started_at=now)
         self.metrics.counter(
             "sched_jobs_running_total", "Jobs admitted to the running set"
         ).inc()
@@ -458,6 +467,9 @@ class JobManager(ClusterManager):
     def _finish_run(self, run: JobRun, status: str, now: float) -> None:
         run.status = status
         run.finished_at = now
+        # Final SLO verdict (deadline judged at the true end; no-op for
+        # jobs without objectives or never admitted).
+        self.slo.finish_job(run.job_name)
         counter = (
             "sched_jobs_finished_total"
             if status == JOB_FINISHED
@@ -605,7 +617,7 @@ class JobManager(ClusterManager):
             labels=("job",),
         )
         target_gauge = self.metrics.gauge(
-            "sched_job_share_target",
+            "sched_job_target_share",
             "Fair-share target share per job",
             labels=("job",),
         )

@@ -7,6 +7,7 @@
     python -m tpu_render_cluster.sched.submit ... status [--job JOB_ID]
     python -m tpu_render_cluster.sched.submit ... cancel JOB_ID
     python -m tpu_render_cluster.sched.submit ... drain
+    python -m tpu_render_cluster.sched.submit ... alerts
 
 Prints the control plane's JSON response; exits non-zero when the server
 answers ``ok: false`` (or is unreachable), so scripts can chain on it.
@@ -45,6 +46,9 @@ def build_parser() -> argparse.ArgumentParser:
     cancel.add_argument("job_id")
 
     sub.add_parser("drain", help="Stop admitting; exit when idle")
+    sub.add_parser(
+        "alerts", help="SLO alert log + live per-job attainment/burn view"
+    )
     return parser
 
 
@@ -66,6 +70,8 @@ def _build_request(args: argparse.Namespace) -> dict:
         return request
     if args.command == "cancel":
         return {"op": "cancel", "job_id": args.job_id}
+    if args.command == "alerts":
+        return {"op": "alerts"}
     return {"op": "drain"}
 
 

@@ -216,7 +216,7 @@ class TpuRaytraceBackend(RenderBackend):
     @staticmethod
     def _observe_render_obs(
         *, compile_seconds: float, execute_seconds: float,
-        from_cache: bool = False,
+        from_cache: bool = False, kernel: str | None = None,
     ) -> None:
         """Feed the process-global obs registry (one TPU per process).
 
@@ -251,6 +251,13 @@ class TpuRaytraceBackend(RenderBackend):
         ).observe(max(0.0, execute_seconds))
         if execute_seconds > 0:
             render_fps_gauge(registry).set(1.0 / execute_seconds)
+        if kernel is not None and execute_seconds > 0:
+            # Roofline pairing: this tier's whole frame is one fenced
+            # program execution (render + readback), keyed identically to
+            # the cost capture inside the renderer factory.
+            from tpu_render_cluster.obs.profiling import get_profiler
+
+            get_profiler().record_execute(kernel, execute_seconds)
 
     def _render_sync(
         self, job: BlenderJob, frame_index: int, tile: int | None = None
@@ -465,10 +472,35 @@ class TpuRaytraceBackend(RenderBackend):
         )
         file_saving_finished_at = time.time()
 
+        # Which roofline kernel this frame's fenced execute time pairs
+        # with: only tiers whose frame is ONE program execution keyed by
+        # a factory-side cost capture (the wavefront/raypool drivers pair
+        # their own launches internally; cache hits executed nothing).
+        kernel = None
+        if cached_linear is None and not use_raypool and not use_wavefront:
+            from tpu_render_cluster.obs.profiling import kernel_key
+
+            if use_sharded:
+                pass  # sharded programs are not cost-captured (per-device)
+            elif region is not None:
+                y0, x0, tile_height, tile_width = region
+                kernel = kernel_key(
+                    "region", scene_name,
+                    w=self.width, h=self.height,
+                    th=tile_height, tw=tile_width,
+                    s=self.samples, b=self.max_bounces,
+                )
+            else:
+                kernel = kernel_key(
+                    "masked", scene_name,
+                    w=self.width, h=self.height,
+                    s=self.samples, b=self.max_bounces,
+                )
         self._observe_render_obs(
             compile_seconds=finished_loading_at - started_process_at,
             execute_seconds=finished_rendering_at - started_rendering_at,
             from_cache=cached_linear is not None,
+            kernel=kernel,
         )
         return FrameRenderTime(
             started_process_at=started_process_at,

@@ -101,6 +101,24 @@ def build_parser() -> argparse.ArgumentParser:
         "would fire.",
     )
     parser.add_argument(
+        "--telemetryPort",
+        dest="telemetry_port",
+        type=int,
+        default=None,
+        help="Serve this worker's live metrics over HTTP on this port: "
+        "/metrics (Prometheus text exposition) + /healthz. 0 picks an "
+        "ephemeral port. Defaults to the TRC_OBS_WORKER_PORT environment "
+        "variable; omit both to disable.",
+    )
+    parser.add_argument(
+        "--telemetryHost",
+        dest="telemetry_host",
+        default="0.0.0.0",
+        help="Bind address for the telemetry endpoints (default 0.0.0.0 so "
+        "a remote Prometheus/dashboard can scrape the worker, matching "
+        "the master's posture; use 127.0.0.1 to keep them local).",
+    )
+    parser.add_argument(
         "--warmScene",
         dest="warm_scene",
         default=None,
@@ -157,22 +175,48 @@ def make_backend(args: argparse.Namespace):
     return create_backend("mock")
 
 
-async def _run_worker(worker: Worker):
+async def _run_worker(
+    worker: Worker,
+    telemetry_port: int | None = None,
+    telemetry_host: str = "0.0.0.0",
+):
     """Run to completion with SIGTERM wired to a graceful drain.
 
     A terminated worker daemon (node maintenance, preemption) finishes
     the frame it is rendering, returns its queue to the master via the
     goodbye message, and exits cleanly — instead of vanishing and making
     the master pay a heartbeat-timeout eviction to rediscover the frames.
+
+    With ``telemetry_port`` set, the worker-local telemetry endpoints
+    (/metrics + /healthz; obs/http.py) serve this daemon's registry live
+    — the pull-based counterpart of the compact heartbeat piggyback the
+    master aggregates.
     """
     loop = asyncio.get_running_loop()
     try:
         loop.add_signal_handler(signal.SIGTERM, worker.request_drain)
     except (NotImplementedError, RuntimeError):  # non-Unix loop
         pass
+    telemetry = None
+    if telemetry_port is not None:
+        from tpu_render_cluster.obs.http import TelemetryServer
+
+        telemetry = TelemetryServer(
+            worker.metrics,
+            host=telemetry_host,
+            port=telemetry_port,
+            healthz_fn=lambda: {
+                "role": "worker",
+                "worker_id": pm.worker_id_to_string(worker.worker_id),
+                "backend": type(worker.backend).__name__,
+            },
+        )
+        await telemetry.start()
     try:
         return await worker.connect_and_run_to_job_completion()
     finally:
+        if telemetry is not None:
+            await telemetry.stop()
         try:
             loop.remove_signal_handler(signal.SIGTERM)
         except (NotImplementedError, RuntimeError, ValueError):
@@ -186,8 +230,13 @@ def main(argv: list[str] | None = None) -> int:
     if args.warm_scene and args.backend == "tpu-raytrace":
         backend.warm(args.warm_scene)
     worker = Worker(args.master_host, args.master_port, backend)
+    from tpu_render_cluster.obs.http import resolve_telemetry_port
+
+    telemetry_port = resolve_telemetry_port(
+        args.telemetry_port, "TRC_OBS_WORKER_PORT"
+    )
     try:
-        asyncio.run(_run_worker(worker))
+        asyncio.run(_run_worker(worker, telemetry_port, args.telemetry_host))
     finally:
         # Export this daemon's obs artifacts even when the run died (the
         # partial timeline matters most in exactly those runs): in
@@ -207,8 +256,17 @@ def main(argv: list[str] | None = None) -> int:
                 [worker.span_tracer, get_tracer()],
             )
             get_tracer().clear()
+            # The roofline section (obs/profiling.py): per-kernel XLA
+            # cost analysis paired with this worker's measured execute
+            # times — the per-kernel achieved-vs-peak evidence the
+            # statistics.json fold consumes.
+            from tpu_render_cluster.obs.profiling import get_profiler
+
+            roofline = get_profiler().view()
             write_metrics_snapshot(
-                obs_directory / f"{worker_name}_metrics.json", worker.metrics
+                obs_directory / f"{worker_name}_metrics.json",
+                worker.metrics,
+                extra={"roofline": roofline} if roofline else None,
             )
         except Exception as e:  # noqa: BLE001 - obs must not mask the run error
             print(f"warning: obs artifact export failed: {e}", file=sys.stderr)
