@@ -282,66 +282,258 @@ def wavefront_compare(
     from tpu_render_cluster.render.integrator import fused_frame_renderer
 
     on_tpu = jax.default_backend() == "tpu"
-    # The CPU (interpret) config must still span MANY kernel blocks —
-    # compaction only shrinks launches in units of the block size (1024
-    # rays), so a frame of a few blocks measures mostly driver overhead
-    # instead of the mode (idle-machine sweep, this scene: 32x32 -> 0.75x,
-    # 64x64 -> 1.01x, 128x128 -> 1.13x wavefront speedup).
-    width = height = WIDTH if on_tpu else 128
-    samples = SAMPLES if on_tpu else 1
-    renderer = fused_frame_renderer(scene_name, width, height, samples, bounces)
-
-    def masked_frame(frame: int):
-        np.asarray(renderer(frame))
-
-    def wavefront_frame(frame: int):
-        from tpu_render_cluster.render.integrator import tonemap
-
-        # tonemap on BOTH sides: the fused renderer's program ends in
-        # tonemap, and the worker backend's wavefront branch tonemaps
-        # too — an asymmetric comparison would hand wavefront the
-        # display-transform cost for free.
-        np.asarray(
-            tonemap(
-                compaction.render_frame_wavefront(
-                    scene_name, frame, width=width, height=height,
-                    samples=samples, max_bounces=bounces,
-                )
-            )
+    # Pin the masked tier to the Pallas (interpret) path off-chip, same
+    # rationale as raypool_compare/bvh_compare: the wavefront driver
+    # always runs the Pallas bounce kernels, while the masked renderer's
+    # CPU default is the XLA fallback — a cross-suite comparison would
+    # measure kernel dialects, not dispatch modes.
+    pallas_pinned = False
+    if not on_tpu and os.environ.get("TRC_PALLAS") is None:
+        os.environ["TRC_PALLAS"] = "1"
+        pallas_pinned = True
+        jax.clear_caches()
+        fused_frame_renderer.cache_clear()
+    try:
+        # The CPU (interpret) config must still span MANY kernel blocks —
+        # compaction only shrinks launches in units of the bucket quantum
+        # (the kernel ray block), so a frame of a few blocks measures
+        # mostly driver overhead instead of the mode. (Pre-TLAS
+        # idle-machine sweep, this scene: 32x32 -> 0.75x, 64x64 -> 1.01x,
+        # 128x128 -> 1.13x wavefront speedup; on the TLAS kernels the
+        # masked tier resorts/tail-skips on the same key column, so the
+        # committed 128x128 record is ~parity — the mode win is the
+        # wasted_lane_fraction row and the on-chip launch shrink, not a
+        # CPU-proxy frames/s delta.)
+        width = height = WIDTH if on_tpu else 128
+        samples = SAMPLES if on_tpu else 1
+        renderer = fused_frame_renderer(
+            scene_name, width, height, samples, bounces
         )
 
-    record: dict = {
-        "metric": f"{scene_name} masked vs wavefront "
-        f"({width}x{height}, {samples}spp, {bounces}b, "
-        f"{jax.devices()[0].platform})",
-        "unit": "frames/s/chip",
-        "frames": frames,
-        "reps": reps,
-    }
-    modes = (("masked", masked_frame), ("wavefront", wavefront_frame))
-    for _name, render_one in modes:
-        render_one(1)  # compile + warm
-    fps: dict[str, list[float]] = {"masked": [], "wavefront": []}
-    for rep in range(reps):
-        # Both modes render the SAME frame window per rep: the scenes are
-        # physics-animated, so disjoint frame ranges would compare
-        # different geometry/survival curves (and hand one mode the
-        # bucket recompiles a first-seen live count triggers).
-        rep_frames = range(2 + rep * frames, 2 + (rep + 1) * frames)
-        for name, render_one in modes:
-            t0 = time.perf_counter()
-            for frame in rep_frames:
-                render_one(frame)
-            fps[name].append(frames / (time.perf_counter() - t0))
-    for name, values in fps.items():
-        record[f"{name}_fps"] = round(statistics.median(values), 3)
-    record["wavefront_speedup"] = round(
-        record["wavefront_fps"] / record["masked_fps"], 3
-    )
-    wasted = compaction.wasted_lane_fraction()
-    if wasted is not None:
-        record["wasted_lane_fraction"] = round(wasted, 4)
-    return record
+        def masked_frame(frame: int):
+            np.asarray(renderer(frame))
+
+        def wavefront_frame(frame: int):
+            from tpu_render_cluster.render.integrator import tonemap
+
+            # tonemap on BOTH sides: the fused renderer's program ends in
+            # tonemap, and the worker backend's wavefront branch tonemaps
+            # too — an asymmetric comparison would hand wavefront the
+            # display-transform cost for free.
+            np.asarray(
+                tonemap(
+                    compaction.render_frame_wavefront(
+                        scene_name, frame, width=width, height=height,
+                        samples=samples, max_bounces=bounces,
+                    )
+                )
+            )
+
+        from tpu_render_cluster.render import pallas_kernels as pk
+
+        record: dict = {
+            "metric": f"{scene_name} masked vs wavefront "
+            f"({width}x{height}, {samples}spp, {bounces}b, "
+            f"{jax.devices()[0].platform})",
+            "unit": "frames/s/chip",
+            "frames": frames,
+            "reps": reps,
+            # Method: which kernel generation BOTH modes ran (TRC_TLAS
+            # env tier at record time) — the masked tier is pinned to
+            # the Pallas path off-chip so the modes share one suite.
+            "tlas_kernels": pk.tlas_enabled(),
+        }
+        modes = (("masked", masked_frame), ("wavefront", wavefront_frame))
+        for _name, render_one in modes:
+            render_one(1)  # compile + warm
+        fps: dict[str, list[float]] = {"masked": [], "wavefront": []}
+        for rep in range(reps):
+            # Both modes render the SAME frame window per rep: the scenes
+            # are physics-animated, so disjoint frame ranges would compare
+            # different geometry/survival curves (and hand one mode the
+            # bucket recompiles a first-seen live count triggers).
+            rep_frames = range(2 + rep * frames, 2 + (rep + 1) * frames)
+            for name, render_one in modes:
+                t0 = time.perf_counter()
+                for frame in rep_frames:
+                    render_one(frame)
+                fps[name].append(frames / (time.perf_counter() - t0))
+        for name, values in fps.items():
+            record[f"{name}_fps"] = round(statistics.median(values), 3)
+        record["wavefront_speedup"] = round(
+            record["wavefront_fps"] / record["masked_fps"], 3
+        )
+        wasted = compaction.wasted_lane_fraction()
+        if wasted is not None:
+            record["wasted_lane_fraction"] = round(wasted, 4)
+        return record
+    finally:
+        if pallas_pinned:
+            os.environ.pop("TRC_PALLAS", None)
+            jax.clear_caches()
+            fused_frame_renderer.cache_clear()
+
+
+def bvh_compare(
+    deep_scene: str = "03_physics-2-mesh",
+    control_scene: str = "02_physics-mesh",
+    frames: int = 3,
+    reps: int = 5,
+    bounces: int = BOUNCES,
+) -> dict:
+    """Flat in-kernel instance loop vs two-level TLAS kernels (ISSUE 10).
+
+    Interleaved median-of-reps A/B through the masked fused renderer —
+    the two variants are DISTINCT compiled programs in one process
+    (``use_tlas`` is part of the renderer cache key and every jit
+    identity), so each rep times (flat window, TLAS window) back to
+    back and the median cancels machine-load drift (per the recorded
+    bench-variance protocol: sequential timings are invalid at this
+    host's ±30%). Two scenes:
+
+    - ``deep_scene`` (03-family: 127-node BLAS x 48 instances) — the
+      deep-scene cliff the TLAS exists for (every bounce kernel used to
+      sweep all 48 instances per ray block);
+    - ``control_scene`` (shallow megakernel mesh scene) — the
+      no-regression guard: the TLAS walk still runs there (24
+      instances), it just has less to prune.
+
+    Each scene's section also records the per-kernel roofline placement
+    delta from the PR-9 ``cost_analysis`` capture: the two variants'
+    FLOPs / bytes-accessed / achieved-vs-attainable rows land under
+    separate ``tlas=0|1`` kernel keys, so the record shows WHERE the
+    speedup comes from (fewer instance-sweep FLOPs and one less
+    full-state broadphase pass per bounce), not just that it exists.
+
+    On non-TPU hosts the masked tier is pinned to the Pallas interpret
+    path for the duration (same rationale as raypool_compare: all
+    variants must run the same kernel suite or the comparison is
+    fiction). The committed record lives at results/BVH_BENCH.json; run
+    with ``python bench.py --bvh-compare`` on the target device class.
+    """
+    import statistics
+
+    import jax
+    import numpy as np
+
+    from tpu_render_cluster.obs.profiling import get_profiler
+    from tpu_render_cluster.render import pallas_kernels as pk
+    from tpu_render_cluster.render.integrator import fused_frame_renderer
+
+    on_tpu = jax.default_backend() == "tpu"
+    pallas_pinned = False
+    if not on_tpu and os.environ.get("TRC_PALLAS") is None:
+        os.environ["TRC_PALLAS"] = "1"
+        pallas_pinned = True
+        jax.clear_caches()
+        fused_frame_renderer.cache_clear()
+    try:
+        # Same CPU shrink rationale as wavefront_compare: the workload
+        # must span many kernel blocks or the measurement is driver
+        # overhead, but interpret mode caps what is affordable.
+        width = height = WIDTH if on_tpu else 128
+        samples = SAMPLES if on_tpu else 1
+        record: dict = {
+            "metric": (
+                f"flat instance loop vs two-level TLAS kernels "
+                f"({width}x{height}, {samples}spp, {bounces}b, "
+                f"{jax.devices()[0].platform})"
+            ),
+            "unit": "frames/s/chip",
+            "frames": frames,
+            "reps": reps,
+            "tlas_leaf": pk.tlas_leaf_size(),
+            "scenes": {},
+        }
+        profiler = get_profiler()
+        for scene_name in (deep_scene, control_scene):
+            renderers = {
+                "flat": fused_frame_renderer(
+                    scene_name, width, height, samples, bounces, False
+                ),
+                "tlas": fused_frame_renderer(
+                    scene_name, width, height, samples, bounces, True
+                ),
+            }
+            for renderer in renderers.values():
+                np.asarray(renderer(1))  # compile + warm
+            fps: dict[str, list[float]] = {"flat": [], "tlas": []}
+            for rep in range(reps):
+                # Both variants render the SAME frame window per rep
+                # (physics-animated scenes: disjoint ranges would
+                # compare different geometry).
+                rep_frames = range(2 + rep * frames, 2 + (rep + 1) * frames)
+                for name, renderer in renderers.items():
+                    window = 0.0
+                    for frame in rep_frames:
+                        t0 = time.perf_counter()
+                        np.asarray(renderer(frame))
+                        elapsed = time.perf_counter() - t0
+                        window += elapsed
+                        # Measured-time pairing for the roofline rows
+                        # (production gets this from the worker backend;
+                        # the bench stands in for it here).
+                        profiler.record_execute(renderer.kernel_key, elapsed)
+                    fps[name].append(frames / window)
+            section: dict = {}
+            for name, values in fps.items():
+                section[f"{name}_fps"] = round(statistics.median(values), 3)
+            section["tlas_speedup"] = round(
+                section["tlas_fps"] / section["flat_fps"], 3
+            )
+            # Roofline placement per variant: the masked-tier kernel
+            # keys differ only in the tlas dim.
+            roofline = profiler.view()
+            kernels = roofline.get("kernels", {})
+            placement: dict = {}
+            for name, flag in (("flat", 0), ("tlas", 1)):
+                from tpu_render_cluster.obs.profiling import kernel_key
+
+                entry = kernels.get(
+                    kernel_key(
+                        "masked", scene_name,
+                        w=width, h=height, s=samples, b=bounces, tlas=flag,
+                    )
+                )
+                if entry and entry.get("captured"):
+                    placement[name] = {
+                        "flops": entry["flops"],
+                        "bytes_accessed": entry["bytes_accessed"],
+                        "bound": entry.get("bound"),
+                        "achieved_fraction_of_attainable": round(
+                            entry.get(
+                                "achieved_fraction_of_attainable", 0.0
+                            ),
+                            6,
+                        ),
+                    }
+            if {"flat", "tlas"} <= placement.keys():
+                flat_p, tlas_p = placement["flat"], placement["tlas"]
+                placement["delta"] = {
+                    "flops_ratio": round(
+                        tlas_p["flops"] / flat_p["flops"], 4
+                    ) if flat_p["flops"] else None,
+                    "bytes_ratio": round(
+                        tlas_p["bytes_accessed"] / flat_p["bytes_accessed"],
+                        4,
+                    ) if flat_p["bytes_accessed"] else None,
+                    "attainable_fraction_delta": round(
+                        tlas_p["achieved_fraction_of_attainable"]
+                        - flat_p["achieved_fraction_of_attainable"],
+                        6,
+                    ),
+                }
+            section["roofline"] = placement
+            section["role"] = (
+                "deep" if scene_name == deep_scene else "shallow-control"
+            )
+            record["scenes"][scene_name] = section
+        return record
+    finally:
+        if pallas_pinned:
+            os.environ.pop("TRC_PALLAS", None)
+            jax.clear_caches()
+            fused_frame_renderer.cache_clear()
 
 
 def raypool_compare(
@@ -410,6 +602,7 @@ def _raypool_compare_inner(
     scene_name, frames, reps, bounces, *, on_tpu, statistics, jax, np,
     compaction, raypool, fused_frame_renderer, tonemap,
 ):
+    from tpu_render_cluster.render import pallas_kernels as pk
     # Same CPU shrink rationale as wavefront_compare: the workload must
     # span many kernel blocks or the measurement is driver overhead.
     width = height = WIDTH if on_tpu else 128
@@ -447,6 +640,10 @@ def _raypool_compare_inner(
         "frames": frames,
         "reps": reps,
         "raypool_frame_cap": raypool.raypool_frame_cap(),
+        # Method: which kernel generation ALL THREE modes ran (TRC_TLAS
+        # env tier at record time; the masked tier is already pinned to
+        # the Pallas path off-chip).
+        "tlas_kernels": pk.tlas_enabled(),
     }
     modes = (
         ("masked", masked_window),
@@ -938,9 +1135,22 @@ def cpu_baseline_fps() -> float:
 
 
 def _int_flag(name: str, default: int) -> int:
-    """Value of ``<name> <int>`` in argv, or ``default`` when absent."""
+    """Value of ``<name> <int>`` in argv, or ``default`` when absent
+    (also when the flag is the trailing token with its value omitted)."""
     if name in sys.argv:
-        return int(sys.argv[sys.argv.index(name) + 1])
+        index = sys.argv.index(name) + 1
+        if index < len(sys.argv):
+            return int(sys.argv[index])
+    return default
+
+
+def _str_flag(name: str, default: str) -> str:
+    """Value of ``<name> <str>`` in argv, or ``default`` when absent
+    (also when the flag is the trailing token with its value omitted)."""
+    if name in sys.argv:
+        index = sys.argv.index(name) + 1
+        if index < len(sys.argv):
+            return sys.argv[index]
     return default
 
 
@@ -1002,6 +1212,35 @@ def main() -> int:
             os.path.dirname(os.path.abspath(__file__)),
             "results",
             "TILE_BENCH.json",
+        )
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
+        return 0
+
+    if "--bvh-compare" in sys.argv:
+        index = sys.argv.index("--bvh-compare")
+        deep = (
+            sys.argv[index + 1]
+            if index + 1 < len(sys.argv) and not sys.argv[index + 1].startswith("-")
+            else "03_physics-2-mesh"
+        )
+        control = _str_flag("--control", "02_physics-mesh")
+        frames = _int_flag("--frames", 3)
+        reps = _int_flag("--reps", 5)
+        bounces = _int_flag("--bounces", BOUNCES)
+        record = bvh_compare(
+            deep, control, frames=frames, reps=reps, bounces=bounces
+        )
+        record["command"] = (
+            f"python bench.py --bvh-compare {deep} --control {control} "
+            f"--frames {frames} --reps {reps} --bounces {bounces}"
+        )
+        print(json.dumps(record))
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "results",
+            "BVH_BENCH.json",
         )
         with open(out_path, "w", encoding="utf-8") as f:
             json.dump(record, f, indent=1)

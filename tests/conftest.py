@@ -69,4 +69,11 @@ def _isolated_render_compile_tracking():
     profiling = sys.modules.get("tpu_render_cluster.obs.profiling")
     if profiling is not None:
         profiling.get_profiler().reset()
+    # And for the host-side geometry-build memo (render/mesh.py): BVH/
+    # TLAS builds are pure, but per-test build-count assertions (e.g.
+    # render_tlas_builds_total deltas) must not depend on which
+    # hierarchies earlier tests already built.
+    mesh = sys.modules.get("tpu_render_cluster.render.mesh")
+    if mesh is not None:
+        mesh.reset_geometry_cache()
     yield

@@ -226,6 +226,43 @@ def _compact_mesh(origins, directions, throughput, alive, lane, rng, mesh):
     )
 
 
+@jax.jit
+def _compact_mesh_keyed(origins, directions, throughput, alive, lane, rng,
+                        keys):
+    """Compact mesh-scene state by the PRECOMPUTED coherence key column.
+
+    The TLAS bounce kernels emit the next bounce's sort key from their
+    epilogue (pallas_kernels.coherence_key_u32 — dead flag at
+    KEY_DEAD_BIT, 29), so
+    the re-sort here is one argsort over an int32 column instead of the
+    separate XLA broadphase + quantization pass ``_compact_mesh`` pays
+    over the full ray state. Same contract: dead lanes to the tail, one
+    packed gather for coherence AND partition.
+    """
+    order = jnp.argsort(keys)
+    packed = jnp.concatenate([origins, directions, throughput], axis=1)[order]
+    return (
+        packed[:, 0:3],
+        packed[:, 3:6],
+        packed[:, 6:9],
+        alive[order],
+        lane[order],
+        rng[order],
+        jnp.sum(alive.astype(jnp.int32)),
+    )
+
+
+@jax.jit
+def _initial_mesh_keys(origins, directions, alive, mesh):
+    """Bounce-0 coherence keys for the TLAS wavefront: the XLA twin of
+    the kernel epilogue, via THE shared derivation
+    (pallas_kernels.initial_mesh_sort_keys — the deep per-bounce path
+    keys through the same site). Frame-dependent, never ray-dependent,
+    so every launch of a frame keys identically; bounces > 0 read the
+    kernel-emitted column."""
+    return pk.initial_mesh_sort_keys(mesh, origins, directions, alive)
+
+
 @functools.partial(jax.jit, static_argnames=("total_bounces",))
 def _sphere_step(
     scene, origins, directions, throughput, alive, lane, rng, live, seed,
@@ -238,21 +275,25 @@ def _sphere_step(
     return o2, d2, thr2, alive2, radiance_total.at[lane].add(contribution)
 
 
-@functools.partial(jax.jit, static_argnames=("total_bounces",))
+@functools.partial(jax.jit, static_argnames=("total_bounces", "use_tlas"))
 def _mesh_step(
     scene, mesh, origins, directions, throughput, alive, lane, rng, live, seed,
-    bounce, radiance_total, *, total_bounces: int,
+    bounce, radiance_total, *, total_bounces: int, use_tlas: bool = False,
 ):
-    contribution, o2, d2, thr2, alive2 = pk.mesh_bounce_pallas(
+    contribution, o2, d2, thr2, alive2, keys2 = pk.mesh_bounce_pallas(
         scene, mesh, origins, directions, throughput, alive, seed, bounce,
         total_bounces=total_bounces, lane=rng, live_count=live,
+        use_tlas=use_tlas,
     )
-    return o2, d2, thr2, alive2, radiance_total.at[lane].add(contribution)
+    return (
+        o2, d2, thr2, alive2, radiance_total.at[lane].add(contribution),
+        keys2,
+    )
 
 
 def trace_paths_wavefront(
     scene, origins, directions, seed, *, max_bounces: int = 4, mesh=None,
-    rng_lanes=None,
+    rng_lanes=None, use_tlas=None,
 ):
     """Trace one sample per ray, wavefront-style; returns radiance [R, 3].
 
@@ -270,12 +311,27 @@ def trace_paths_wavefront(
     RNG counters with FULL-frame lane ids: the cluster-tile region path
     (render_region_wavefront) uses it so a tiled wavefront frame
     reproduces the whole-frame wavefront image on its pixels.
+    ``use_tlas`` (None = env tier) selects the two-level mesh kernel
+    variant; with it, each bounce's compaction reads the key column the
+    previous bounce kernel emitted instead of re-deriving keys.
     """
     from tpu_render_cluster.obs import get_tracer
 
     n0 = origins.shape[0]
-    block = pk.BVH_BLOCK_R if mesh is not None else pk.SPHERE_BOUNCE_BLOCK_R
     kind = "mesh" if mesh is not None else "sphere"
+    tlas = (
+        pk.use_tlas_for(mesh.instances.translation.shape[0], use_tlas)
+        if mesh is not None else False
+    )
+    # The bucket quantum is the kernel's ray block: the TLAS kernels
+    # packet at the narrower tlas_block_r, which also buys the ladder
+    # finer reclaim granularity.
+    if mesh is None:
+        block = pk.SPHERE_BOUNCE_BLOCK_R
+    elif tlas:
+        block = pk.tlas_block_r()
+    else:
+        block = pk.BVH_BLOCK_R
     tracer = get_tracer()
     occupancy = lane_occupancy_gauge()
     survival = alive_fraction_histogram()
@@ -287,13 +343,20 @@ def trace_paths_wavefront(
     lane = jnp.arange(n0, dtype=jnp.int32)
     rng = lane if rng_lanes is None else jnp.asarray(rng_lanes, jnp.int32)
     seed = jnp.asarray(seed, jnp.int32)
+    keys = _initial_mesh_keys(origins, directions, alive, mesh) if tlas else None
 
     for bounce in range(max_bounces):
         start_wall = time.time()
         start_mono = time.perf_counter()
         width = origins.shape[0]
         _count_compile(kind, "compact", width)
-        if mesh is not None:
+        if tlas:
+            origins, directions, throughput, alive, lane, rng, live_dev = (
+                _compact_mesh_keyed(
+                    origins, directions, throughput, alive, lane, rng, keys
+                )
+            )
+        elif mesh is not None:
             origins, directions, throughput, alive, lane, rng, live_dev = (
                 _compact_mesh(
                     origins, directions, throughput, alive, lane, rng, mesh
@@ -327,7 +390,7 @@ def trace_paths_wavefront(
             rng = rng[:bucket]
         occupancy.set(live / bucket)
         launched.observe(live / bucket)
-        _count_compile(kind, "bounce", bucket, max_bounces)
+        _count_compile(kind, "bounce", bucket, max_bounces, tlas)
         # Roofline profiling: the bucket program's identity is (kind,
         # bucket, bounces) — the same identity the bucketed-jit cache
         # compiles per. The capture args are stashed BEFORE the step
@@ -337,7 +400,8 @@ def trace_paths_wavefront(
 
         profiler = get_profiler()
         step_key = kernel_key(
-            f"wavefront_{kind}_bounce", None, bucket=bucket, b=max_bounces
+            f"wavefront_{kind}_bounce", None, bucket=bucket, b=max_bounces,
+            tlas=int(tlas),
         )
         capture_args = None
         if not profiler.captured(step_key):
@@ -349,12 +413,11 @@ def trace_paths_wavefront(
                       rng, live_dev, seed, bounce, radiance_total)
             )
         if mesh is not None:
-            origins, directions, throughput, alive, radiance_total = (
-                _mesh_step(
-                    scene, mesh, origins, directions, throughput, alive,
-                    lane, rng, live_dev, seed, bounce, radiance_total,
-                    total_bounces=max_bounces,
-                )
+            (origins, directions, throughput, alive, radiance_total,
+             keys) = _mesh_step(
+                scene, mesh, origins, directions, throughput, alive,
+                lane, rng, live_dev, seed, bounce, radiance_total,
+                total_bounces=max_bounces, use_tlas=tlas,
             )
         else:
             origins, directions, throughput, alive, radiance_total = (
@@ -371,10 +434,16 @@ def trace_paths_wavefront(
         # cost — there is no tighter device fence to pair with.
         profiler.record_execute(step_key, bounce_seconds)
         if capture_args is not None:
-            step = _mesh_step if mesh is not None else _sphere_step
-            profiler.capture(
-                step_key, step, *capture_args, total_bounces=max_bounces
-            )
+            if mesh is not None:
+                profiler.capture(
+                    step_key, _mesh_step, *capture_args,
+                    total_bounces=max_bounces, use_tlas=tlas,
+                )
+            else:
+                profiler.capture(
+                    step_key, _sphere_step, *capture_args,
+                    total_bounces=max_bounces,
+                )
         tracer.complete(
             "wavefront_bounce", cat="render", start_wall=start_wall,
             duration=bounce_seconds,
@@ -419,6 +488,7 @@ def render_frame_wavefront(
     height: int = 512,
     samples: int = 8,
     max_bounces: int = 4,
+    use_tlas=None,
 ):
     """Render one frame through the wavefront driver; [H, W, 3] linear.
 
@@ -440,7 +510,8 @@ def render_frame_wavefront(
         width=width, height=height, samples=samples,
     )
     radiance = trace_paths_wavefront(
-        scene, origins, directions, seed, max_bounces=max_bounces, mesh=mesh
+        scene, origins, directions, seed, max_bounces=max_bounces, mesh=mesh,
+        use_tlas=use_tlas,
     )
     return _finish_frame(
         radiance, samples=samples, height=height, width=width
@@ -475,6 +546,7 @@ def render_region_wavefront(
     height: int = 512,
     samples: int = 8,
     max_bounces: int = 4,
+    use_tlas=None,
 ):
     """Render one region of a frame through the wavefront driver.
 
@@ -499,7 +571,7 @@ def render_region_wavefront(
     )
     radiance = trace_paths_wavefront(
         scene, origins, directions, seed, max_bounces=max_bounces,
-        mesh=mesh, rng_lanes=lanes,
+        mesh=mesh, rng_lanes=lanes, use_tlas=use_tlas,
     )
     return _finish_frame(
         radiance, samples=samples, height=tile_height, width=tile_width

@@ -394,7 +394,7 @@ def region_rays_and_seed(
 
 def trace_paths(
     scene: Scene, origins, directions, key, *, max_bounces: int = 4, mesh=None,
-    rng_lanes=None,
+    rng_lanes=None, use_tlas=None,
 ) -> jnp.ndarray:
     """Trace one sample per ray; returns radiance [R, 3].
 
@@ -413,6 +413,14 @@ def trace_paths(
     megakernels', pinned by tests/test_wavefront.py), and the XLA
     fallback ignores it (shape-derived RNG cannot be cropped — region
     renders there are statistically, not bitwise, consistent).
+
+    ``use_tlas`` (None = the ``TRC_TLAS`` env tier, default on) selects
+    the two-level TLAS kernel variants for mesh scenes. Per-lane results
+    are identical either way (instance visit order is semantically free;
+    only packet-cull efficiency changes); on the deep per-bounce path the
+    TLAS kernels additionally emit the next bounce's coherence sort key
+    from their epilogue, so the re-sort below reads one precomputed
+    column instead of re-deriving keys from the full ray state.
     """
     from tpu_render_cluster.render import pallas_kernels
 
@@ -441,7 +449,7 @@ def trace_paths(
         if rng_lanes is None and pallas_kernels.mesh_megakernel_eligible(mesh):
             return pallas_kernels.trace_paths_fused_mesh(
                 scene, mesh, origins, directions, seed,
-                max_bounces=max_bounces,
+                max_bounces=max_bounces, use_tlas=use_tlas,
             )
         # Deep scenes: the megakernel's bounce_step as ONE fused launch
         # per bounce (sphere/plane/mesh nearest, NEE with both any-hits,
@@ -464,8 +472,25 @@ def trace_paths(
         # positional lanes the two arrays are identical and XLA CSEs the
         # duplicate gathers away.
         rng = lane if rng_lanes is None else jnp.asarray(rng_lanes, jnp.int32)
+        tlas = pallas_kernels.use_tlas_for(
+            mesh.instances.translation.shape[0], use_tlas
+        )
+        keys = None
+        if tlas:
+            # Bounce 0 has no kernel-emitted key column yet: derive the
+            # initial keys through the XLA twin of the kernels' fused
+            # epilogue, via the SAME shared site the wavefront driver
+            # uses (bit-identical derivation, pinned by
+            # tests/test_tlas.py). Later bounces read the key column the
+            # bounce kernel wrote while the state was still VMEM-resident.
+            keys = pallas_kernels.initial_mesh_sort_keys(
+                mesh, origins, directions, alive
+            )
         for bounce in range(max_bounces):
-            order = _ray_sort_order(origins, directions, alive, mesh=mesh)
+            order = (
+                jnp.argsort(keys) if tlas
+                else _ray_sort_order(origins, directions, alive, mesh=mesh)
+            )
             packed = jnp.concatenate(
                 [origins, directions, throughput, radiance], axis=1
             )[order]
@@ -476,20 +501,21 @@ def trace_paths(
             alive = alive[order]
             lane = lane[order]
             rng = rng[order]
-            # The sort key's dead flag (bit 31) puts every dead lane
-            # after every live one, so lanes >= live are exactly the dead
-            # tail: the kernel's live-count prefetch skips those blocks
-            # outright (behavior-preserving — dead lanes pass through a
-            # masked bounce unchanged anyway). The carried ORIGINAL lane
-            # id doubles as the RNG counter, so a ray's stream survives
-            # the permutation (and composes with the wavefront driver's
-            # compaction, which shares this kernel).
+            # The sort key's dead flag (bit 31 flat, bit 29 fused) puts
+            # every dead lane after every live one, so lanes >= live are
+            # exactly the dead tail: the kernel's live-count prefetch
+            # skips those blocks outright (behavior-preserving — dead
+            # lanes pass through a masked bounce unchanged anyway). The
+            # carried ORIGINAL lane id doubles as the RNG counter, so a
+            # ray's stream survives the permutation (and composes with
+            # the wavefront driver's compaction, which shares this
+            # kernel).
             live = jnp.sum(alive.astype(jnp.int32))
-            contribution, origins, directions, throughput, alive = (
+            contribution, origins, directions, throughput, alive, keys = (
                 pallas_kernels.mesh_bounce_pallas(
                     scene, mesh, origins, directions, throughput, alive,
                     seed, bounce, total_bounces=max_bounces,
-                    lane=rng, live_count=live,
+                    lane=rng, live_count=live, use_tlas=tlas,
                 )
             )
             radiance = radiance + contribution
@@ -515,7 +541,10 @@ def trace_paths(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("width", "height", "tile_height", "tile_width", "samples", "max_bounces"),
+    static_argnames=(
+        "width", "height", "tile_height", "tile_width", "samples",
+        "max_bounces", "use_tlas",
+    ),
 )
 def render_tile(
     scene: Scene,
@@ -531,11 +560,15 @@ def render_tile(
     samples: int = 8,
     max_bounces: int = 4,
     mesh=None,
+    use_tlas=None,
 ) -> jnp.ndarray:
     """Render a tile; returns [tile_height, tile_width, 3] linear radiance.
 
     The RNG key derives from (frame, y0, x0, sample) so any tile of any
-    frame renders identically regardless of device/order.
+    frame renders identically regardless of device/order. ``use_tlas``
+    (static; None = env tier) selects the two-level mesh kernel variant
+    — a distinct value is a distinct compiled program, which is what
+    lets the interleaved A/B bench run both variants in one process.
     """
     n = tile_height * tile_width
     base_key = tile_base_key(frame, y0, x0)
@@ -569,6 +602,7 @@ def render_tile(
             tile_trace_key(base_key),
             max_bounces=max_bounces,
             mesh=mesh,
+            use_tlas=use_tlas,
         )
         image = radiance.reshape(samples, n, 3).mean(axis=0)
     else:
@@ -669,6 +703,7 @@ def fused_frame_renderer(
     height: int,
     samples: int,
     max_bounces: int,
+    use_tlas: bool | None = None,
 ):
     """A jitted ``frame -> uint8 [H, W, 3]`` closure for one scene/config.
 
@@ -679,6 +714,11 @@ def fused_frame_renderer(
     dispatches per frame, which dominates wall time when the device sits
     behind a network tunnel (observed: ~2 s/frame eager vs ~10 ms fused on
     the same chip).
+
+    ``use_tlas`` (None = env tier, resolved at trace time) is part of
+    the cache key AND the compiled program's identity: the interleaved
+    ``bench.py --bvh-compare`` holds one renderer per variant in the
+    same process.
     """
     from tpu_render_cluster.render.camera import scene_camera
     from tpu_render_cluster.render.scene import build_scene
@@ -703,19 +743,24 @@ def fused_frame_renderer(
             samples=samples,
             max_bounces=max_bounces,
             mesh=mesh,
+            use_tlas=use_tlas,
         )
         return tonemap(linear)
 
     # Roofline profiling (obs/profiling.py): the first call captures the
     # program's XLA cost analysis (FLOPs/bytes) under the masked tier's
     # kernel key; the lru_cache above caches the instrumented wrapper, so
-    # later frames pay one flag check.
+    # later frames pay one flag check. The tlas dim keys the two kernel
+    # variants to separate roofline rows — the per-kernel placement
+    # delta bench.py --bvh-compare records.
     from tpu_render_cluster.obs.profiling import get_profiler, kernel_key
+    from tpu_render_cluster.render.pallas_kernels import tlas_enabled
 
     return get_profiler().instrument(
         kernel_key(
             "masked", scene_name,
             w=width, h=height, s=samples, b=max_bounces,
+            tlas=int(tlas_enabled() if use_tlas is None else use_tlas),
         ),
         render,
     )
@@ -730,6 +775,7 @@ def fused_region_renderer(
     tile_width: int,
     samples: int,
     max_bounces: int,
+    use_tlas: bool | None = None,
 ):
     """A jitted ``(frame, y0, x0) -> [th, tw, 3] LINEAR`` region closure.
 
@@ -768,6 +814,7 @@ def fused_region_renderer(
             radiance = trace_paths(
                 scene, origins, directions, tile_trace_key(base_key),
                 max_bounces=max_bounces, mesh=mesh, rng_lanes=lanes,
+                use_tlas=use_tlas,
             )
         else:
             # XLA fallback: per-lane counters don't exist there, so the
@@ -785,12 +832,14 @@ def fused_region_renderer(
     # Roofline profiling: one cost capture per tile SHAPE (matching the
     # one-compile-per-shape contract of this renderer).
     from tpu_render_cluster.obs.profiling import get_profiler, kernel_key
+    from tpu_render_cluster.render.pallas_kernels import tlas_enabled
 
     return get_profiler().instrument(
         kernel_key(
             "region", scene_name,
             w=width, h=height, th=tile_height, tw=tile_width,
             s=samples, b=max_bounces,
+            tlas=int(tlas_enabled() if use_tlas is None else use_tlas),
         ),
         render,
     )

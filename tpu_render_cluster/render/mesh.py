@@ -31,7 +31,6 @@ triangles) is the correctness reference the BVH paths are tested against.
 
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple
 
 import jax
@@ -242,13 +241,36 @@ def build_bvh(vertices: np.ndarray, faces: np.ndarray) -> MeshBVH:
         )
 
 
-@functools.lru_cache(maxsize=8)
+# Process-wide geometry-build memo: host-side BVH/TLAS builds keyed by
+# every parameter that shapes the result — (kind, leaf_size) for BLAS
+# builds, (k_count, tlas_leaf_size) for TLAS topologies — so the test
+# suite and the bucket-ladder recompiles never rebuild a hierarchy they
+# have already built this process. An explicit dict (not lru_cache) so
+# tests can reset it: tests/conftest.py wires ``reset_geometry_cache``
+# into the autouse fixture alongside ``compaction.reset_compile_tracking``.
+_geometry_cache: dict[tuple, object] = {}
+
+
+def reset_geometry_cache() -> None:
+    """Forget memoized host-side BVH/TLAS builds (test isolation only:
+    the builds are pure, so resetting merely makes the next call rebuild
+    — per-test build-count assertions stay independent of earlier
+    tests)."""
+    _geometry_cache.clear()
+
+
 def cached_mesh_bvh(kind: str) -> MeshBVH:
-    if kind == "box":
-        return build_bvh(*make_box())
-    if kind == "icosphere":
-        return build_bvh(*make_icosphere(2))
-    raise ValueError(f"Unknown mesh kind: {kind!r}")
+    key = ("bvh", kind, LEAF_SIZE)
+    bvh = _geometry_cache.get(key)
+    if bvh is None:
+        if kind == "box":
+            bvh = build_bvh(*make_box())
+        elif kind == "icosphere":
+            bvh = build_bvh(*make_icosphere(2))
+        else:
+            raise ValueError(f"Unknown mesh kind: {kind!r}")
+        _geometry_cache[key] = bvh
+    return bvh
 
 
 # ---------------------------------------------------------------------------
@@ -625,6 +647,158 @@ def rotation_y(angle):
         ],
         axis=-2,
     )
+
+
+# ---------------------------------------------------------------------------
+# Two-level hierarchy: TLAS over instances (ISSUE 10)
+#
+# The flat in-kernel instance sweep visits every instance's world AABB per
+# ray block; the TLAS replaces that with a threaded skip-link walk over a
+# small tree of instance groups, so a block only descends into the
+# subtrees its packet actually overlaps. Split of responsibilities under
+# jit: instance transforms are TRACED (physics animation), so the tree
+# TOPOLOGY must be frame-invariant — it is a median split over instance
+# SLOTS (static numpy, memoized per (k_count, leaf_size)), while the
+# slot -> instance assignment (a Morton sort of world-AABB centers) and
+# the per-node bounds (segment unions over the sorted AABBs) are cheap
+# XLA arithmetic recomputed per frame. A Morton-sorted median split is a
+# spatial-median build — the SAH sweep of a classic host build needs
+# data-dependent topology, which a jitted per-frame build cannot have.
+
+
+class TlasTopology(NamedTuple):
+    """Static (numpy) threaded TLAS topology over ``k_count`` instance
+    slots: DFS preorder, skip links, leaves covering contiguous slot
+    ranges. ``member`` is the [M, K] node->slot incidence mask the
+    per-frame bounds reduction uses."""
+
+    skip: np.ndarray  # [M] int32 — next subtree root (M = done)
+    first: np.ndarray  # [M] int32 — leaf slot start (0 for inner)
+    count: np.ndarray  # [M] int32 — leaf slot count (0 for inner)
+    member: np.ndarray  # [M, K] bool — node covers instance slot
+    depth: int  # tree depth (root = 1)
+
+
+def build_tlas_topology(k_count: int, leaf_size: int) -> TlasTopology:
+    """Median split over instance slot ranges, threaded like build_bvh."""
+    if k_count < 1:
+        raise ValueError("TLAS needs at least one instance")
+    leaf_size = max(1, leaf_size)
+    nodes: list[dict] = []
+
+    def emit(lo: int, hi: int, level: int) -> tuple[int, int]:
+        node_index = len(nodes)
+        nodes.append({"lo": lo, "hi": hi, "leaf": hi - lo <= leaf_size})
+        if nodes[node_index]["leaf"]:
+            return node_index, level
+        mid = (lo + hi) // 2
+        _, left_depth = emit(lo, mid, level + 1)
+        _, right_depth = emit(mid, hi, level + 1)
+        return node_index, max(left_depth, right_depth)
+
+    _, depth = emit(0, k_count, 1)
+    m = len(nodes)
+    # DFS preorder by construction; a node's subtree is the consecutive
+    # run of nodes whose slot range nests inside its own.
+    skip = np.zeros(m, np.int32)
+    first = np.zeros(m, np.int32)
+    count = np.zeros(m, np.int32)
+    member = np.zeros((m, k_count), bool)
+    for i, node in enumerate(nodes):
+        j = i + 1
+        while j < m and nodes[j]["lo"] >= node["lo"] and nodes[j]["hi"] <= node["hi"]:
+            j += 1
+        skip[i] = j
+        member[i, node["lo"]:node["hi"]] = True
+        if node["leaf"]:
+            first[i] = node["lo"]
+            count[i] = node["hi"] - node["lo"]
+    return TlasTopology(
+        skip=skip, first=first, count=count, member=member, depth=depth
+    )
+
+
+def tlas_build_counter(registry=None):
+    from tpu_render_cluster.obs import get_registry
+
+    registry = registry if registry is not None else get_registry()
+    return registry.counter(
+        "render_tlas_builds_total",
+        "Host-side TLAS topology builds (cache misses of the process-wide "
+        "geometry memo — bounded by distinct (instance count, leaf size) "
+        "pairs, never frames)",
+    )
+
+
+def tlas_depth_gauge(registry=None):
+    from tpu_render_cluster.obs import get_registry
+
+    registry = registry if registry is not None else get_registry()
+    return registry.gauge(
+        "render_tlas_depth",
+        "Depth of the most recently built TLAS topology (root = 1)",
+    )
+
+
+def cached_tlas_topology(k_count: int, leaf_size: int) -> TlasTopology:
+    """Memoized ``build_tlas_topology`` (see ``_geometry_cache``)."""
+    key = ("tlas", k_count, leaf_size)
+    topology = _geometry_cache.get(key)
+    if topology is None:
+        topology = build_tlas_topology(k_count, leaf_size)
+        _geometry_cache[key] = topology
+        tlas_build_counter().inc()
+        tlas_depth_gauge().set(topology.depth)
+    return topology
+
+
+def tlas_node_bounds(topology: TlasTopology, lo_sorted, hi_sorted):
+    """Per-frame TLAS node AABBs from SORTED instance world AABBs.
+
+    ``lo_sorted``/``hi_sorted`` are [K, 3] in slot order (the Morton
+    permutation applied). Returns ([M, 3], [M, 3]) node unions — pure
+    masked min/max off the static incidence mask, so it jits/vmaps.
+    """
+    mask = jnp.asarray(topology.member)[:, :, None]  # [M, K, 1]
+    node_lo = jnp.min(jnp.where(mask, lo_sorted[None], INF), axis=1)
+    node_hi = jnp.max(jnp.where(mask, hi_sorted[None], -INF), axis=1)
+    return node_lo, node_hi
+
+
+def morton_dilate5(v):
+    """Spread the low 5 bits of a uint32 to every 3rd position (Morton
+    dilation) — THE shared definition for the coherence-key quantization
+    (instance slot assignment here, the kernels' fused sort-key epilogue
+    and its XLA twin in pallas_kernels)."""
+    v = (v | (v << 8)) & jnp.uint32(0x0300F)
+    v = (v | (v << 4)) & jnp.uint32(0x030C3)
+    v = (v | (v << 2)) & jnp.uint32(0x09249)
+    return v
+
+
+def instance_morton_order(lo_w, hi_w):
+    """Morton order of instance world-AABB centers ([K] int32 permutation).
+
+    The TLAS slot assignment: spatially-adjacent instances land in the
+    same leaves, so subtree unions stay tight. Ray-INDEPENDENT by design
+    (unlike the flat path's near-first anchor sort): a region launch and
+    the whole-frame launch derive identical instance orders, keeping the
+    tiled-equals-untiled contracts exact. Stable argsort, so equal codes
+    (e.g. the degenerate all-overlapping field) keep their original
+    relative order.
+    """
+    centers = 0.5 * (lo_w + hi_w)  # [K, 3]
+    lo = jnp.min(centers, axis=0)
+    span = jnp.maximum(jnp.max(centers, axis=0) - lo, 1e-6)
+    cell = jnp.clip(
+        (centers - lo) / span * 32.0, 0.0, 31.0
+    ).astype(jnp.uint32)
+    code = (
+        morton_dilate5(cell[:, 0])
+        | (morton_dilate5(cell[:, 1]) << 1)
+        | (morton_dilate5(cell[:, 2]) << 2)
+    )
+    return jnp.argsort(code).astype(jnp.int32)
 
 
 class MeshSet(NamedTuple):

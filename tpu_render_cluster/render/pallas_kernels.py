@@ -124,6 +124,196 @@ def wavefront_eligible(mesh) -> bool:
 # differently by two callers.)
 
 
+def tlas_enabled() -> bool:
+    """Whether the mesh kernels traverse a two-level TLAS/BLAS hierarchy
+    (default) or the flat per-instance sweep (``TRC_TLAS=0`` — the A/B
+    baseline ``bench.py --bvh-compare`` measures against, and the on-chip
+    triage kill switch).
+
+    Read at *trace* time like ``TRC_PALLAS``: jitted renderers bake the
+    decision, and the drivers additionally thread it as a static jit
+    argument so both kernel variants can coexist in one process (the
+    interleaved A/B bench relies on that).
+    """
+    value = os.environ.get("TRC_TLAS")
+    if value is None:
+        return True
+    return value not in ("0", "false", "off", "no")
+
+
+def tlas_leaf_size() -> int:
+    """Instances per TLAS leaf (``TRC_TLAS_LEAF``, default 4, clamped to
+    [1, 16]). Part of the compiled kernel's identity — a distinct leaf
+    size is a distinct trace."""
+    try:
+        leaf = int(os.environ.get("TRC_TLAS_LEAF", "4"))
+    except ValueError:
+        leaf = 4
+    return max(1, min(leaf, 16))
+
+
+def tlas_block_r() -> int:
+    """Ray-block width of the TLAS kernel variants (``TRC_TLAS_BLOCK``,
+    default 256).
+
+    Packet pruning only exists at block granularity — a subtree is
+    skipped when NO lane in the block wants it — so the TLAS walk wants
+    much NARROWER packets than the flat sweep's ``BVH_BLOCK_R`` (1024,
+    tuned for sweep-style launches where the block size only amortizes
+    launch overhead). Measured on the CPU proxy (03-family, 48
+    instances): 1024-lane packets union over most of the instance field
+    and prune nothing (0.95x vs flat), 512 -> ~1.7x, 256 -> ~2x,
+    128 -> ~2.3x but with more per-block overhead headroom on chip —
+    256 is the default; re-tune on chip via the env knob. Snapped to a
+    power of two in [128, BVH_BLOCK_R] so it always divides the pool
+    width / bucket quanta the drivers round to, and read at trace time
+    like the other TLAS knobs (part of each compiled kernel's shape).
+    """
+    try:
+        raw = int(os.environ.get("TRC_TLAS_BLOCK", "256"))
+    except ValueError:
+        raw = 256
+    block = 128
+    while block * 2 <= min(raw, BVH_BLOCK_R):
+        block *= 2
+    return block
+
+
+def use_tlas_for(k_count: int, use_tlas: bool | None = None) -> bool:
+    """Resolve the TLAS decision for a ``k_count``-instance field.
+
+    ``None`` defers to the env tier. Fields that fit in one TLAS leaf
+    degenerate to the flat sweep plus a root test — auto-disabled.
+    """
+    flag = tlas_enabled() if use_tlas is None else bool(use_tlas)
+    return flag and k_count > tlas_leaf_size()
+
+
+# ---------------------------------------------------------------------------
+# Fused coherence sort key (ISSUE 10): the per-bounce re-sort key is
+# computed in the mesh bounce kernels' EPILOGUE from the post-bounce ray
+# state — one extra [1, BR] int32 output row — so the TLAS drivers'
+# re-sort is a single argsort over a precomputed column instead of a
+# separate XLA pass (candidate broadphase + quantization + dilation)
+# over the full ray state. Layout (LSB -> MSB): direction octant [0:3),
+# 5-bit/axis Morton cell of origin+direction [3:18), first-overlap
+# candidate instance [18:24) (6 bits, clamped — packets that want the
+# SAME instance first walk straight to its leaf and seed tight best-t),
+# frame id [24:29) (pool kernels only; 0 elsewhere), dead flag bit 29.
+# Always < 2^30, so the uint32 bit pattern bitcasts to a POSITIVE int32
+# and a plain ascending argsort orders it exactly like the uint32 would.
+
+KEY_DEAD_BIT = 29
+
+
+def coherence_key_u32(
+    px, py, pz, dx, dy, dz, dead, fid, candidate,
+    lox, loy, loz, ivx, ivy, ivz,
+):
+    """The ONE key derivation, componentwise so the kernel epilogue
+    ([1, BR] rows, SMEM scalar bounds) and the XLA twin ([R] columns,
+    traced scalar bounds) provably compute bit-identical keys
+    (tests/test_tlas.py pins it). ``p*`` = origin+direction components,
+    ``dead`` bool, ``fid``/``candidate`` int32; ``lo*``/``iv*`` the
+    quantization window scalars from ``mesh_key_bounds``. The candidate
+    INPUT is derived per site with shared semantics (nearest-entry
+    overlapped instance): the kernel epilogue walks the TLAS, the XLA
+    twin runs ``instance_entry_candidates``."""
+    from tpu_render_cluster.render.mesh import morton_dilate5
+
+    def cell(p, lo, iv):
+        quantized = jnp.clip((p - lo) * iv * 32.0, 0.0, 31.0)
+        return quantized.astype(jnp.int32).astype(jnp.uint32)
+
+    morton = (
+        morton_dilate5(cell(px, lox, ivx))
+        | (morton_dilate5(cell(py, loy, ivy)) << jnp.uint32(1))
+        | (morton_dilate5(cell(pz, loz, ivz)) << jnp.uint32(2))
+    )
+    one = jnp.uint32(1)
+    zero = jnp.uint32(0)
+    octant = (
+        jnp.where(dx > 0, one, zero)
+        | (jnp.where(dy > 0, one, zero) << jnp.uint32(1))
+        | (jnp.where(dz > 0, one, zero) << jnp.uint32(2))
+    )
+    cand_bits = jnp.minimum(candidate.astype(jnp.uint32), jnp.uint32(63))
+    fid_bits = jnp.minimum(fid.astype(jnp.uint32), jnp.uint32(31))
+    dead_bit = jnp.where(dead, one, zero) << jnp.uint32(KEY_DEAD_BIT)
+    return (
+        octant
+        | (morton << jnp.uint32(3))
+        | (cand_bits << jnp.uint32(18))
+        | (fid_bits << jnp.uint32(24))
+        | dead_bit
+    )
+
+
+def mesh_key_bounds(lo_w, hi_w):
+    """Quantization window for the coherence key: the instance field's
+    world AABB union, padded one unit (floor-bounce origins sit ON the
+    field's boundary; escaped rays clamp to edge cells harmlessly).
+    Returns ([3] lo, [3] 1/span) — frame-dependent only, never
+    ray-dependent, so region and whole-frame launches key identically.
+    """
+    lo = jnp.min(lo_w, axis=0) - 1.0
+    hi = jnp.max(hi_w, axis=0) + 1.0
+    return lo, 1.0 / jnp.maximum(hi - lo, 1e-6)
+
+
+def mesh_sort_keys(
+    origins, directions, alive, key_lo, key_inv, fid=None, candidate=None,
+):
+    """XLA twin of the kernel epilogue's key ([R] int32): the INITIAL
+    keys of a wavefront/deep-path/pool launch, before any bounce kernel
+    has run to produce the fused column. ``candidate`` (optional [R]
+    int32) is the nearest-entry overlapped instance from
+    ``instance_entry_candidates``; None packs a neutral 0 (grouping by
+    Morton/octant only)."""
+    point = origins + directions
+    if fid is None:
+        fid = jnp.zeros(origins.shape[0], jnp.int32)
+    if candidate is None:
+        candidate = jnp.zeros(origins.shape[0], jnp.int32)
+    key = coherence_key_u32(
+        point[:, 0], point[:, 1], point[:, 2],
+        directions[:, 0], directions[:, 1], directions[:, 2],
+        ~alive, fid, candidate,
+        key_lo[0], key_lo[1], key_lo[2],
+        key_inv[0], key_inv[1], key_inv[2],
+    )
+    return key.astype(jnp.int32)
+
+
+def initial_mesh_sort_keys(mesh, origins, directions, alive):
+    """Bounce-0 coherence keys for a TLAS launch, derived from the
+    MeshSet: instance world AABBs -> quantization window + nearest-entry
+    candidates -> ``mesh_sort_keys``. THE one site both the deep
+    per-bounce path (integrator.trace_paths) and the wavefront driver
+    (compaction._initial_mesh_keys) key bounce 0 through, so the two
+    tiers' initial sorts cannot drift from each other or from the kernel
+    epilogue's fused column (bit-identical on live lanes, pinned by
+    tests/test_tlas.py)."""
+    from tpu_render_cluster.render.mesh import instance_morton_order
+
+    table = _instance_table(
+        mesh.instances.rotation, mesh.instances.translation,
+        mesh.instances.scale, mesh.bvh.bounds_min, mesh.bvh.bounds_max,
+    )
+    lo_w, hi_w = table[:, 13:16], table[:, 16:19]
+    # Candidates are SLOT labels (the Morton-sorted order the kernels'
+    # instance table uses), not original-index labels — the epilogue's
+    # entry walk reports slots, and slot-adjacent == spatially-adjacent
+    # is the grouping the packet cull is tuned for.
+    order = instance_morton_order(lo_w, hi_w)
+    lo_s, hi_s = lo_w[order], hi_w[order]
+    key_lo, key_inv = mesh_key_bounds(lo_s, hi_s)
+    return mesh_sort_keys(
+        origins, directions, alive, key_lo, key_inv,
+        candidate=instance_entry_candidates(origins, directions, lo_s, hi_s),
+    )
+
+
 def _nearest_hit_kernel(o_ref, d_ref, c_ref, r2_ref, csq_ref, t_ref, idx_ref):
     """One ray block vs all spheres; writes min-t and argmin index."""
     o = o_ref[:, :]  # [3, BR]
@@ -982,7 +1172,7 @@ def _bvh_kernel_factory(n_nodes: int, leaf_size: int):
     return kernel
 
 
-def _pad_rays_to_miss(origins, directions):
+def _pad_rays_to_miss(origins, directions, block: int = BVH_BLOCK_R):
     """Block-pad rays so pad lanes provably MISS the tree.
 
     A zero pad direction would turn the slab test degenerate (inv ~ 1e12
@@ -991,7 +1181,7 @@ def _pad_rays_to_miss(origins, directions):
     unit direction misses the root.
     """
     rays = origins.shape[0]
-    padded_rays = -(-rays // BVH_BLOCK_R) * BVH_BLOCK_R
+    padded_rays = -(-rays // block) * block
     ray_pad = padded_rays - rays
     o_t = jnp.pad(origins, ((0, ray_pad), (0, 0)), constant_values=1e7).T
     d_t = jnp.pad(directions, ((0, ray_pad), (0, 0))).T
@@ -1704,7 +1894,8 @@ def _bvh_anyhit_instanced(
 def _mesh_trace_kernel_factory(
     max_bounces: int, n_padded: int, n_nodes: int, leaf_size: int,
     k_count: int, state_io: bool = False, pool_io: bool = False,
-    k_per_frame: int = 0,
+    k_per_frame: int = 0, use_tlas: bool = False, tlas_nodes: int = 0,
+    tlas_per_frame: int = 0,
 ):
     """Mesh path-trace kernel. Three shapes share one bounce_step:
 
@@ -1731,6 +1922,12 @@ def _mesh_trace_kernel_factory(
     contract_first = (((0,), (0,)), ((), ()))
 
     def kernel(*refs):
+        # Fixed-prefix unpacking, then the optional TLAS block (5 SMEM
+        # refs, use_tlas only), the key-bounds scalars + fused sort-key
+        # output (streamed-state TLAS kernels only — flat kernels keep
+        # today's exact signature so the A/B baseline is untouched), and
+        # finally the state outputs.
+        refs = list(refs)
         if pool_io:
             (live_ref, o_ref, d_ref, thr_ref, alive_ref, lane_ref,
              seed_row_ref, bounce_row_ref, fid_row_ref,
@@ -1738,22 +1935,97 @@ def _mesh_trace_kernel_factory(
              c_ref, r2_ref, csq_ref, rad_ref, albedo_ref, emission_ref,
              dcsun_ref, sfid_ref, params_ref, sunsm_ref, inst_ref,
              v0_ref, e1_ref, e2_ref, nrm_ref, bmin_ref, bmax_ref,
-             skip_ref, first_ref, count_ref,
-             out_ref, o_out_ref, d_out_ref, thr_out_ref,
-             alive_out_ref) = refs
+             skip_ref, first_ref, count_ref) = refs[:31]
+            rest = refs[31:]
         elif state_io:
             (seed_ref, bounce_ref, live_ref, o_ref, d_ref, thr_ref,
              alive_ref, lane_ref,
              c_ref, r2_ref, csq_ref, rad_ref, albedo_ref, emission_ref,
              dcsun_ref, params_ref, sunsm_ref, inst_ref, v0_ref, e1_ref,
              e2_ref, nrm_ref, bmin_ref, bmax_ref, skip_ref, first_ref,
-             count_ref, out_ref, o_out_ref, d_out_ref, thr_out_ref,
-             alive_out_ref) = refs
+             count_ref) = refs[:27]
+            rest = refs[27:]
         else:
             (seed_ref, o_ref, d_ref, c_ref, r2_ref, csq_ref, rad_ref,
              albedo_ref, emission_ref, dcsun_ref, params_ref, sunsm_ref,
              inst_ref, v0_ref, e1_ref, e2_ref, nrm_ref, bmin_ref,
-             bmax_ref, skip_ref, first_ref, count_ref, out_ref) = refs
+             bmax_ref, skip_ref, first_ref, count_ref) = refs[:22]
+            rest = refs[22:]
+        if use_tlas:
+            (tbmin_ref, tbmax_ref, tskip_ref, tfirst_ref,
+             tcount_ref) = rest[:5]
+            rest = rest[5:]
+        if (state_io or pool_io) and use_tlas:
+            keysm_ref = rest[0]
+            (out_ref, o_out_ref, d_out_ref, thr_out_ref, alive_out_ref,
+             key_out_ref) = rest[1:]
+        elif state_io or pool_io:
+            (out_ref, o_out_ref, d_out_ref, thr_out_ref,
+             alive_out_ref) = rest
+        else:
+            (out_ref,) = rest
+        if use_tlas:
+            # THE threaded skip-link walk over TLAS node slabs, shared
+            # by the nearest, any-hit, and key-epilogue entry walks
+            # (same rule as the BLAS walk_step: a traversal/epsilon fix
+            # lands once). Call sites differ only in the ray components,
+            # the per-lane ``limit_of(carry)`` driving the packet test,
+            # and the ``leaf_body`` fori callback over a leaf's slot
+            # range; ``carry`` is a tuple.
+            def tlas_walk(
+                node0, node_end, ox, oy, oz, ix, iy, iz,
+                limit_of, leaf_body, carry,
+            ):
+                def cond(walk):
+                    return walk[0] < node_end
+
+                def body(walk):
+                    node = walk[0]
+                    carry = tuple(walk[1:])
+                    limit = limit_of(carry)
+                    lox = (tbmin_ref[node, 0] - ox) * ix
+                    hix = (tbmax_ref[node, 0] - ox) * ix
+                    loy = (tbmin_ref[node, 1] - oy) * iy
+                    hiy = (tbmax_ref[node, 1] - oy) * iy
+                    loz = (tbmin_ref[node, 2] - oz) * iz
+                    hiz = (tbmax_ref[node, 2] - oz) * iz
+                    tnear = jnp.maximum(
+                        jnp.maximum(
+                            jnp.minimum(lox, hix), jnp.minimum(loy, hiy)
+                        ),
+                        jnp.minimum(loz, hiz),
+                    )
+                    tfar = jnp.minimum(
+                        jnp.minimum(
+                            jnp.maximum(lox, hix), jnp.maximum(loy, hiy)
+                        ),
+                        jnp.maximum(loz, hiz),
+                    )
+                    packet_hit = (
+                        tfar >= jnp.maximum(tnear, 0.0)
+                    ) & (tnear < limit)
+                    hit_any = jnp.any(packet_hit)
+                    cnt = tcount_ref[node]
+                    is_leaf = cnt > 0
+                    start = tfirst_ref[node]
+                    next_node = jnp.where(
+                        hit_any,
+                        jnp.where(is_leaf, tskip_ref[node], node + 1),
+                        tskip_ref[node],
+                    )
+                    carry = jax.lax.cond(
+                        is_leaf & hit_any,
+                        lambda: jax.lax.fori_loop(
+                            start, start + cnt, leaf_body, carry
+                        ),
+                        lambda: carry,
+                    )
+                    return (next_node, *carry)
+
+                return tuple(
+                    jax.lax.while_loop(cond, body, (node0, *carry))
+                )[1:]
+
         o = o_ref[:, :]  # [3, BR]
         d = d_ref[:, :]
         c = c_ref[:, :]
@@ -2045,11 +2317,51 @@ def _mesh_trace_kernel_factory(
                 jnp.zeros((1, block), jnp.float32),
                 jnp.zeros((1, block), jnp.float32),
             )
-            best_t, bnx, bny, bnz, bar, bag, bab = jax.lax.fori_loop(
-                k_sweep_lo if pool_io else 0,
-                k_sweep_hi if pool_io else k_count,
-                per_instance, init,
-            )
+            if use_tlas:
+                # Two-level walk: threaded skip-link TLAS over instance
+                # groups; a leaf hit runs the EXISTING per-instance BLAS
+                # walk over its slot range. A block whose packet misses a
+                # subtree's union AABB (or whose per-lane best-t already
+                # beats its entry) jumps the whole subtree — the flat
+                # K-cull sweep this replaces paid every instance every
+                # block. Pool mode walks one frame's node window per
+                # fori step; lanes of OTHER frames in a mixed block are
+                # barred from driving nodes (limit -INF, like dead
+                # lanes) exactly as they are barred from the instances.
+                def tlas_walk_nearest(node0, node_end, frame_match, carry):
+                    limit_of = (
+                        (lambda c: jnp.where(frame_match, c[0], -INF))
+                        if frame_match is not None
+                        else (lambda c: c[0])
+                    )
+                    return tlas_walk(
+                        node0, node_end, wox, woy, woz, wix, wiy, wiz,
+                        limit_of, per_instance, carry,
+                    )
+
+                if pool_io:
+                    def per_frame(f, carry):
+                        node0 = f * tlas_per_frame
+                        return tlas_walk_nearest(
+                            node0, node0 + tlas_per_frame,
+                            fid_row == f.astype(jnp.float32), carry,
+                        )
+
+                    walked = jax.lax.fori_loop(
+                        fid_lo_ref[0, 0], fid_hi_ref[0, 0] + 1,
+                        per_frame, init,
+                    )
+                else:
+                    walked = tlas_walk_nearest(
+                        jnp.int32(0), jnp.int32(tlas_nodes), None, init
+                    )
+                best_t, bnx, bny, bnz, bar, bag, bab = walked
+            else:
+                best_t, bnx, bny, bnz, bar, bag, bab = jax.lax.fori_loop(
+                    k_sweep_lo if pool_io else 0,
+                    k_sweep_hi if pool_io else k_count,
+                    per_instance, init,
+                )
             # Flip toward the incoming ray (matches mesh.intersect_instances).
             facing = (
                 bnx * d[0:1, :] + bny * d[1:2, :] + bnz * d[2:3, :]
@@ -2153,6 +2465,44 @@ def _mesh_trace_kernel_factory(
                 )
                 return walked_occluded
 
+            if use_tlas:
+                # Same two-level shape as the nearest walk, with the
+                # any-hit limit convention: lanes whose result cannot
+                # matter (pre-occluded, other-frame in pool mode) carry
+                # a -INF limit and never drive a node's packet test.
+                def tlas_walk_occluded(node0, node_end, match_f, occ0):
+                    def limit_of(c):
+                        blocked = (
+                            jnp.maximum(c[0], 1.0 - match_f)
+                            if match_f is not None else c[0]
+                        )
+                        return jnp.where(blocked > 0.0, -INF, INF)
+
+                    return tlas_walk(
+                        node0, node_end, wox, woy, woz, wix, wiy, wiz,
+                        limit_of,
+                        lambda k, c: (per_instance(k, c[0]),),
+                        (occ0,),
+                    )[0]
+
+                if pool_io:
+                    def per_frame(f, occluded):
+                        node0 = f * tlas_per_frame
+                        return tlas_walk_occluded(
+                            node0, node0 + tlas_per_frame,
+                            (fid_row == f.astype(jnp.float32)).astype(
+                                jnp.float32
+                            ),
+                            occluded,
+                        )
+
+                    return jax.lax.fori_loop(
+                        fid_lo_ref[0, 0], fid_hi_ref[0, 0] + 1,
+                        per_frame, occluded0,
+                    )
+                return tlas_walk_occluded(
+                    jnp.int32(0), jnp.int32(tlas_nodes), None, occluded0
+                )
             return jax.lax.fori_loop(
                 k_sweep_lo if pool_io else 0,
                 k_sweep_hi if pool_io else k_count,
@@ -2373,6 +2723,126 @@ def _mesh_trace_kernel_factory(
             d_out_ref[:, :] = d
             thr_out_ref[:, :] = throughput
             alive_out_ref[:, :] = alive
+            if use_tlas:
+                # Fused coherence-key epilogue: the NEXT bounce's sort
+                # key, derived from the post-bounce state while it is
+                # still VMEM-resident (the separate XLA broadphase pass
+                # this replaces re-read the full ray state from HBM).
+                # The candidate component — the NEW ray's nearest-entry
+                # overlapped instance, the strongest grouping signal for
+                # floor-bounce packets — comes from an AABB-only TLAS
+                # walk (node slabs + leaf instance-AABB entries, no BLAS
+                # descent). Gated on the same live-count branch as the
+                # bounce: skipped all-dead tail blocks key their
+                # passthrough state with the sentinel candidate — all
+                # dead, so the dead bit keeps them parked at the tail.
+                eox, eoy, eoz = o[0:1, :], o[1:2, :], o[2:3, :]
+                edx, edy, edz = d[0:1, :], d[1:2, :], d[2:3, :]
+                eix, eiy, eiz = winv(edx), winv(edy), winv(edz)
+                live_lane = alive > 0.5
+
+                def entry_leaf(slot_offset):
+                    def leaf_step(k, carry):
+                        best_e, best_s = carry
+                        lox = (inst_ref[k, 13] - eox) * eix
+                        hix = (inst_ref[k, 16] - eox) * eix
+                        loy = (inst_ref[k, 14] - eoy) * eiy
+                        hiy = (inst_ref[k, 17] - eoy) * eiy
+                        loz = (inst_ref[k, 15] - eoz) * eiz
+                        hiz = (inst_ref[k, 18] - eoz) * eiz
+                        near = jnp.maximum(
+                            jnp.maximum(
+                                jnp.minimum(lox, hix), jnp.minimum(loy, hiy)
+                            ),
+                            jnp.minimum(loz, hiz),
+                        )
+                        far = jnp.minimum(
+                            jnp.minimum(
+                                jnp.maximum(lox, hix), jnp.maximum(loy, hiy)
+                            ),
+                            jnp.maximum(loz, hiz),
+                        )
+                        overlap = far >= jnp.maximum(near, 0.0)
+                        if pool_io:
+                            overlap = overlap & (fid_row == inst_ref[k, 22])
+                        entry = jnp.where(
+                            overlap, jnp.maximum(near, 0.0), INF
+                        )
+                        improved = entry < best_e
+                        best_e = jnp.where(improved, entry, best_e)
+                        best_s = jnp.where(
+                            improved,
+                            (k - slot_offset).astype(jnp.float32),
+                            best_s,
+                        )
+                        return best_e, best_s
+
+                    return leaf_step
+
+                def entry_walk(node0, node_end, slot_offset, match, carry):
+                    drive = (
+                        live_lane if match is None else live_lane & match
+                    )
+                    return tlas_walk(
+                        node0, node_end, eox, eoy, eoz, eix, eiy, eiz,
+                        lambda c: jnp.where(drive, c[0], -INF),
+                        entry_leaf(slot_offset), carry,
+                    )
+
+                sentinel = jnp.float32(k_per_frame if pool_io else k_count)
+                entry_init = (
+                    jnp.full((1, block), INF, jnp.float32),
+                    jnp.full((1, block), sentinel, jnp.float32),
+                )
+
+                def run_entry_walk():
+                    if pool_io:
+                        def per_frame_entry(f, carry):
+                            node0 = f * tlas_per_frame
+                            return entry_walk(
+                                node0, node0 + tlas_per_frame,
+                                f * k_per_frame,
+                                fid_row == f.astype(jnp.float32), carry,
+                            )
+
+                        return jax.lax.fori_loop(
+                            fid_lo_ref[0, 0], fid_hi_ref[0, 0] + 1,
+                            per_frame_entry, entry_init,
+                        )
+                    return entry_walk(
+                        jnp.int32(0), jnp.int32(tlas_nodes), jnp.int32(0),
+                        None, entry_init,
+                    )
+
+                # Final-bounce launches (state_io: the bounce index is a
+                # uniform scalar) never have their key consumed — the
+                # driver's loop ends — so skip the entry walk there and
+                # key with the sentinel candidate. Pool mode cannot gate:
+                # lanes sit at MIXED depths and the next pool iteration
+                # always sorts by this column.
+                want_candidates = block_start < live_ref[0, 0]
+                if not pool_io:
+                    want_candidates = want_candidates & (
+                        bounce_ref[0, 0] < max_bounces - 1
+                    )
+                _, best_slot = jax.lax.cond(
+                    want_candidates,
+                    run_entry_walk,
+                    lambda: entry_init,
+                )
+                key = coherence_key_u32(
+                    o[0:1, :] + d[0:1, :],
+                    o[1:2, :] + d[1:2, :],
+                    o[2:3, :] + d[2:3, :],
+                    d[0:1, :], d[1:2, :], d[2:3, :],
+                    alive <= 0.5,
+                    (fid_row.astype(jnp.int32) if pool_io
+                     else jnp.zeros((1, block), jnp.int32)),
+                    best_slot.astype(jnp.int32),
+                    keysm_ref[0], keysm_ref[1], keysm_ref[2],
+                    keysm_ref[3], keysm_ref[4], keysm_ref[5],
+                )
+                key_out_ref[:, :] = key.astype(jnp.int32)
         else:
             _, _, _, radiance, _ = jax.lax.fori_loop(
                 0, max_bounces, bounce_step,
@@ -2383,21 +2853,29 @@ def _mesh_trace_kernel_factory(
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("max_bounces", "interpret"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_bounces", "interpret", "use_tlas", "tlas_leaf"),
+)
 def _trace_fused_mesh(
     origins, directions, centers, radii, albedo, emission,
     sun_direction, sun_color, sky_horizon, sky_zenith,
     plane_albedo_a, plane_albedo_b, seed,
     rotation, translation, scale, inst_albedo,
     v0, e1, e2, normal, bounds_min, bounds_max, skip, first, count,
-    *, max_bounces: int, interpret: bool,
+    *, max_bounces: int, interpret: bool, use_tlas: bool = False,
+    tlas_leaf: int = 4,
 ):
     from tpu_render_cluster.render.mesh import LEAF_SIZE
 
     # Pad lanes must provably MISS (far origin, perpendicular unit dir):
     # zero-padded directions would degenerate the slab tests and strip the
-    # packet culling from the final block (see _pad_rays_to_miss).
-    o_t, d_t, rays, padded_rays = _pad_rays_to_miss(origins, directions)
+    # packet culling from the final block (see _pad_rays_to_miss). The
+    # TLAS variant blocks rays at its own (narrower) packet width.
+    block = tlas_block_r() if use_tlas else BVH_BLOCK_R
+    o_t, d_t, rays, padded_rays = _pad_rays_to_miss(
+        origins, directions, block
+    )
 
     n = centers.shape[0]
     padded_n = -(-n // _SUBLANE) * _SUBLANE
@@ -2420,24 +2898,71 @@ def _trace_fused_mesh(
     params = params.at[5].set(plane_albedo_b)
     seed_arr = jnp.asarray(seed, jnp.int32).reshape(1, 1)
 
-    inst_table = _instance_table(
-        rotation, translation, scale, bounds_min, bounds_max, inst_albedo
-    )
     n_nodes = skip.shape[0]
     k_count = rotation.shape[0]
+    if use_tlas:
+        # Slot-assign instances by Morton order of their world-AABB
+        # centers (ray-independent, so every launch of this frame — any
+        # region, any tier — derives the same table order) and build the
+        # per-frame TLAS node unions over the sorted AABBs. Topology is
+        # static/memoized; bounds are cheap traced arithmetic.
+        from tpu_render_cluster.render.mesh import (
+            cached_tlas_topology,
+            instance_morton_order,
+            tlas_node_bounds,
+        )
 
-    grid = (padded_rays // BVH_BLOCK_R,)
+        # ONE table build, slot-ordered by a row gather (every table
+        # column is a per-instance row-wise function, so gathering rows
+        # IS rebuilding on gathered inputs — exactly, same f32 ops).
+        table = _instance_table(
+            rotation, translation, scale, bounds_min, bounds_max,
+            inst_albedo,
+        )
+        lo_w, hi_w = table[:, 13:16], table[:, 16:19]
+        order = instance_morton_order(lo_w, hi_w)
+        inst_table = table[order]
+        topology = cached_tlas_topology(k_count, tlas_leaf)
+        node_lo, node_hi = tlas_node_bounds(
+            topology, lo_w[order], hi_w[order]
+        )
+        tlas_operands = (
+            node_lo, node_hi, jnp.asarray(topology.skip),
+            jnp.asarray(topology.first), jnp.asarray(topology.count),
+        )
+        tlas_nodes = int(topology.skip.shape[0])
+    else:
+        inst_table = _instance_table(
+            rotation, translation, scale, bounds_min, bounds_max,
+            inst_albedo,
+        )
+        tlas_operands = ()
+        tlas_nodes = 0
+
+    grid = (padded_rays // block,)
     whole = lambda i: (0, 0)  # noqa: E731
     flat = lambda i: (0,)  # noqa: E731
+    tlas_specs = (
+        [
+            pl.BlockSpec((tlas_nodes, 3), whole, memory_space=pltpu.SMEM),
+            pl.BlockSpec((tlas_nodes, 3), whole, memory_space=pltpu.SMEM),
+            pl.BlockSpec((tlas_nodes,), flat, memory_space=pltpu.SMEM),
+            pl.BlockSpec((tlas_nodes,), flat, memory_space=pltpu.SMEM),
+            pl.BlockSpec((tlas_nodes,), flat, memory_space=pltpu.SMEM),
+        ]
+        if use_tlas
+        else []
+    )
     out = pl.pallas_call(
         _mesh_trace_kernel_factory(
-            max_bounces, padded_n, n_nodes, LEAF_SIZE, k_count
+            max_bounces, padded_n, n_nodes, LEAF_SIZE, k_count,
+            use_tlas=use_tlas, tlas_nodes=tlas_nodes,
         ),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1), whole, memory_space=pltpu.SMEM),
-            pl.BlockSpec((3, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((3, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, block), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, block), lambda i: (0, i), memory_space=pltpu.VMEM),
             pl.BlockSpec((3, padded_n), whole, memory_space=pltpu.VMEM),
             pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
             pl.BlockSpec((padded_n, 1), whole, memory_space=pltpu.VMEM),
@@ -2457,15 +2982,15 @@ def _trace_fused_mesh(
             pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
             pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
             pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
-        ],
+        ] + tlas_specs,
         out_specs=[
-            pl.BlockSpec((3, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, block), lambda i: (0, i), memory_space=pltpu.VMEM),
         ],
         out_shape=[jax.ShapeDtypeStruct((3, padded_rays), jnp.float32)],
         interpret=interpret,
     )(seed_arr, o_t, d_t, c_t, r2, csq, rad, albedo_t, emission_t, dc_sun,
       params, sun_direction, inst_table, v0, e1, e2, normal, bounds_min,
-      bounds_max, skip, first, count)[0]
+      bounds_max, skip, first, count, *tlas_operands)[0]
     return out.T[:rays]
 
 
@@ -2476,11 +3001,17 @@ def _mesh_bounce_io(
     plane_albedo_a, plane_albedo_b,
     rotation, translation, scale, inst_albedo,
     v0, e1, e2, normal, bounds_min, bounds_max, skip, first, count,
-    *, total_bounces: int, interpret: bool,
+    *, total_bounces: int, interpret: bool, use_tlas: bool = False,
+    tlas_leaf: int = 4,
 ):
     from tpu_render_cluster.render.mesh import LEAF_SIZE
 
-    o_t, d_t, rays, padded_rays = _pad_rays_to_miss(origins, directions)
+    # The TLAS variant blocks rays at its own narrower packet width
+    # (tlas_block_r) — pruning lives at block granularity.
+    block = tlas_block_r() if use_tlas else BVH_BLOCK_R
+    o_t, d_t, rays, padded_rays = _pad_rays_to_miss(
+        origins, directions, block
+    )
     ray_pad = padded_rays - rays
     thr_t = jnp.pad(throughput, ((0, ray_pad), (0, 0))).T  # [3, Rp]
     # Pad lanes are DEAD: with their guaranteed-miss rays they never drive
@@ -2511,38 +3042,92 @@ def _mesh_bounce_io(
     bounce_arr = jnp.asarray(bounce, jnp.int32).reshape(1, 1)
     live_arr = jnp.asarray(live_count, jnp.int32).reshape(1, 1)
 
-    # Front-to-back instance order (pure data reordering — normals/albedo
-    # are tracked in-kernel, so results are order-invariant): near
-    # instances set small best-t early and the per-lane walk culls most of
-    # the rest. Dead lanes are parked at 1e7 by the integrator and must
-    # not drag the anchor.
-    valid = (jnp.abs(origins) < 1e6).all(axis=1) & alive
-    anchor_point = jnp.sum(
-        jnp.where(valid[:, None], origins, 0.0), axis=0
-    ) / jnp.maximum(jnp.sum(valid), 1)
-    near_first = jnp.argsort(
-        jnp.sum((translation - anchor_point[None, :]) ** 2, axis=1)
-    )
-    inst_table = _instance_table(
-        rotation[near_first], translation[near_first], scale[near_first],
-        bounds_min, bounds_max, inst_albedo[near_first],
-    )
     n_nodes = skip.shape[0]
     k_count = rotation.shape[0]
+    if use_tlas:
+        # TLAS slot order: Morton over instance world-AABB centers —
+        # ray-INDEPENDENT (unlike the anchor sort below), so a region
+        # launch and the whole-frame launch derive identical tables and
+        # node bounds, keeping tiled-equals-untiled exact. Front-to-back
+        # seeding is subsumed by the walk's per-node entry-vs-best-t cull.
+        from tpu_render_cluster.render.mesh import (
+            cached_tlas_topology,
+            instance_morton_order,
+            tlas_node_bounds,
+        )
 
-    grid = (padded_rays // BVH_BLOCK_R,)
+        # ONE table build, slot-ordered by a row gather (every table
+        # column is a per-instance row-wise function, so gathering rows
+        # IS rebuilding on gathered inputs — exactly, same f32 ops).
+        table = _instance_table(
+            rotation, translation, scale, bounds_min, bounds_max,
+            inst_albedo,
+        )
+        lo_w, hi_w = table[:, 13:16], table[:, 16:19]
+        order = instance_morton_order(lo_w, hi_w)
+        inst_table = table[order]
+        topology = cached_tlas_topology(k_count, tlas_leaf)
+        node_lo, node_hi = tlas_node_bounds(
+            topology, lo_w[order], hi_w[order]
+        )
+        key_lo, key_inv = mesh_key_bounds(lo_w, hi_w)
+        extra_operands = (
+            node_lo, node_hi, jnp.asarray(topology.skip),
+            jnp.asarray(topology.first), jnp.asarray(topology.count),
+            jnp.concatenate([key_lo, key_inv]),
+        )
+        tlas_nodes = int(topology.skip.shape[0])
+    else:
+        # Front-to-back instance order (pure data reordering — normals/
+        # albedo are tracked in-kernel, so results are order-invariant):
+        # near instances set small best-t early and the per-lane walk
+        # culls most of the rest. Dead lanes are parked at 1e7 by the
+        # integrator and must not drag the anchor.
+        valid = (jnp.abs(origins) < 1e6).all(axis=1) & alive
+        anchor_point = jnp.sum(
+            jnp.where(valid[:, None], origins, 0.0), axis=0
+        ) / jnp.maximum(jnp.sum(valid), 1)
+        near_first = jnp.argsort(
+            jnp.sum((translation - anchor_point[None, :]) ** 2, axis=1)
+        )
+        inst_table = _instance_table(
+            rotation[near_first], translation[near_first],
+            scale[near_first],
+            bounds_min, bounds_max, inst_albedo[near_first],
+        )
+        extra_operands = ()
+        tlas_nodes = 0
+
+    grid = (padded_rays // block,)
     whole = lambda i: (0, 0)  # noqa: E731
     flat = lambda i: (0,)  # noqa: E731
     ray_block = pl.BlockSpec(
-        (3, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM
+        (3, block), lambda i: (0, i), memory_space=pltpu.VMEM
     )
     row_block = pl.BlockSpec(
-        (1, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM
+        (1, block), lambda i: (0, i), memory_space=pltpu.VMEM
     )
-    contrib, o2, d2, thr2, alive2 = pl.pallas_call(
+    extra_specs = (
+        [
+            pl.BlockSpec((tlas_nodes, 3), whole, memory_space=pltpu.SMEM),
+            pl.BlockSpec((tlas_nodes, 3), whole, memory_space=pltpu.SMEM),
+            pl.BlockSpec((tlas_nodes,), flat, memory_space=pltpu.SMEM),
+            pl.BlockSpec((tlas_nodes,), flat, memory_space=pltpu.SMEM),
+            pl.BlockSpec((tlas_nodes,), flat, memory_space=pltpu.SMEM),
+            pl.BlockSpec((6,), flat, memory_space=pltpu.SMEM),
+        ]
+        if use_tlas
+        else []
+    )
+    key_out_specs = [row_block] if use_tlas else []
+    key_out_shapes = (
+        [jax.ShapeDtypeStruct((1, padded_rays), jnp.int32)]
+        if use_tlas else []
+    )
+    results = pl.pallas_call(
         _mesh_trace_kernel_factory(
             total_bounces, padded_n, n_nodes, LEAF_SIZE, k_count,
-            state_io=True,
+            state_io=True, use_tlas=use_tlas, tlas_nodes=tlas_nodes,
         ),
         grid=grid,
         in_specs=[
@@ -2573,32 +3158,37 @@ def _mesh_bounce_io(
             pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
             pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
             pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
-        ],
-        out_specs=[ray_block, ray_block, ray_block, ray_block, row_block],
+        ] + extra_specs,
+        out_specs=[ray_block, ray_block, ray_block, ray_block, row_block]
+        + key_out_specs,
         out_shape=[
             jax.ShapeDtypeStruct((3, padded_rays), jnp.float32),
             jax.ShapeDtypeStruct((3, padded_rays), jnp.float32),
             jax.ShapeDtypeStruct((3, padded_rays), jnp.float32),
             jax.ShapeDtypeStruct((3, padded_rays), jnp.float32),
             jax.ShapeDtypeStruct((1, padded_rays), jnp.float32),
-        ],
+        ] + key_out_shapes,
         interpret=interpret,
     )(seed_arr, bounce_arr, live_arr, o_t, d_t, thr_t, alive_t, lane_t,
       c_t, r2, csq, rad,
       albedo_t, emission_t, dc_sun, params, sun_direction, inst_table,
-      v0, e1, e2, normal, bounds_min, bounds_max, skip, first, count)
+      v0, e1, e2, normal, bounds_min, bounds_max, skip, first, count,
+      *extra_operands)
+    contrib, o2, d2, thr2, alive2 = results[:5]
+    key2 = results[5][0, :rays] if use_tlas else None
     return (
         contrib.T[:rays],
         o2.T[:rays],
         d2.T[:rays],
         thr2.T[:rays],
         alive2[0, :rays] > 0.5,
+        key2,
     )
 
 
 def mesh_bounce_pallas(
     scene, mesh, origins, directions, throughput, alive, seed, bounce,
-    *, total_bounces: int, lane=None, live_count=None,
+    *, total_bounces: int, lane=None, live_count=None, use_tlas=None,
 ):
     """One fused path-trace bounce for deep-walk mesh scenes.
 
@@ -2611,8 +3201,12 @@ def mesh_bounce_pallas(
     permutations; ``live_count`` is the number of leading live lanes
     (dead lanes must be sorted to the tail), letting all-dead tail
     blocks skip the bounce. Defaults: positional lanes, nothing skipped.
-    Returns (radiance contribution [R, 3], new origins,
-    new directions, new throughput, new alive).
+
+    ``use_tlas`` (None = the ``TRC_TLAS`` env tier) selects the
+    two-level TLAS kernel variant, which also emits the fused coherence
+    sort key of the POST-bounce state. Returns (radiance contribution
+    [R, 3], new origins, new directions, new throughput, new alive,
+    key [R] int32 — None on the flat variant).
     """
     n = origins.shape[0]
     if lane is None:
@@ -2632,15 +3226,19 @@ def mesh_bounce_pallas(
         bvh.v0, bvh.e1, bvh.e2, bvh.normal,
         bvh.bounds_min, bvh.bounds_max, bvh.skip, bvh.first, bvh.count,
         total_bounces=total_bounces, interpret=_interpret(),
+        use_tlas=use_tlas_for(instances.translation.shape[0], use_tlas),
+        tlas_leaf=tlas_leaf_size(),
     )
 
 
 def trace_paths_fused_mesh(
-    scene, mesh, origins, directions, seed, *, max_bounces: int
+    scene, mesh, origins, directions, seed, *, max_bounces: int,
+    use_tlas=None,
 ):
     """Fused megakernel path trace for mesh scenes; drop-in for
     integrator.trace_paths with a MeshSet. Same physics as the XLA bounce
     scan + per-pass kernels; different (in-kernel counter PCG) RNG stream.
+    ``use_tlas`` (None = env tier) selects the two-level kernel variant.
     """
     bvh = mesh.bvh
     instances = mesh.instances
@@ -2655,6 +3253,8 @@ def trace_paths_fused_mesh(
         bvh.v0, bvh.e1, bvh.e2, bvh.normal,
         bvh.bounds_min, bvh.bounds_max, bvh.skip, bvh.first, bvh.count,
         max_bounces=max_bounces, interpret=_interpret(),
+        use_tlas=use_tlas_for(instances.translation.shape[0], use_tlas),
+        tlas_leaf=tlas_leaf_size(),
     )
 
 
@@ -2889,21 +3489,28 @@ def pool_sphere_bounce(
 def pool_mesh_bounce(
     ops: PoolMeshOperands, origins, directions, throughput, alive,
     lane, fid, seed_row, bounce_row, live_count, *, total_bounces: int,
+    use_tlas: bool = False, tlas_leaf: int = 4,
 ):
     """One pool bounce over a stacked multi-frame mesh scene.
 
-    Pool width must be a multiple of BVH_BLOCK_R. The front-to-back
-    instance ordering is recomputed per call (ray origins move every
-    iteration); results are instance-order invariant, as in
+    Pool width must be a multiple of the active ray block (the TLAS
+    variant packets at the narrower tlas_block_r; every tlas_block_r
+    divides BVH_BLOCK_R, so a BVH_BLOCK_R-rounded pool satisfies both).
+    On the flat variant the front-to-back instance ordering is
+    recomputed per call (ray origins move every iteration); the TLAS
+    variant slot-orders each frame's segment by Morton code instead
+    (ray-independent) and walks one per-frame TLAS window per block.
+    Results are instance-order invariant either way, as in
     _mesh_bounce_io. Returns (contribution, origins, directions,
-    throughput, alive).
+    throughput, alive, key-or-None).
     """
     from tpu_render_cluster.render.mesh import LEAF_SIZE
 
+    block = tlas_block_r() if use_tlas else BVH_BLOCK_R
     rays = origins.shape[0]
-    if rays % BVH_BLOCK_R:
+    if rays % block:
         raise ValueError(
-            f"pool width {rays} not a multiple of {BVH_BLOCK_R}"
+            f"pool width {rays} not a multiple of {block}"
         )
     sp = ops.spheres
     padded_n = sp.c_t.shape[1]
@@ -2919,31 +3526,82 @@ def pool_mesh_bounce(
     # Per-block frame-id windows: the kernel sweeps only the table's
     # contiguous [fid_lo*K, (fid_hi+1)*K) slice for each block
     # (conservative: computed over every lane incl. the stale dead tail).
-    fid_blocks = fid.astype(jnp.int32).reshape(
-        rays // BVH_BLOCK_R, BVH_BLOCK_R
-    )
+    fid_blocks = fid.astype(jnp.int32).reshape(rays // block, block)
     fid_lo = fid_blocks.min(axis=1)[None, :]  # [1, n_blocks]
     fid_hi = fid_blocks.max(axis=1)[None, :]
 
-    # Front-to-back instance order WITHIN each frame's segment, from the
-    # mean live origin (dead lanes parked far away must not drag the
-    # anchor): the stacking stays fid-major — the kernel's window sweep
-    # depends on frame f owning rows [f*K, (f+1)*K) — while near
-    # instances still seed tight best-t early within each frame. Results
-    # are instance-order invariant, as in _mesh_bounce_io.
     k_per_frame = ops.k_per_frame
     n_frames = ops.rotation.shape[0] // k_per_frame
-    valid = (jnp.abs(origins) < 1e6).all(axis=1) & alive
-    anchor = jnp.sum(
-        jnp.where(valid[:, None], origins, 0.0), axis=0
-    ) / jnp.maximum(jnp.sum(valid), 1)
-    d2 = jnp.sum(
-        (ops.translation - anchor[None, :]) ** 2, axis=1
-    ).reshape(n_frames, k_per_frame)
-    within = jnp.argsort(d2, axis=1)  # [F, K]
-    near_first = (
-        within + (jnp.arange(n_frames, dtype=within.dtype) * k_per_frame)[:, None]
-    ).reshape(-1)
+    if use_tlas:
+        # Morton slot order WITHIN each frame's segment (stacking stays
+        # fid-major — the kernel windows on frame f owning rows
+        # [f*K, (f+1)*K)), plus one per-frame TLAS node window stacked
+        # the same way: frame f's nodes are rows [f*M, (f+1)*M) with
+        # skip links and leaf starts offset into the global node/slot
+        # index spaces.
+        from tpu_render_cluster.render.mesh import (
+            cached_tlas_topology,
+            instance_morton_order,
+            tlas_node_bounds,
+        )
+
+        lo_w, hi_w = pool_instance_aabbs(ops)  # [F*K, 3]
+        lo_f = lo_w.reshape(n_frames, k_per_frame, 3)
+        hi_f = hi_w.reshape(n_frames, k_per_frame, 3)
+        within = jax.vmap(instance_morton_order)(lo_f, hi_f)  # [F, K]
+        near_first = (
+            within
+            + (jnp.arange(n_frames, dtype=within.dtype) * k_per_frame)[
+                :, None
+            ]
+        ).reshape(-1)
+        topology = cached_tlas_topology(k_per_frame, tlas_leaf)
+        m = int(topology.skip.shape[0])
+        slo = lo_w[near_first].reshape(n_frames, k_per_frame, 3)
+        shi = hi_w[near_first].reshape(n_frames, k_per_frame, 3)
+        node_lo, node_hi = jax.vmap(
+            lambda lo, hi: tlas_node_bounds(topology, lo, hi)
+        )(slo, shi)
+        node_offset = jnp.arange(n_frames, dtype=jnp.int32)[:, None] * m
+        slot_offset = (
+            jnp.arange(n_frames, dtype=jnp.int32)[:, None] * k_per_frame
+        )
+        key_lo, key_inv = mesh_key_bounds(lo_w, hi_w)
+        extra_operands = (
+            node_lo.reshape(-1, 3),
+            node_hi.reshape(-1, 3),
+            (jnp.asarray(topology.skip)[None, :] + node_offset).reshape(-1),
+            (jnp.asarray(topology.first)[None, :] + slot_offset).reshape(
+                -1
+            ),
+            jnp.tile(jnp.asarray(topology.count), n_frames),
+            jnp.concatenate([key_lo, key_inv]),
+        )
+        tlas_nodes = n_frames * m
+        tlas_per_frame = m
+    else:
+        # Front-to-back instance order WITHIN each frame's segment, from
+        # the mean live origin (dead lanes parked far away must not drag
+        # the anchor): near instances seed tight best-t early within
+        # each frame. Results are instance-order invariant, as in
+        # _mesh_bounce_io.
+        valid = (jnp.abs(origins) < 1e6).all(axis=1) & alive
+        anchor = jnp.sum(
+            jnp.where(valid[:, None], origins, 0.0), axis=0
+        ) / jnp.maximum(jnp.sum(valid), 1)
+        dist2 = jnp.sum(
+            (ops.translation - anchor[None, :]) ** 2, axis=1
+        ).reshape(n_frames, k_per_frame)
+        within = jnp.argsort(dist2, axis=1)  # [F, K]
+        near_first = (
+            within
+            + (jnp.arange(n_frames, dtype=within.dtype) * k_per_frame)[
+                :, None
+            ]
+        ).reshape(-1)
+        extra_operands = ()
+        tlas_nodes = 0
+        tlas_per_frame = 0
     inst_table = _instance_table(
         ops.rotation[near_first], ops.translation[near_first],
         ops.scale[near_first],
@@ -2956,19 +3614,37 @@ def pool_mesh_bounce(
     n_nodes = ops.skip.shape[0]
     k_count = ops.rotation.shape[0]
 
-    grid = (rays // BVH_BLOCK_R,)
+    grid = (rays // block,)
     whole = lambda i: (0, 0)  # noqa: E731
     flat = lambda i: (0,)  # noqa: E731
     ray_block = pl.BlockSpec(
-        (3, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM
+        (3, block), lambda i: (0, i), memory_space=pltpu.VMEM
     )
     row_block = pl.BlockSpec(
-        (1, BVH_BLOCK_R), lambda i: (0, i), memory_space=pltpu.VMEM
+        (1, block), lambda i: (0, i), memory_space=pltpu.VMEM
     )
-    contrib, o2, d2, thr2, alive2 = pl.pallas_call(
+    extra_specs = (
+        [
+            pl.BlockSpec((tlas_nodes, 3), whole, memory_space=pltpu.SMEM),
+            pl.BlockSpec((tlas_nodes, 3), whole, memory_space=pltpu.SMEM),
+            pl.BlockSpec((tlas_nodes,), flat, memory_space=pltpu.SMEM),
+            pl.BlockSpec((tlas_nodes,), flat, memory_space=pltpu.SMEM),
+            pl.BlockSpec((tlas_nodes,), flat, memory_space=pltpu.SMEM),
+            pl.BlockSpec((6,), flat, memory_space=pltpu.SMEM),
+        ]
+        if use_tlas
+        else []
+    )
+    key_out_specs = [row_block] if use_tlas else []
+    key_out_shapes = (
+        [jax.ShapeDtypeStruct((1, rays), jnp.int32)] if use_tlas else []
+    )
+    results = pl.pallas_call(
         _mesh_trace_kernel_factory(
             total_bounces, padded_n, n_nodes, LEAF_SIZE, k_count,
             pool_io=True, k_per_frame=k_per_frame,
+            use_tlas=use_tlas, tlas_nodes=tlas_nodes,
+            tlas_per_frame=tlas_per_frame,
         ),
         grid=grid,
         in_specs=[
@@ -3007,20 +3683,23 @@ def pool_mesh_bounce(
             pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
             pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
             pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
-        ],
-        out_specs=[ray_block, ray_block, ray_block, ray_block, row_block],
+        ] + extra_specs,
+        out_specs=[ray_block, ray_block, ray_block, ray_block, row_block]
+        + key_out_specs,
         out_shape=[
             jax.ShapeDtypeStruct((3, rays), jnp.float32),
             jax.ShapeDtypeStruct((3, rays), jnp.float32),
             jax.ShapeDtypeStruct((3, rays), jnp.float32),
             jax.ShapeDtypeStruct((3, rays), jnp.float32),
             jax.ShapeDtypeStruct((1, rays), jnp.float32),
-        ],
+        ] + key_out_shapes,
         interpret=_interpret(),
     )(live_arr, o_t, d_t, thr_t, alive_t, lane_t, seed_t, bounce_t, fid_t,
       fid_lo, fid_hi,
       sp.c_t, sp.r2, sp.csq, sp.rad, sp.albedo_t, sp.emission_t,
       sp.dc_sun, sp.sfid, sp.params, ops.sun_direction, inst_table,
       ops.v0, ops.e1, ops.e2, ops.normal, ops.bounds_min, ops.bounds_max,
-      ops.skip, ops.first, ops.count)
-    return contrib.T, o2.T, d2.T, thr2.T, alive2[0] > 0.5
+      ops.skip, ops.first, ops.count, *extra_operands)
+    contrib, o2, d2, thr2, alive2 = results[:5]
+    key2 = results[5][0] if use_tlas else None
+    return contrib.T, o2.T, d2.T, thr2.T, alive2[0] > 0.5, key2

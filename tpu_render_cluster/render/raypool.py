@@ -306,7 +306,7 @@ def _pool_sort_order(origins, directions, alive, fid, lo_w, hi_w):
     jax.jit,
     static_argnames=(
         "scene_name", "width", "height", "samples", "max_bounces",
-        "pool_width", "tile_shape",
+        "pool_width", "tile_shape", "use_tlas",
     ),
 )
 def _raypool_batch(
@@ -322,6 +322,7 @@ def _raypool_batch(
     max_bounces: int,
     pool_width: int,
     tile_shape: tuple[int, int] | None = None,
+    use_tlas: bool | None = None,
 ):
     """The whole batch as ONE compiled program; returns
     (linear images [f_cap, H, W, 3], stats tuple).
@@ -415,6 +416,7 @@ def _raypool_batch(
     )
 
     mesh_kind = mesh_kind_for_scene(scene_name)
+    tlas = False
     if mesh_kind is not None:
         bvh = cached_mesh_bvh(mesh_kind)  # shared topology, host-cached
         inst = jax.vmap(lambda f: build_mesh_instances(scene_name, f))(
@@ -434,15 +436,29 @@ def _raypool_batch(
             bounds_min=bvh.bounds_min, bounds_max=bvh.bounds_max,
             skip=bvh.skip, first=bvh.first, count=bvh.count,
         )
-        # Sort-key broadphase over SLOT-UNION AABBs: slot k's world AABB
-        # unioned across the window's frames, so the candidate pass is
-        # [P, K] instead of [P, K*F] (measured ~126 ms/iteration of pure
-        # glue at F=8 on CPU). The candidate only steers packing — fid
-        # sits ABOVE it in the key, so within a frame group the union box
-        # is a slightly dilated version of the frame's own box.
-        inst_lo, inst_hi = pk.pool_instance_aabbs(mesh_ops)
-        inst_lo = inst_lo.reshape(f_cap, k, 3).min(axis=0)
-        inst_hi = inst_hi.reshape(f_cap, k, 3).max(axis=0)
+        # ``use_tlas`` is a static REQUEST (None = env tier); the actual
+        # decision folds in the per-frame instance count, all concrete
+        # at trace time (small fields degenerate to the flat sweep).
+        tlas = pk.use_tlas_for(k, use_tlas)
+        if tlas:
+            # The TLAS kernels packet at their own narrower block; it
+            # always divides BVH_BLOCK_R, so the BVH_BLOCK_R-rounded
+            # pool width stays valid and the launched-lane accounting
+            # below matches the kernel's actual skip granularity.
+            block = pk.tlas_block_r()
+        if not tlas:
+            # Sort-key broadphase over SLOT-UNION AABBs: slot k's world
+            # AABB unioned across the window's frames, so the candidate
+            # pass is [P, K] instead of [P, K*F] (measured ~126
+            # ms/iteration of pure glue at F=8 on CPU). The candidate
+            # only steers packing — fid sits ABOVE it in the key, so
+            # within a frame group the union box is a slightly dilated
+            # version of the frame's own box. The TLAS pool needs none
+            # of this: its sort reads the key column the bounce kernel
+            # emitted.
+            inst_lo, inst_hi = pk.pool_instance_aabbs(mesh_ops)
+            inst_lo = inst_lo.reshape(f_cap, k, 3).min(axis=0)
+            inst_hi = inst_hi.reshape(f_cap, k, 3).max(axis=0)
     else:
         mesh_ops = None
 
@@ -468,6 +484,14 @@ def _raypool_batch(
         live_sum=jnp.float32(0.0),
         launched_sum=jnp.float32(0.0),
     )
+    if tlas:
+        # Carried coherence-key column (the TLAS bounce kernel re-emits
+        # it every iteration): every initial lane is dead, so one
+        # constant dead-bit key is exact — the first sort is a stable
+        # identity and the refill fills the pool head.
+        state["key"] = jnp.full(
+            (pool,), jnp.int32(1 << pk.KEY_DEAD_BIT), jnp.int32
+        )
     # Backstop against a non-terminating loop under a lifecycle bug:
     # every iteration either serves new rays or ages live lanes toward
     # the bounce cap, so this bound is generous.
@@ -480,8 +504,14 @@ def _raypool_batch(
 
     def body(s):
         # 1. One permutation: dead to the tail (+ frame/candidate/Morton
-        # coherence for mesh scenes).
-        if mesh_ops is not None:
+        # coherence for mesh scenes). The TLAS pool sorts by the key
+        # column the previous iteration's bounce kernel emitted (dead
+        # flag at pk.KEY_DEAD_BIT, fid above Morton — the same
+        # live-grouping the flat key builds, minus the separate
+        # broadphase pass).
+        if mesh_ops is not None and tlas:
+            perm = jnp.argsort(s["key"])
+        elif mesh_ops is not None:
             perm = _pool_sort_order(
                 s["o"], s["d"], s["alive"], s["fid"], inst_lo, inst_hi
             )
@@ -520,15 +550,17 @@ def _raypool_batch(
             else glane_map[jnp.clip(lane, 0, n - 1)]
         )
         if mesh_ops is not None:
-            contrib, o, d, thr, alive_k = pk.pool_mesh_bounce(
+            contrib, o, d, thr, alive_k, key2 = pk.pool_mesh_bounce(
                 mesh_ops, o, d, thr, alive, rng, fid, seed_row, bounce,
-                live2, total_bounces=max_bounces,
+                live2, total_bounces=max_bounces, use_tlas=tlas,
+                tlas_leaf=pk.tlas_leaf_size(),
             )
         else:
             contrib, o, d, thr, alive_k = pk.pool_sphere_bounce(
                 sphere_ops, o, d, thr, alive, rng, fid, seed_row,
                 bounce, live2, total_bounces=max_bounces,
             )
+            key2 = None
 
         # 4. Scatter-back into each lane's own frame buffer. Dead lanes
         # contribute exact zeros (alive-masked kernel math / skipped
@@ -554,7 +586,7 @@ def _raypool_batch(
         log_at = jnp.minimum(s["it"], RAYPOOL_LOG_CAP - 1)
         launched = ((live2 + block - 1) // block) * block
         occupancy = live2.astype(jnp.float32) / jnp.maximum(launched, 1)
-        return dict(
+        next_state = dict(
             o=o, d=d, thr=thr, alive=alive, lane=lane, fid=fid,
             bounce=bounce,
             served=s["served"] + take,
@@ -566,6 +598,15 @@ def _raypool_batch(
             live_sum=s["live_sum"] + live2.astype(jnp.float32),
             launched_sum=s["launched_sum"] + launched.astype(jnp.float32),
         )
+        if tlas:
+            # The kernel keyed lanes by its OWN post-bounce alive; the
+            # bounce-cap kill above happens out here, so stamp the dead
+            # bit onto capped lanes or the next sort would keep funding
+            # their packets instead of reclaiming them.
+            next_state["key"] = jnp.where(
+                alive, key2, key2 | jnp.int32(1 << pk.KEY_DEAD_BIT)
+            )
+        return next_state
 
     final = jax.lax.while_loop(cond, body, state)
     images = (
@@ -657,6 +698,7 @@ def render_batch_raypool(
     pool_width: int | None = None,
     frame_cap: int | None = None,
     region: tuple[int, int, int, int] | None = None,
+    use_tlas: bool | None = None,
 ):
     """Render a batch of frames through the device-resident ray pool.
 
@@ -693,6 +735,10 @@ def render_batch_raypool(
     )
     pool = pool_width if pool_width is not None else raypool_width(n, block)
     pool = max(block, -(-pool // block) * block)
+    # The tag mirrors the REQUESTED tier (None = env), like the masked/
+    # region profiler keys — kernel selection still auto-degrades tiny
+    # instance fields inside the batch program.
+    tlas_tag = int(pk.tlas_enabled() if use_tlas is None else bool(use_tlas))
 
     images: list = []
     for start in range(0, len(frames), f_cap):
@@ -701,6 +747,7 @@ def render_batch_raypool(
         note_compile(
             "raypool", scene_name, width, height, samples, max_bounces,
             pool, f_cap, None if region is None else (region[2], region[3]),
+            tlas_tag,
         )
         start_wall = time.time()
         start_mono = time.perf_counter()
@@ -713,6 +760,7 @@ def render_batch_raypool(
             width=width, height=height, samples=samples,
             max_bounces=max_bounces, pool_width=pool,
             tile_shape=None if region is None else (region[2], region[3]),
+            use_tlas=use_tlas,
         )
         # THE host sync of the batch: everything before this line is one
         # dispatched XLA program.
@@ -739,6 +787,7 @@ def render_batch_raypool(
             w=width, h=height, s=samples, b=max_bounces,
             pool=pool, frames=f_cap,
             tile="-" if region is None else f"{region[2]}x{region[3]}",
+            tlas=tlas_tag,
         )
         if not profiler.captured(pool_key):
             profiler.capture(
@@ -749,6 +798,7 @@ def render_batch_raypool(
                 width=width, height=height, samples=samples,
                 max_bounces=max_bounces, pool_width=pool,
                 tile_shape=None if region is None else (region[2], region[3]),
+                use_tlas=use_tlas,
             )
         profiler.record_execute(pool_key, duration)
         _emit_batch_obs(
