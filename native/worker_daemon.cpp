@@ -437,6 +437,11 @@ class WorkerDaemon {
     std::condition_variable reconnected_cv_;
     std::atomic<bool> cancelled_{false};
     std::atomic<bool> job_finished_{false};
+    // Epoch fencing state (IO thread only): the master incarnation the
+    // current session belongs to (-1 = epoch-less), and the refused-
+    // reconnect fallback flag.
+    int64_t last_epoch_ = -1;
+    bool force_fresh_announce_ = false;
     std::thread::id io_thread_id_;
 
     struct QueueEntry {
@@ -449,6 +454,10 @@ class WorkerDaemon {
     std::condition_variable queue_cv_;
     std::deque<QueueEntry> queue_;
     std::set<std::pair<std::string, int>> finished_frames_;
+    // Bumped by begin_fresh_session() (queue_mutex_): a frame that was
+    // mid-render when the master session changed must not re-enter the
+    // just-cleared finished index when it completes.
+    uint64_t session_generation_ = 0;
 
     TraceBuilder tracer_;
     uint64_t ping_counter_ = 0;
@@ -483,11 +492,32 @@ class WorkerDaemon {
         const Json* tag = request.get("message_type");
         if (tag == nullptr || tag->as_string() != "handshake_request")
             return false;
+        // Optional ledger epoch (PROTOCOL.md §Epoch fencing & failover):
+        // a reconnect that lands on a DIFFERENT master incarnation than
+        // the one we lost has no session to resume — announce a fresh
+        // first-connection instead of replaying into it. -1 = no epoch
+        // key (a ledger-less master; plain reconnect semantics apply).
+        int64_t epoch = -1;
+        const Json* hs_payload = request.get("payload");
+        if (hs_payload != nullptr) {
+            const Json* epoch_field = hs_payload->get("epoch");
+            if (epoch_field != nullptr &&
+                (epoch_field->type == Json::INT ||
+                 epoch_field->type == Json::UINT))
+                epoch = epoch_field->as_i64();
+        }
+        bool announce_fresh =
+            !is_reconnect || force_fresh_announce_ || epoch != last_epoch_;
+        if (is_reconnect && announce_fresh)
+            LOG_WARN(
+                "Master session changed (epoch %lld -> %lld); re-announcing "
+                "as a fresh session.",
+                (long long)last_epoch_, (long long)epoch);
 
         Json payload = Json::make_object();
         payload.set("handshake_type",
-                    Json::make_string(is_reconnect ? "reconnecting"
-                                                   : "first-connection"));
+                    Json::make_string(announce_fresh ? "first-connection"
+                                                     : "reconnecting"));
         payload.set("worker_version", Json::make_string("1.0.0"));
         payload.set("worker_id", Json::make_uint(worker_id_));
         Json envelope = Json::make_object();
@@ -506,11 +536,47 @@ class WorkerDaemon {
             return false;
         const Json* ok = ack_payload->get("ok");
         if (ok == nullptr || ok->type != Json::BOOL || !ok->boolean) {
-            LOG_ERROR("Master refused the handshake.");
+            if (!announce_fresh) {
+                // A restarted (epoch-less) master refuses reconnects from
+                // workers it never met; fall back to a fresh announce on
+                // the next attempt instead of retrying into refusal until
+                // the backoff budget kills the daemon.
+                force_fresh_announce_ = true;
+                LOG_WARN(
+                    "Reconnect refused; will re-announce as a fresh session.");
+            } else {
+                LOG_ERROR("Master refused the handshake.");
+            }
             return false;
         }
+        last_epoch_ = epoch;
+        force_fresh_announce_ = false;
+        if (is_reconnect && announce_fresh) begin_fresh_session();
         reconnected_cv_.notify_all();
         return true;
+    }
+
+    // A reconnect landed on a NEW master incarnation: the queued-but-not-
+    // rendering entries belong to assignments the new master does not
+    // know, and the already-finished index would lie about its NEW
+    // assignments — drop both. The frame mid-render (if any) finishes;
+    // its result carries no epoch echo from this daemon, so the new
+    // master's dedup seam arbitrates it like any anonymous result.
+    void begin_fresh_session() {
+        std::lock_guard<std::mutex> lock(queue_mutex_);
+        size_t dropped = 0;
+        for (auto it = queue_.begin(); it != queue_.end();) {
+            if (!it->rendering) {
+                it = queue_.erase(it);
+                dropped++;
+            } else {
+                ++it;
+            }
+        }
+        finished_frames_.clear();
+        session_generation_++;
+        LOG_INFO("Fresh session with master; dropped %zu stale queued frame(s).",
+                 dropped);
     }
 
     // Called by the IO thread when the socket dies mid-job.
@@ -741,6 +807,7 @@ class WorkerDaemon {
         while (!cancelled_.load()) {
             RenderRequest request;
             bool have_frame = false;
+            uint64_t session = 0;
             {
                 std::unique_lock<std::mutex> lock(queue_mutex_);
                 cv_wait_for(queue_cv_, lock, std::chrono::milliseconds(100), [&] {
@@ -752,6 +819,7 @@ class WorkerDaemon {
                         entry.rendering = true;
                         request = entry.request;
                         have_frame = true;
+                        session = session_generation_;
                         break;
                     }
                 }
@@ -785,8 +853,11 @@ class WorkerDaemon {
                 }
                 // Errored frames are NOT finished: the master returns them to
                 // the pending pool and may re-queue them here, so a later
-                // remove request must not answer "already-finished".
-                if (rendered) {
+                // remove request must not answer "already-finished". A frame
+                // whose SESSION changed mid-render stays out too: the new
+                // master may re-assign this unit, and an already-finished
+                // answer would lie about the new assignment.
+                if (rendered && session == session_generation_) {
                     finished_frames_.insert(
                         {request.job_name, request.frame_index});
                 }

@@ -52,6 +52,14 @@ KIND_HANG = "hang"
 KIND_DRAIN = "drain"
 # Master faults (executed by the dispatch-delay shim in worker_handle.py).
 KIND_DELAY_DISPATCH = "delay_dispatch"
+# Control-plane faults (executed by the failover harness, ha/chaos.py):
+# the TARGET is the master, addressed by the ``MASTER_TARGET`` sentinel
+# rather than a worker slot.
+KIND_MASTER_KILL = "master_kill"
+KIND_MASTER_PARTITION = "master_partition"
+
+# Slot sentinel for faults aimed at the master process itself.
+MASTER_TARGET = -1
 
 ALL_KINDS = (
     KIND_DROP_SEND,
@@ -65,7 +73,11 @@ ALL_KINDS = (
     KIND_HANG,
     KIND_DRAIN,
     KIND_DELAY_DISPATCH,
+    KIND_MASTER_KILL,
+    KIND_MASTER_PARTITION,
 )
+
+MASTER_KINDS = (KIND_MASTER_KILL, KIND_MASTER_PARTITION)
 
 FINISHED_EVENT_TYPE = "event_frame-queue_item-finished"
 RENDERING_EVENT_TYPE = "event_frame-queue_item-started-rendering"
@@ -181,6 +193,15 @@ class FaultPlan:
     def events_for(self, slot: int) -> tuple[FaultEvent, ...]:
         return tuple(e for e in self.events if e.target == slot)
 
+    def master_events(self) -> tuple[FaultEvent, ...]:
+        """Control-plane faults (master kill / partition), schedule order."""
+        return tuple(
+            sorted(
+                (e for e in self.events if e.kind in MASTER_KINDS),
+                key=lambda e: e.at_seconds,
+            )
+        )
+
     def expected_evictions(self) -> int:
         return sum(1 for e in self.events if e.causes_eviction)
 
@@ -230,6 +251,8 @@ class FaultPlan:
         dispatch_delays: int = 1,
         hangs: int = 0,
         drains: int = 0,
+        master_kills: int = 0,
+        master_partitions: int = 0,
     ) -> "FaultPlan":
         """Roll a schedule from one PCG64 stream.
 
@@ -360,8 +383,49 @@ class FaultPlan:
                     duration_seconds=float(rng.uniform(0.2, 0.5)),
                 )
             )
+        # Control-plane faults draw LAST so plans without them (every
+        # pre-HA seed) keep a bit-identical schedule for the same seed.
+        for _ in range(master_kills):
+            events.append(
+                FaultEvent(
+                    kind=KIND_MASTER_KILL,
+                    target=MASTER_TARGET,
+                    at_seconds=float(rng.uniform(0.8, 1.4)),
+                )
+            )
+        for _ in range(master_partitions):
+            events.append(
+                FaultEvent(
+                    kind=KIND_MASTER_PARTITION,
+                    target=MASTER_TARGET,
+                    at_seconds=float(rng.uniform(0.4, 0.8)),
+                )
+            )
         return cls(
             seed=seed, workers=workers, events=tuple(events), timings=timings
+        )
+
+    @classmethod
+    def generate_failover(cls, seed: int, workers: int = 3) -> "FaultPlan":
+        """A failover-focused schedule: one master kill mid-job plus the
+        survivable worker faults (straggler, duplicated result send,
+        dropped rendering event) that keep the dedup seam honest while
+        the standby adopts the pool. No worker-removing faults — every
+        worker must survive to be re-adopted."""
+        return cls.generate(
+            seed,
+            workers,
+            kills=0,
+            partitions=0,
+            wedges=0,
+            hangs=0,
+            drains=0,
+            duplicate_sends=1,
+            stragglers=1,
+            drops=1,
+            dispatch_delays=0,
+            master_kills=1,
+            master_partitions=1,
         )
 
     @classmethod

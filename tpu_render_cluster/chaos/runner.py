@@ -523,11 +523,48 @@ def build_parser() -> argparse.ArgumentParser:
         "pipeline — sub-frame work units + the master's assembly ledger "
         "(single-job mode only).",
     )
+    parser.add_argument(
+        "--failover",
+        action="store_true",
+        help="Run the master-failover scenario (ha/chaos.py): a "
+        "ledger-backed primary is killed mid-job, a standby replays the "
+        "write-ahead ledger on the same port, re-adopts the workers via "
+        "epoch-fenced re-announce, and the job completes — audited by the "
+        "cross-incarnation exactly-once invariant. Uses "
+        "FaultPlan.generate_failover(seed, workers) unless --plan is given.",
+    )
     return parser
 
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.failover:
+        from tpu_render_cluster.ha.chaos import run_chaos_failover_job
+
+        plan = (
+            FaultPlan.from_toml(args.plan)
+            if args.plan
+            else FaultPlan.generate_failover(args.seed, args.workers)
+        )
+        results_directory = args.results_directory
+        if results_directory is None:
+            from tpu_render_cluster.analysis.paths import RESULTS_ROOT
+
+            results_directory = RESULTS_ROOT / "chaos-runs"
+        tile_grid = None
+        if args.tiles:
+            from tpu_render_cluster.jobs.tiles import parse_tile_grid
+
+            tile_grid = parse_tile_grid(args.tiles)
+        report = run_chaos_failover_job(
+            plan,
+            frames=args.frames,
+            results_directory=results_directory,
+            timeout=args.timeout,
+            tile_grid=tile_grid,
+        )
+        print(json.dumps(report.to_dict(), indent=2))
+        return 0 if report.ok else 1
     if args.plan:
         plan = FaultPlan.from_toml(args.plan)
     else:

@@ -1,0 +1,31 @@
+"""Replicated control plane (ROADMAP open item 2).
+
+Three pieces make the master survivable and horizontally scalable:
+
+- ``ha/ledger.py`` — the write-ahead **job ledger**: an append-only,
+  fsync'd, segmented JSONL journal of job-lifecycle / unit-finished /
+  frame-assembled transitions with periodic snapshots and a
+  format-versioned replay path. The PR-4 exactly-once dedup ledger is
+  the in-memory half of this; the WAL is the half that survives the
+  process.
+- ``ha/chaos.py`` — **master failover**, driven end to end by the chaos
+  engine: kill the primary mid-job, start a standby on the same port,
+  replay the ledger, re-adopt the live workers through the existing
+  reconnect + late-joiner-replay path, fence stale traffic with the
+  monotonic epoch the ledger mints per master incarnation.
+- ``ha/shards.py`` — the **shard router** front end: one JSON-lines
+  control socket that hashes submissions across N master shards, each
+  owning a slice of the worker pool.
+"""
+
+from tpu_render_cluster.ha.ledger import (
+    JobLedger,
+    LedgerCorruptError,
+    LedgerReplay,
+)
+
+__all__ = [
+    "JobLedger",
+    "LedgerCorruptError",
+    "LedgerReplay",
+]

@@ -1,0 +1,418 @@
+"""Master-failover chaos: kill the primary mid-job, audit the standby.
+
+The failover analog of ``chaos/runner.py``: one real in-process cluster
+(accepting server, 3-step handshake, heartbeats, real WebSockets), a
+seeded fault plan that includes the control-plane kinds
+(``master_kill`` / ``master_partition``), and an invariant audit at the
+end. The run has two acts:
+
+1. **Primary** — a ledger-backed ``ClusterManager`` starts the job; the
+   plan's worker faults (stragglers, duplicated sends, drops) execute as
+   usual. At the scheduled offsets, ``master_partition`` aborts every
+   master-side worker socket (workers reconnect into the SAME epoch —
+   the ordinary reconnect path) and ``master_kill`` cancels the primary
+   outright, socket-death and all.
+2. **Standby** — a fresh ``ClusterManager`` opens the same ledger
+   directory (epoch bump), replays the finished set, binds the SAME
+   port, and re-adopts the workers as they re-announce (fresh sessions —
+   the epoch piggyback tells them their old session is gone). The job
+   completes; results of predecessor assignments arrive fenced with the
+   old epoch and are refused, never double-counted.
+
+The audit (``check_failover_invariants``) is the cross-incarnation
+exactly-once equation::
+
+    ledger_replayed + (ok - duplicates) == units_total
+
+plus zero ghost mirrors, zero unplanned evictions/drains, and a merged
+cluster timeline whose flows all resolve. MTTR is measured as
+kill -> first post-adoption queue-add dispatch.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from pathlib import Path
+from typing import Any
+
+from tpu_render_cluster.chaos.inject import MasterChaosHooks, WorkerChaosController
+from tpu_render_cluster.chaos.plan import (
+    KIND_MASTER_KILL,
+    KIND_MASTER_PARTITION,
+    FaultPlan,
+)
+from tpu_render_cluster.chaos.runner import (
+    DEFAULT_RENDER_SECONDS,
+    ChaosReport,
+    _make_job,
+    _timing_overrides,
+    unit_latency_stats,
+)
+from tpu_render_cluster.ha.ledger import JobLedger
+from tpu_render_cluster.harness import local as local_harness
+from tpu_render_cluster.master.cluster import ClusterManager
+from tpu_render_cluster.master.state import FrameStatus
+from tpu_render_cluster.obs import MetricsRegistry
+from tpu_render_cluster.worker.backends.chaos import FaultyBackend
+from tpu_render_cluster.worker.backends.mock import MockBackend
+from tpu_render_cluster.worker.runtime import Worker
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_FAILOVER_FRAMES = 48
+STANDBY_BIND_RETRIES = 20
+STANDBY_BIND_RETRY_SECONDS = 0.1
+
+
+def check_failover_invariants(
+    standby: ClusterManager,
+    plan: FaultPlan,
+    *,
+    cluster_trace_document: Any | None = None,
+) -> list[str]:
+    """The failover audit, over the STANDBY incarnation's final state."""
+    from tpu_render_cluster.chaos.invariants import counter_total, ledger_stats
+
+    violations: list[str] = []
+    state = standby.state
+    total = len(state.frames)
+
+    unfinished = sorted(
+        (unit for unit, record in state.frames.items()
+         if record.status is not FrameStatus.FINISHED),
+        key=lambda u: u.sort_key,
+    )
+    if unfinished:
+        violations.append(
+            f"completion: {len(unfinished)} unit(s) not FINISHED after "
+            f"failover: {[u.label for u in unfinished[:10]]}"
+        )
+    if state.finished_count() != total:
+        violations.append(
+            f"completion: finished_count {state.finished_count()} != "
+            f"unit table size {total}"
+        )
+
+    # Cross-incarnation exactly-once: what the ledger restored plus what
+    # the standby's result stream delivered (first copies only) must
+    # cover every unit exactly once.
+    delivered = state.ledger["ok_results"] - state.ledger["duplicate_results"]
+    if standby.replayed_units + delivered != total:
+        violations.append(
+            "exactly-once across failover: replayed + (ok - duplicates) = "
+            f"{standby.replayed_units} + ({state.ledger['ok_results']} - "
+            f"{state.ledger['duplicate_results']}) = "
+            f"{standby.replayed_units + delivered}, expected {total}"
+        )
+
+    for worker in standby.workers.values():
+        if len(worker.queue) > 0:
+            ghosts = sorted(
+                (f.unit for f in worker.queue.all_frames()),
+                key=lambda u: u.sort_key,
+            )
+            violations.append(
+                f"ghost assignments: worker {worker.worker_id:08x} "
+                f"({'dead' if worker.is_dead else 'alive'}) still mirrors "
+                f"unit(s) {[u.label for u in ghosts[:10]]}"
+            )
+
+    # A failover plan removes no workers: nobody may be evicted or
+    # drained in the standby incarnation (the primary's registry is
+    # audited by the caller's stats, not here — it died mid-run).
+    snapshot = standby.metrics.snapshot()
+    ledger = ledger_stats(snapshot)
+    expected_evictions = plan.expected_evictions()
+    if ledger["evictions"] != expected_evictions:
+        violations.append(
+            f"evictions: standby master_worker_evictions_total = "
+            f"{ledger['evictions']:.0f}, plan injected {expected_evictions}"
+        )
+    if ledger["drains"] != plan.expected_drains():
+        violations.append(
+            f"drains: standby master_worker_drains_total = "
+            f"{ledger['drains']:.0f}, plan injected {plan.expected_drains()}"
+        )
+
+    # The fence must be consistent with itself: every refusal the metrics
+    # counted landed in the per-job ledger too.
+    refused_metric = counter_total(snapshot, "master_stale_epoch_events_total")
+    if refused_metric != state.ledger["stale_epoch_results"]:
+        violations.append(
+            f"epoch fence: master_stale_epoch_events_total "
+            f"{refused_metric:.0f} != per-job stale_epoch_results "
+            f"{state.ledger['stale_epoch_results']}"
+        )
+
+    if cluster_trace_document is not None:
+        from tpu_render_cluster.obs import validate_trace_document
+
+        problems = validate_trace_document(cluster_trace_document)
+        for problem in problems[:10]:
+            violations.append(f"cluster trace: {problem}")
+    return violations
+
+
+async def _failover_run(
+    job,
+    plan: FaultPlan,
+    backends: list[FaultyBackend],
+    controllers: list[WorkerChaosController],
+    hooks: MasterChaosHooks,
+    registries: list[MetricsRegistry],
+    primary_registry: MetricsRegistry,
+    standby_registry: MetricsRegistry,
+    ledger_directory: Path,
+    failover_stats: dict[str, Any],
+):
+    loop = asyncio.get_running_loop()
+    started = loop.time()
+    watchdogs: list[asyncio.Task] = []
+
+    primary_ledger = JobLedger.open(ledger_directory, metrics=primary_registry)
+    primary = ClusterManager(
+        "127.0.0.1",
+        0,
+        job,
+        metrics=primary_registry,
+        dispatch_delay_fn=hooks.dispatch_delay,
+        ledger=primary_ledger,
+    )
+    primary_task = asyncio.create_task(
+        primary.initialize_server_and_run_job(), name="primary-master"
+    )
+    while primary._server is None:
+        if primary_task.done():
+            await primary_task
+            raise RuntimeError("primary master exited before startup")
+        await asyncio.sleep(0.01)
+    port = primary.port
+    failover_stats["primary_epoch"] = primary_ledger.epoch
+
+    workers = [
+        Worker(
+            "127.0.0.1",
+            port,
+            backend,
+            metrics=registries[slot],
+            connection_wrapper=controllers[slot].wrap_connection,
+        )
+        for slot, backend in enumerate(backends)
+    ]
+    worker_tasks = [
+        asyncio.create_task(w.connect_and_run_to_job_completion()) for w in workers
+    ]
+    for slot, worker in enumerate(workers):
+        hooks.map_worker(worker.worker_id, slot)
+        controllers[slot].attach(worker, worker_tasks[slot].cancel)
+        watchdogs.append(
+            asyncio.create_task(
+                controllers[slot].run_timed_faults(),
+                name=f"chaos-watchdog-{slot}",
+            )
+        )
+
+    standby: ClusterManager | None = None
+    try:
+        # Act 1+2: execute the control-plane fault schedule.
+        killed = False
+        for event in plan.master_events():
+            await asyncio.sleep(max(0.0, started + event.at_seconds - loop.time()))
+            if event.kind == KIND_MASTER_PARTITION:
+                # The master vanishes from every worker's point of view
+                # without dying: abort each logical connection's inner
+                # socket. The workers reconnect into the SAME epoch — the
+                # ordinary resume-session path, no state dropped.
+                logger.info("chaos: partitioning the master from all workers")
+                failover_stats["master_partitions"] = (
+                    failover_stats.get("master_partitions", 0) + 1
+                )
+                for handle in primary.workers.values():
+                    handle.connection._connection.abort()
+            elif event.kind == KIND_MASTER_KILL and not killed:
+                killed = True
+                logger.info("chaos: killing the primary master")
+                failover_stats["kill_at"] = time.time()
+                primary_task.cancel()
+                await asyncio.gather(primary_task, return_exceptions=True)
+
+        if not killed:
+            # No kill scheduled: degenerate to a plain run (the caller's
+            # plan is wrong, but don't hang the harness).
+            master_trace, worker_traces = await primary_task
+            return master_trace, worker_traces, primary, workers
+
+        # Act 2: the standby opens the same ledger (epoch bump), binds the
+        # SAME port the workers know, replays, and re-adopts.
+        standby_ledger = JobLedger.open(ledger_directory, metrics=standby_registry)
+        failover_stats["standby_epoch"] = standby_ledger.epoch
+
+        def adoption_probe(worker_id: int, frame_index: int) -> float:
+            if "first_dispatch_at" not in failover_stats:
+                failover_stats["first_dispatch_at"] = time.time()
+            return hooks.dispatch_delay(worker_id, frame_index)
+
+        standby = ClusterManager(
+            "127.0.0.1",
+            port,
+            job,
+            metrics=standby_registry,
+            dispatch_delay_fn=adoption_probe,
+            ledger=standby_ledger,
+        )
+        failover_stats["replayed_units"] = standby.replayed_units
+        standby_task: asyncio.Task | None = None
+        for attempt in range(STANDBY_BIND_RETRIES):
+            standby_task = asyncio.create_task(
+                standby.initialize_server_and_run_job(), name="standby-master"
+            )
+            while standby._server is None and not standby_task.done():
+                await asyncio.sleep(0.01)
+            if standby._server is not None:
+                break
+            # Bind failed (the primary's socket not fully released yet):
+            # surface anything that is not an address-in-use retry.
+            try:
+                await standby_task
+            except OSError:
+                await asyncio.sleep(STANDBY_BIND_RETRY_SECONDS)
+                continue
+            raise RuntimeError("standby master exited before startup")
+        if standby._server is None:
+            raise RuntimeError(
+                f"standby could not bind port {port} after "
+                f"{STANDBY_BIND_RETRIES} attempts"
+            )
+        master_trace, worker_traces = await standby_task
+        if "first_dispatch_at" in failover_stats and "kill_at" in failover_stats:
+            failover_stats["mttr_seconds"] = (
+                failover_stats["first_dispatch_at"] - failover_stats["kill_at"]
+            )
+        return master_trace, worker_traces, standby, workers
+    finally:
+        for watchdog in watchdogs:
+            watchdog.cancel()
+        await asyncio.gather(*watchdogs, return_exceptions=True)
+        # Reap worker tasks (they exit once the standby collected traces;
+        # anything still alive after the grace is cancelled).
+        _done, pending = await asyncio.wait(worker_tasks, timeout=3.0)
+        for task in pending:
+            task.cancel()
+        await asyncio.gather(*worker_tasks, return_exceptions=True)
+
+
+def run_chaos_failover_job(
+    plan: FaultPlan,
+    *,
+    frames: int = DEFAULT_FAILOVER_FRAMES,
+    ledger_directory: str | Path | None = None,
+    results_directory: str | Path | None = None,
+    render_seconds: float = DEFAULT_RENDER_SECONDS,
+    timeout: float = 240.0,
+    tile_grid: tuple[int, int] | None = None,
+) -> ChaosReport:
+    """Run one seeded failover scenario end to end and audit it.
+
+    The plan must contain a ``master_kill`` event (``FaultPlan.
+    generate_failover`` builds a canonical one). The report's
+    ``stats["failover"]`` carries the epochs, the ledger-replayed unit
+    count, and the measured MTTR (master kill to the standby's first
+    post-adoption dispatch).
+    """
+    import tempfile
+
+    job = _make_job(plan, frames, None, tile_grid)
+    if ledger_directory is None:
+        ledger_directory = Path(tempfile.mkdtemp(prefix="trc-ha-ledger-"))
+    ledger_directory = Path(ledger_directory)
+
+    registries = [MetricsRegistry() for _ in range(plan.workers)]
+    controllers = [
+        WorkerChaosController(slot, plan.events_for(slot), registry=registries[slot])
+        for slot in range(plan.workers)
+    ]
+    primary_registry = MetricsRegistry()
+    standby_registry = MetricsRegistry()
+    hooks = MasterChaosHooks(plan, registry=primary_registry)
+    backends = [
+        FaultyBackend(
+            MockBackend(
+                load_seconds=0.004,
+                save_seconds=0.004,
+                render_seconds=render_seconds,
+            ),
+            controllers[slot],
+        )
+        for slot in range(plan.workers)
+    ]
+    failover_stats: dict[str, Any] = {}
+    started = time.time()
+    with _timing_overrides(plan.timings):
+        master_trace, worker_traces, manager, workers = asyncio.run(
+            asyncio.wait_for(
+                _failover_run(
+                    job,
+                    plan,
+                    backends,
+                    controllers,
+                    hooks,
+                    registries,
+                    primary_registry,
+                    standby_registry,
+                    ledger_directory,
+                    failover_stats,
+                ),
+                timeout,
+            )
+        )
+
+    artifacts: dict[str, str] = {}
+    if results_directory is not None:
+        results_directory = Path(results_directory)
+        results_directory.mkdir(parents=True, exist_ok=True)
+        prefix = results_directory / (
+            f"failover-{plan.seed}-{plan.fingerprint()}"
+        )
+        trace_path, metrics_path, cluster_trace_path = (
+            local_harness.save_obs_artifacts(prefix, manager, workers)
+        )
+        artifacts = {
+            "trace_events": str(trace_path),
+            "metrics": str(metrics_path),
+            "cluster_trace": str(cluster_trace_path),
+        }
+        cluster_trace_document = json.loads(
+            Path(cluster_trace_path).read_text(encoding="utf-8")
+        )
+    else:
+        from tpu_render_cluster.obs import merge_timeline
+
+        cluster_trace_document = merge_timeline(
+            manager.cluster_timeline_processes()
+        )
+
+    violations = check_failover_invariants(
+        manager, plan, cluster_trace_document=cluster_trace_document
+    )
+    from tpu_render_cluster.chaos.invariants import ledger_stats
+
+    stats: dict[str, Any] = {
+        "frames_total": len(manager.state.frames),
+        "tiles_per_frame": job.tiles_per_frame(),
+        "job_seconds": master_trace.job_finish_time - master_trace.job_start_time,
+        "wall_seconds": time.time() - started,
+        "worker_traces_collected": len(worker_traces),
+        "failover": failover_stats,
+        "ledger": {
+            **ledger_stats(manager.metrics.snapshot()),
+            "stale_epoch_results": manager.state.ledger["stale_epoch_results"],
+        },
+        "primary_ledger": ledger_stats(primary_registry.snapshot()),
+        "unit_latency": unit_latency_stats(manager.state.unit_seconds),
+    }
+    return ChaosReport(
+        plan=plan, violations=violations, stats=stats, artifacts=artifacts
+    )

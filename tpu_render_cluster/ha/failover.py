@@ -1,0 +1,171 @@
+"""Master failover: turning a ledger replay back into live master state.
+
+A standby (or restarted) master recovers in three steps, all of which
+reuse machinery that already exists for other reasons:
+
+1. **Replay** — ``JobLedger.open`` bumps the epoch and replays the
+   journal; ``apply_ledger_to_state`` marks every recorded-finished unit
+   in the fresh ``ClusterManagerState`` so only the remainder is
+   dispatched (the same transition ``--resume``'s output scan uses).
+2. **Adoption** — live workers reconnect through their existing backoff
+   path; the epoch piggybacked on the handshake tells them this is a new
+   incarnation, so they re-announce as fresh sessions (dropping stale
+   queue state) and receive the active jobs' ``event_job-started``
+   replays through the late-joiner path.
+3. **Fencing** — results of the predecessor's assignments arrive stamped
+   with the old epoch and are counted + refused by the worker-handle
+   dedup seam; the units they would have finished are simply re-rendered,
+   and the exactly-once equation holds per incarnation:
+   ``replayed + (ok - duplicates) == units_total``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import TYPE_CHECKING
+
+from tpu_render_cluster.jobs.tiles import WorkUnit
+
+if TYPE_CHECKING:
+    from tpu_render_cluster.ha.ledger import LedgerReplay
+    from tpu_render_cluster.master.state import ClusterManagerState
+
+logger = logging.getLogger(__name__)
+
+
+def apply_ledger_to_state(
+    state: "ClusterManagerState",
+    replay: "LedgerReplay",
+    *,
+    include_closed: bool = False,
+) -> tuple[int, list[int]]:
+    """Mark the replay's finished units in a fresh frame table.
+
+    Returns ``(replayed_units, frames_needing_stitch)``: the second is
+    the tiled-job recovery edge — frames whose every tile the ledger
+    recorded finished but whose ASSEMBLY record never landed (the crash
+    hit between the last tile and the stitch); the caller re-schedules
+    those stitches on the standby, reading the tile files the workers
+    already wrote. Units the ledger knows but the job no longer defines
+    (an edited job file) are skipped with a warning rather than trusted.
+
+    Only OPEN generations are credited by default: a ledger entry whose
+    lifecycle already closed (finished/cancelled) belongs to a previous
+    submission that merely shares the name — a fresh same-named job must
+    render from scratch. ``include_closed=True`` is the explicit
+    ``--resume`` contract: continue THIS job wherever the ledger left it,
+    even if it completed.
+    """
+    entry = replay.job(state.job.job_name)
+    if entry is None or (entry.status != "started" and not include_closed):
+        return 0, []
+    replayed = 0
+    needs_stitch: list[int] = []
+    skipped = 0
+    for frame_index, tile in sorted(
+        entry.finished_units, key=lambda u: (u[0], -1 if u[1] is None else u[1])
+    ):
+        unit = WorkUnit(frame_index, tile)
+        if unit not in state.frames:
+            skipped += 1
+            continue
+        frame_completed = state.mark_frame_as_finished(unit)
+        replayed += 1
+        if frame_completed and state.job.tile_grid is not None:
+            if frame_index in entry.assembled_frames:
+                state.note_frame_assembled(frame_index)
+            else:
+                needs_stitch.append(frame_index)
+    if skipped:
+        logger.warning(
+            "Ledger replay for %r: %d recorded unit(s) are not in the "
+            "job's current unit table; ignored.",
+            state.job.job_name,
+            skipped,
+        )
+    if replayed:
+        logger.info(
+            "Ledger replay for %r: %d/%d unit(s) already finished"
+            "%s.",
+            state.job.job_name,
+            replayed,
+            len(state.frames),
+            f", {len(needs_stitch)} frame(s) need re-stitching"
+            if needs_stitch
+            else "",
+        )
+    return replayed, needs_stitch
+
+
+def adopt_ledger(
+    state: "ClusterManagerState",
+    ledger,
+    *,
+    metrics=None,
+    include_closed: bool = False,
+    spec: dict | None = None,
+    job_id: str | None = None,
+    weight: float = 1.0,
+    priority: int = 0,
+) -> tuple[int, list[int]]:
+    """The full recovery sequence for one job joining a ledgered master:
+    replay application, replayed-unit accounting, sink attachment (AFTER
+    replay, so restored units are not re-journaled), and the status-gated
+    ``job_started`` append (only when the journal holds no OPEN
+    generation of this name). One helper, shared by the single-job
+    master's construction and the scheduler's admission, so the
+    ordering invariants cannot drift between them. Returns
+    ``(replayed_units, frames_needing_stitch)``.
+    """
+    replayed, needs_stitch = apply_ledger_to_state(
+        state, ledger.replay, include_closed=include_closed
+    )
+    if replayed and metrics is not None:
+        metrics.counter(
+            "ha_ledger_replayed_units_total",
+            "Units restored as finished from ledger replay instead of "
+            "being re-rendered",
+        ).inc(replayed)
+    attach_ledger_sinks(state, ledger)
+    entry = ledger.replay.job(state.job.job_name)
+    if entry is None or (entry.status != "started" and not include_closed):
+        ledger.append_job_started(
+            state.job.job_name,
+            spec=spec,
+            job_id=job_id,
+            weight=weight,
+            priority=priority,
+        )
+    return replayed, needs_stitch
+
+
+def attach_ledger_sinks(
+    state: "ClusterManagerState", ledger, *, metrics=None
+) -> None:
+    """Journal the state's exactly-once transitions from here on.
+
+    Must run AFTER ``apply_ledger_to_state`` — replayed units must not be
+    re-journaled. Append failures are logged, not raised: a full disk
+    degrades failover durability (those units re-render after a crash),
+    it must not kill the running job mid-event."""
+    job_name = state.job.job_name
+
+    def on_unit_finished(unit: WorkUnit) -> None:
+        try:
+            ledger.append_unit_finished(job_name, unit.frame_index, unit.tile)
+        except OSError as e:
+            logger.error("Ledger append failed for unit %s: %s", unit.label, e)
+
+    def on_frame_assembled(frame_index: int) -> None:
+        try:
+            ledger.append_frame_assembled(job_name, frame_index)
+        except OSError as e:
+            logger.error(
+                "Ledger append failed for assembled frame %d: %s",
+                frame_index,
+                e,
+            )
+
+    state.on_unit_finished = on_unit_finished
+    if state.job.tile_grid is not None:
+        state.on_frame_assembled = on_frame_assembled

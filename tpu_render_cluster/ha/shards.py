@@ -1,0 +1,291 @@
+"""Shard router: one control-plane front end over N master shards.
+
+The single master ceilings out (NORTHSTAR.md: 3,233 assignments/s at 160
+workers) because one event loop serializes every dispatch RPC, result
+event, and scheduler tick. Sharding splits the control plane
+horizontally: N independent ``master serve`` processes (shards), each
+owning a SLICE of the worker pool (workers connect to their shard's
+worker port directly — the router never touches the render-traffic
+path), with this router as the single submission endpoint.
+
+The router speaks the same JSON-lines protocol as ``sched/control.py``
+(so ``python -m tpu_render_cluster.sched.submit`` and shell scripts work
+unchanged against it) and routes:
+
+- ``submit`` — stable-hashes the job name (crc32, deterministic across
+  processes and runs) onto a shard and forwards; the returned job id is
+  prefixed ``s<shard>/`` so later ops route without a lookup table;
+- ``status``/``cancel`` with a ``s<shard>/job-NNNN`` id — routed to the
+  owning shard (the prefix is stripped before forwarding);
+- ``status`` (global), ``alerts``, ``drain``, ``ping`` — fanned out to
+  every shard and aggregated under ``shards``.
+
+CLI::
+
+    python -m tpu_render_cluster.ha.shards --controlPort 9900 \\
+        --shards 127.0.0.1:9902,127.0.0.1:9912
+
+Shard health is the operator's concern (each shard exposes its own
+``/healthz``); a shard that is down answers requests routed to it with
+``ok: false`` and an explanatory error instead of taking the router down.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import sys
+import zlib
+from typing import Any
+
+from tpu_render_cluster.obs import MetricsRegistry, get_registry
+from tpu_render_cluster.sched.control import MAX_LINE_BYTES, control_request
+
+logger = logging.getLogger(__name__)
+
+
+def shard_for_job_name(job_name: str, shard_count: int) -> int:
+    """Deterministic job->shard placement (crc32: stable across Python
+    processes, unlike ``hash``, so a resubmitted or re-routed status
+    query lands on the same shard every time)."""
+    if shard_count <= 0:
+        raise ValueError("shard_count must be positive")
+    return zlib.crc32(job_name.encode("utf-8")) % shard_count
+
+
+def split_routed_job_id(job_id: str) -> tuple[int, str] | None:
+    """``"s2/job-0007"`` -> ``(2, "job-0007")``; None when unprefixed."""
+    if not job_id.startswith("s"):
+        return None
+    head, sep, rest = job_id.partition("/")
+    if not sep or not rest:
+        return None
+    try:
+        return int(head[1:]), rest
+    except ValueError:
+        return None
+
+
+class ShardRouter:
+    """Routing logic over a list of shard control endpoints."""
+
+    def __init__(
+        self,
+        shards: list[tuple[str, int]],
+        *,
+        timeout: float = 30.0,
+        metrics: MetricsRegistry | None = None,
+    ) -> None:
+        if not shards:
+            raise ValueError("ShardRouter needs at least one shard")
+        self.shards = shards
+        self.timeout = timeout
+        self.metrics = metrics if metrics is not None else get_registry()
+        self._requests = self.metrics.counter(
+            "ha_router_requests_total",
+            "Control requests through the shard router, by op and shard "
+            "('all' for fan-outs)",
+            labels=("op", "shard"),
+        )
+        self._routed_jobs = self.metrics.counter(
+            "ha_router_jobs_routed_total",
+            "Submissions hashed onto each shard",
+            labels=("shard",),
+        )
+
+    def shard_for(self, job_name: str) -> int:
+        return shard_for_job_name(job_name, len(self.shards))
+
+    async def _forward(
+        self, shard: int, request: dict[str, Any]
+    ) -> dict[str, Any]:
+        host, port = self.shards[shard]
+        try:
+            return await control_request(
+                host, port, request, timeout=self.timeout
+            )
+        except (OSError, ValueError, ConnectionError, asyncio.TimeoutError) as e:
+            return {
+                "ok": False,
+                "error": f"shard {shard} ({host}:{port}) unreachable: {e}",
+                "shard": shard,
+            }
+
+    async def _fan_out(self, request: dict[str, Any]) -> list[dict[str, Any]]:
+        return list(
+            await asyncio.gather(
+                *(self._forward(i, request) for i in range(len(self.shards)))
+            )
+        )
+
+    async def handle_request(self, request: dict[str, Any]) -> dict[str, Any]:
+        op = request.get("op")
+        if op == "submit":
+            spec = request.get("spec") or {}
+            job_name = ((spec.get("job") or {}).get("job_name"))
+            if not isinstance(job_name, str) or not job_name:
+                return {"ok": False, "error": "submit spec has no job_name"}
+            shard = self.shard_for(job_name)
+            self._requests.inc(op="submit", shard=str(shard))
+            self._routed_jobs.inc(shard=str(shard))
+            response = await self._forward(shard, request)
+            if response.get("ok") and isinstance(response.get("job_id"), str):
+                # Prefix the shard so every later op routes statelessly.
+                response = {
+                    **response,
+                    "job_id": f"s{shard}/{response['job_id']}",
+                    "shard": shard,
+                }
+            return response
+        if op in ("status", "cancel") and isinstance(request.get("job_id"), str):
+            routed = split_routed_job_id(request["job_id"])
+            if routed is None:
+                return {
+                    "ok": False,
+                    "error": f"job_id {request['job_id']!r} is not shard-"
+                    "routed (expected 's<shard>/job-NNNN')",
+                }
+            shard, inner_id = routed
+            if not 0 <= shard < len(self.shards):
+                return {"ok": False, "error": f"unknown shard in job_id: {shard}"}
+            self._requests.inc(op=str(op), shard=str(shard))
+            return await self._forward(shard, {**request, "job_id": inner_id})
+        if op in ("status", "alerts", "drain", "ping"):
+            # Global fan-out, aggregated per shard.
+            self._requests.inc(op=str(op), shard="all")
+            responses = await self._fan_out(request)
+            return {
+                "ok": all(r.get("ok") for r in responses),
+                "shards": {str(i): r for i, r in enumerate(responses)},
+            }
+        return {"ok": False, "error": f"unknown op: {op!r}"}
+
+
+class ShardRouterServer:
+    """JSON-lines TCP front end over a ``ShardRouter`` (the shard-side
+    twin of ``sched/control.py``'s ``ControlServer``)."""
+
+    def __init__(
+        self, router: ShardRouter, host: str = "127.0.0.1", port: int = 0
+    ) -> None:
+        self.router = router
+        self.host = host
+        self.port = port
+        self._server: asyncio.Server | None = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_connection, self.host, self.port, limit=MAX_LINE_BYTES
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info(
+            "Shard router listening on %s:%d over %d shard(s)",
+            self.host,
+            self.port,
+            len(self.router.shards),
+        )
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 5.0)
+            except asyncio.TimeoutError:
+                logger.warning("Shard router close timed out.")
+
+    async def _on_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        peer = writer.get_extra_info("peername")
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                try:
+                    request = json.loads(line)
+                    if not isinstance(request, dict):
+                        raise ValueError("request must be a JSON object")
+                except (json.JSONDecodeError, ValueError) as e:
+                    response: dict[str, Any] = {
+                        "ok": False,
+                        "error": f"bad request: {e}",
+                    }
+                else:
+                    response = await self.router.handle_request(request)
+                writer.write(json.dumps(response).encode("utf-8") + b"\n")
+                await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        except Exception as e:  # noqa: BLE001 - one client must not kill routing
+            logger.warning("Router connection from %s failed: %s", peer, e)
+        finally:
+            writer.close()
+
+
+def parse_shard_list(text: str) -> list[tuple[str, int]]:
+    """``"h1:9902,h2:9902"`` -> ``[("h1", 9902), ("h2", 9902)]``."""
+    shards: list[tuple[str, int]] = []
+    for chunk in text.split(","):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        host, sep, port = chunk.rpartition(":")
+        if not sep:
+            raise ValueError(f"shard {chunk!r} is not host:port")
+        shards.append((host, int(port)))
+    if not shards:
+        raise ValueError("no shards given")
+    return shards
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="trc-shard-router",
+        description="JSON-lines control front end hashing jobs across "
+        "master shards",
+    )
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument(
+        "--controlPort", dest="control_port", type=int, default=9900
+    )
+    parser.add_argument(
+        "--shards",
+        required=True,
+        help="Comma-separated host:port control endpoints, one per master "
+        "shard (the `master serve --controlPort` addresses).",
+    )
+    parser.add_argument("--timeout", type=float, default=30.0)
+    return parser
+
+
+async def serve(args: argparse.Namespace) -> int:
+    router = ShardRouter(
+        parse_shard_list(args.shards), timeout=args.timeout
+    )
+    server = ShardRouterServer(router, args.host, args.control_port)
+    await server.start()
+    print(
+        f"Shard router on {args.host}:{server.port} over "
+        f"{len(router.shards)} shard(s): "
+        + ", ".join(f"s{i}={h}:{p}" for i, (h, p) in enumerate(router.shards))
+    )
+    try:
+        await asyncio.Event().wait()  # serve until interrupted
+    finally:
+        await server.stop()
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return asyncio.run(serve(args))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -100,9 +100,19 @@ class ClusterManager:
         dispatch_delay_fn=None,
         output_base_directory: str | Path | None = None,
         telemetry_port: int | None = None,
+        ledger=None,
+        ledger_resume: bool = False,
     ) -> None:
         self.host = host
         self.port = port
+        # Write-ahead job ledger (ha/ledger.py; None = the reference
+        # single-incarnation behavior, byte-identical wire traffic). When
+        # set, the master stamps the ledger's epoch on handshakes and
+        # queue-adds, journals every unit-finished/frame-assembled
+        # transition, and — on a restart/standby takeover — starts from
+        # the replayed finished set instead of re-rendering it.
+        self.ledger = ledger
+        self.epoch: int | None = ledger.epoch if ledger is not None else None
         # ``job=None`` is the SERVICE mode used by the multi-job scheduler
         # subclass (sched/manager.py JobManager): no frame table exists at
         # construction; per-job states are created at admission and looked
@@ -194,6 +204,25 @@ class ClusterManager:
         )
         self._job_started = False
         self._server: asyncio.Server | None = None
+        # Frames a previous incarnation finished every tile of but never
+        # stitched (crash between last tile and assembly): re-scheduled
+        # once the job starts, from the tile files already on disk.
+        self._replay_stitch_frames: list[int] = []
+        self.replayed_units = 0
+        if self.ledger is not None and self.state is not None:
+            from tpu_render_cluster.ha.failover import adopt_ledger
+
+            # Open generations always restore (a standby resuming an
+            # in-flight job); closed ones only under the explicit
+            # ``--resume`` contract — a plain re-run of a completed job
+            # starts a fresh generation and renders from scratch.
+            self.replayed_units, self._replay_stitch_frames = adopt_ledger(
+                self.state,
+                self.ledger,
+                metrics=self.metrics,
+                include_closed=ledger_resume,
+                spec=job.to_dict(),
+            )
 
     # -- multi-job hooks (overridden by sched/manager.py JobManager) --------
 
@@ -235,12 +264,15 @@ class ClusterManager:
             await self.telemetry.start()
 
     def _healthz_view(self) -> dict:
-        return {
+        view = {
             "role": "master",
             "workers_connected": len(self.workers),
             "workers_live": len(self.live_workers()),
             "job_started": self._job_started,
         }
+        if self.epoch is not None:
+            view["epoch"] = self.epoch
+        return view
 
     async def _shutdown_server(self) -> None:
         """Stop the writer, cancel, close worker sockets, close the server."""
@@ -258,6 +290,11 @@ class ClusterManager:
             await asyncio.wait_for(self._server.wait_closed(), 5.0)
         except asyncio.TimeoutError:
             logger.warning("Server close timed out; continuing shutdown.")
+        if self.ledger is not None:
+            try:
+                self.ledger.close()
+            except OSError as e:
+                logger.warning("Ledger close failed: %s", e)
 
     async def initialize_server_and_run_job(
         self,
@@ -429,8 +466,20 @@ class ClusterManager:
             ws.abort()
 
     async def _perform_handshake(self, ws: WebSocketConnection) -> None:
+        if self.cancellation.is_cancelled():
+            # Shutting down (or crashed and being torn down): a reconnect
+            # accepted NOW would swap a live socket into a handle whose
+            # reader tasks are already stopped, parking the worker on an
+            # open-but-dead connection instead of letting it fail over.
+            ws.abort()
+            return
+        # The optional epoch tells a reconnecting worker whether this is
+        # the incarnation it lost (resume the session) or a successor
+        # (re-announce fresh); epoch-less masters stay byte-identical.
         await ws.send_text(
-            pm.encode_message(pm.MasterHandshakeRequest(PROTOCOL_VERSION))
+            pm.encode_message(
+                pm.MasterHandshakeRequest(PROTOCOL_VERSION, epoch=self.epoch)
+            )
         )
         response = pm.decode_message(await ws.receive_text())
         if not isinstance(response, pm.WorkerHandshakeResponse):
@@ -455,6 +504,12 @@ class ClusterManager:
                 ws.abort()
                 return
             worker = self.workers[response.worker_id]
+            if self.cancellation.is_cancelled():
+                # Teardown raced the handshake: the handle's reader tasks
+                # are stopping, so adopting this socket would strand the
+                # worker — abort and let it retry against our successor.
+                ws.abort()
+                return
             worker.connection.replace_inner_connection(ws)
             self.metrics.counter(
                 "master_worker_reconnects_total",
@@ -494,6 +549,7 @@ class ClusterManager:
             state_resolver=self._state_for_job,
             on_frame_complete=self.assembly.schedule,
             on_unit_latency=self.slo.observe_unit_latency,
+            epoch=self.epoch,
         )
         self.workers[worker_id] = worker
         worker.start()
@@ -586,6 +642,13 @@ class ClusterManager:
         self._job_started = True
         for worker in self.live_workers():
             await worker.send_job_started()
+        if self._replay_stitch_frames:
+            # Tiled failover edge: every tile of these frames landed under
+            # the predecessor but the stitch never did — re-schedule it
+            # from the tile files on disk before new results interleave.
+            for frame_index in self._replay_stitch_frames:
+                self.assembly.schedule(self.state, frame_index)
+            self._replay_stitch_frames = []
 
         self.metrics.gauge(
             "master_job_units", "Work units in the job's frame table"
@@ -659,6 +722,11 @@ class ClusterManager:
         finish = time.time()
         if not self.state.all_frames_finished():
             raise RuntimeError("Strategy exited before all frames finished.")
+        if self.ledger is not None:
+            try:
+                self.ledger.append_job_finished(self.job.job_name)
+            except OSError as e:
+                logger.error("Ledger job-finished append failed: %s", e)
         logger.info("All frames finished in %.2f s.", finish - start)
         return MasterTrace(job_start_time=start, job_finish_time=finish)
 

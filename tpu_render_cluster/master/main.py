@@ -39,6 +39,17 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--port", type=int, default=9901)
     parser.add_argument("--logFilePath", dest="log_file_path", default=None)
     parser.add_argument(
+        "--ledger",
+        dest="ledger_directory",
+        default=None,
+        help="Write-ahead job ledger directory (replicated control plane): "
+        "job lifecycle + unit-finished transitions are journaled (fsync'd, "
+        "segmented, snapshot-compacted) so a restarted or standby master "
+        "replays them, re-adopts live workers, and fences stale traffic "
+        "with a monotonic epoch. Defaults to the TRC_HA_LEDGER environment "
+        "variable; omit both to run ledger-less (reference behavior).",
+    )
+    parser.add_argument(
         "--telemetryPort",
         dest="telemetry_port",
         type=int,
@@ -114,6 +125,27 @@ def resolved_telemetry_port(args: argparse.Namespace) -> int | None:
     return resolve_telemetry_port(args.telemetry_port, "TRC_OBS_PORT")
 
 
+def open_ledger(args: argparse.Namespace):
+    """``--ledger`` flag, else ``TRC_HA_LEDGER``, else None (no journal).
+
+    Opening claims the directory for this incarnation: the epoch is
+    bumped + persisted and any torn tail from a previous crash repaired
+    before the first append."""
+    import os
+
+    from tpu_render_cluster.ha.ledger import JobLedger
+
+    directory = args.ledger_directory or os.environ.get("TRC_HA_LEDGER")
+    if not directory:
+        return None
+    ledger = JobLedger.open(directory)
+    print(
+        f"Job ledger at {directory}: epoch {ledger.epoch}, "
+        f"{ledger.replay.records} record(s) replayed."
+    )
+    return ledger
+
+
 async def serve_command(args: argparse.Namespace) -> int:
     from tpu_render_cluster.sched.control import ControlServer
     from tpu_render_cluster.sched.manager import JobManager
@@ -123,13 +155,41 @@ async def serve_command(args: argparse.Namespace) -> int:
 
         args.results_directory = str(DEFAULT_RESULTS_DIR)
     results_directory = Path(args.results_directory)
+    ledger = open_ledger(args)
     manager = JobManager(
         args.host,
         args.port,
         metrics_snapshot_path=results_directory / "metrics-live.json",
         output_base_directory=args.base_directory,
         telemetry_port=resolved_telemetry_port(args),
+        ledger=ledger,
     )
+    if ledger is not None:
+        # Re-admit what a previous incarnation left unfinished: the jobs
+        # re-enter the admission queue with their recorded weight/priority
+        # and pick up at the ledger's finished set when admitted.
+        from tpu_render_cluster.sched.models import JobSpec
+
+        for entry in ledger.replay.unfinished_jobs():
+            if entry.job is None:
+                print(
+                    f"warning: ledger job {entry.job_name!r} has no recorded "
+                    "spec; cannot re-admit it.",
+                    file=sys.stderr,
+                )
+                continue
+            job_id = manager.submit(
+                JobSpec(
+                    job=BlenderJob.from_dict(entry.job),
+                    weight=entry.weight,
+                    priority=entry.priority,
+                )
+            )
+            print(
+                f"Ledger: re-admitted unfinished job {entry.job_name!r} "
+                f"as {job_id} ({len(entry.finished_units)} unit(s) already "
+                "finished)."
+            )
     # A restarted service re-learns worker speeds from its own previous
     # shutdown snapshot (explicit TRC_COST_MODEL wins; saved again below).
     from tpu_render_cluster.sched.cost_model import (
@@ -213,6 +273,7 @@ async def run_job_command(args: argparse.Namespace) -> int:
         args.results_directory = str(DEFAULT_RESULTS_DIR)
     job = BlenderJob.load_from_file(args.job_file_path)
     start_time = datetime.now()
+    ledger = open_ledger(args)
     manager = ClusterManager(
         args.host,
         args.port,
@@ -222,11 +283,22 @@ async def run_job_command(args: argparse.Namespace) -> int:
         # output prefix with the same base directory resume does.
         output_base_directory=args.base_directory,
         telemetry_port=resolved_telemetry_port(args),
+        ledger=ledger,
+        ledger_resume=args.resume,
     )
     if args.resume:
         from tpu_render_cluster.master.resume import apply_resume, load_cost_model
 
-        apply_resume(manager.state, job, args.base_directory)
+        # Ledger wins (exact per-unit journal); the output-directory scan
+        # is the fallback for jobs that predate the ledger. The manager
+        # already applied any open-generation replay at construction;
+        # apply_resume is idempotent over it.
+        apply_resume(
+            manager.state,
+            job,
+            args.base_directory,
+            ledger_replay=ledger.replay if ledger is not None else None,
+        )
         # Restore the previous run's learned predictors too (an explicit
         # TRC_COST_MODEL wins over the snapshot — load_cost_model
         # returns None when it is set).
@@ -237,6 +309,15 @@ async def run_job_command(args: argparse.Namespace) -> int:
             # Fully-resumed job: don't block on the worker barrier.
             from tpu_render_cluster.traces.master_trace import MasterTrace
 
+            if ledger is not None:
+                # Close the journal's lifecycle too: the crash this run
+                # resumed from may have hit between the last unit append
+                # and job_finished — leaving the entry "started" would
+                # make every later replay re-admit a completed job.
+                entry = ledger.replay.job(job.job_name)
+                if entry is not None and entry.status == "started":
+                    ledger.append_job_finished(job.job_name)
+                ledger.close()
             print("All frames already rendered; nothing to do.")
             now = time.time()
             trace = MasterTrace(job_start_time=now, job_finish_time=now)

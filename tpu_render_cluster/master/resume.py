@@ -125,9 +125,34 @@ def apply_resume(
     state: ClusterManagerState,
     job: BlenderJob,
     base_directory: Path | str | None = None,
+    *,
+    ledger_replay=None,
 ) -> int:
-    """Marks already-rendered frames finished; returns how many were skipped."""
+    """Marks already-rendered work finished; returns how many units were
+    restored.
+
+    Unified with the write-ahead ledger (ha/ledger.py): when a ledger
+    replay holds finished-unit records for this job, the LEDGER wins —
+    it is exact (per unit, per tile, fsync'd at result time) where the
+    output scan is approximate (frame-level, fooled by half-written or
+    stale files). The directory scan remains the fallback for jobs that
+    ran before any ledger existed.
+    """
     from tpu_render_cluster.jobs.tiles import WorkUnit
+
+    if ledger_replay is not None and ledger_replay.finished_units(job.job_name):
+        from tpu_render_cluster.ha.failover import apply_ledger_to_state
+
+        replayed, _ = apply_ledger_to_state(
+            state, ledger_replay, include_closed=True
+        )
+        logger.info(
+            "Resume: %d/%d unit(s) restored from the job ledger "
+            "(output-directory scan skipped — the ledger is authoritative).",
+            replayed,
+            len(state.frames),
+        )
+        return replayed
 
     rendered = scan_rendered_frames(job, base_directory)
     for frame_index in sorted(rendered):

@@ -122,7 +122,16 @@ class ClusterManagerState:
             "duplicate_results": 0,
             "late_results": 0,
             "stale_results": 0,
+            # Results refused because they carry a PREVIOUS master
+            # incarnation's epoch (ha/: the fencing half of failover).
+            "stale_epoch_results": 0,
         }
+        # Write-ahead ledger sinks (ha/ledger.py, wired by a ledger-backed
+        # master AFTER replay application so replayed units are not
+        # re-journaled): called exactly once per unit/frame, on the same
+        # transitions the in-memory ledger meters.
+        self.on_unit_finished = None
+        self.on_frame_assembled = None
         # Per-frame assembly ledger (tiled jobs): frame -> the set of tile
         # indices whose units reached FINISHED. A frame is assembly-ready
         # when the set reaches ``tiles_per_frame`` — each tile lands in it
@@ -268,6 +277,8 @@ class ClusterManagerState:
             return False
         record.status = FrameStatus.FINISHED
         self._finished_count += 1
+        if self.on_unit_finished is not None:
+            self.on_unit_finished(unit)
         if self._tiles_per_frame == 1:
             return True
         landed = self._assembly.setdefault(unit.frame_index, set())
@@ -279,6 +290,8 @@ class ClusterManagerState:
         # Fully-landed frames leave the partial map so the ghost-frame
         # audit is O(frames in flight), not O(job).
         self._assembly.pop(frame_index, None)
+        if self.on_frame_assembled is not None:
+            self.on_frame_assembled(frame_index)
 
     def return_frame_to_pending(self, unit: "WorkUnit | int") -> None:
         """Unit comes back to the pool (steal succeeded, render errored,

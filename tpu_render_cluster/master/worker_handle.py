@@ -91,6 +91,11 @@ def heartbeat_pong_retries() -> int:
 class WorkerHandle:
     """One connected worker, as seen by the master."""
 
+    # Class-level defaults so partially-constructed handles (tests build
+    # them attribute-by-attribute) behave like epoch-less production ones.
+    epoch: int | None = None
+    _shutdown_started = False
+
     def __init__(
         self,
         worker_id: int,
@@ -107,9 +112,15 @@ class WorkerHandle:
         | None = None,
         on_unit_latency: Callable[[ClusterManagerState, WorkUnit, float], None]
         | None = None,
+        epoch: int | None = None,
     ) -> None:
         self.worker_id = worker_id
         self.connection = connection
+        # Master incarnation epoch (ha/ledger.py; None without a ledger):
+        # stamped on every queue-add and checked against the epoch echoed
+        # by incoming frame events — an event fenced to a PREVIOUS
+        # incarnation is counted and refused, never applied.
+        self.epoch = epoch
         # Single-job masters pass the one state; the multi-job scheduler
         # passes ``state=None`` plus a resolver mapping the ``job_name``
         # every worker event carries to the owning job's state (None for
@@ -123,6 +134,9 @@ class WorkerHandle:
         # True when is_dead was reached via the graceful goodbye path
         # (counted as a drain, not an eviction).
         self.drained = False
+        # Set by shutdown(): failures observed past this point are our
+        # own teardown, not worker death (no eviction accounting).
+        self._shutdown_started = False
         # Chaos shim: seconds to stall before dispatching a given frame's
         # queue-add RPC (no-op when None — the production default).
         self._dispatch_delay_fn = dispatch_delay_fn
@@ -198,6 +212,10 @@ class WorkerHandle:
             self._heartbeat_task.cancel()
 
     async def shutdown(self) -> None:
+        # An in-flight heartbeat send racing this teardown fails with
+        # "sender closed" — that is US closing, not the worker dying, and
+        # must not count an eviction (or requeue frames) on the way out.
+        self._shutdown_started = True
         self.cancel_heartbeat()
         if self._events_task is not None:
             self._events_task.cancel()
@@ -206,7 +224,7 @@ class WorkerHandle:
         self.connection.close()
 
     async def _mark_dead(self, reason: str) -> None:
-        if self.is_dead:
+        if self.is_dead or self._shutdown_started:
             return
         self.is_dead = True
         self.logger.warning("Worker marked dead: %s", reason)
@@ -365,7 +383,8 @@ class WorkerHandle:
         # frame starts a new causal chain with its own Perfetto flow.
         trace = pm.TraceContext.new(state.trace_id)
         request = pm.MasterFrameQueueAddRequest.new(
-            job, frame_index, trace=trace, job_id=job_id, tile=unit.tile
+            job, frame_index, trace=trace, job_id=job_id, tile=unit.tile,
+            epoch=self.epoch,
         )
         rpc_started = time.perf_counter()
         rpc_started_wall = time.time()
@@ -593,6 +612,45 @@ class WorkerHandle:
         if state is not None and ledger_key is not None:
             state.ledger[ledger_key] += 1
 
+    def _refuse_stale_epoch(
+        self, event: "pm.WorkerFrameQueueItemRenderingEvent | pm.WorkerFrameQueueItemFinishedEvent", kind: str
+    ) -> bool:
+        """True when the event is fenced out: it echoes an epoch that is
+        not this master incarnation's. The result/render DID happen under
+        a predecessor, but this master holds no assignment context for it
+        (the worker re-announced fresh and its old session's queue state
+        was dropped), so applying it would corrupt the frame table; the
+        ledger-replayed finished set plus re-dispatch of the remainder is
+        the recovery path. Counted in the metrics AND the owning job's
+        in-memory ledger, exactly like the other dedup-seam refusals.
+        This runs on the master's hottest path (every worker event), so
+        everything beyond the three comparisons — including the log
+        label — is built only on the rare refusal."""
+        if (
+            self.epoch is None
+            or event.epoch is None
+            or event.epoch == self.epoch
+        ):
+            return False
+        if self.metrics is not None:
+            self.metrics.counter(
+                "master_stale_epoch_events_total",
+                "Worker frame events refused because they echo a previous "
+                "master incarnation's epoch",
+            ).inc()
+        state = self._state_for(event.job_name)
+        if state is not None:
+            state.ledger["stale_epoch_results"] += 1
+        self.logger.warning(
+            "Refused %s event for unit %s with stale epoch %d "
+            "(current epoch %d).",
+            kind,
+            WorkUnit(event.frame_index, event.tile).label,
+            event.epoch,
+            self.epoch,
+        )
+        return True
+
     def _is_current_assignment(self, record) -> bool:
         """Does this worker own the frame's LIVE assignment right now?
 
@@ -633,6 +691,8 @@ class WorkerHandle:
     def _apply_rendering_event(
         self, event: pm.WorkerFrameQueueItemRenderingEvent
     ) -> None:
+        if self._refuse_stale_epoch(event, "rendering"):
+            return
         unit = WorkUnit(event.frame_index, event.tile)
         state = self._state_for(event.job_name)
         # Keep the mirror honest even for a defunct job: a unit that
@@ -686,6 +746,12 @@ class WorkerHandle:
     def _apply_finished_event(
         self, event: pm.WorkerFrameQueueItemFinishedEvent
     ) -> None:
+        # Fencing runs before ANY accounting or mirror mutation: a
+        # stale-epoch result must not touch the ok/duplicate counters (the
+        # exactly-once equation is per incarnation) and must not close a
+        # flow this incarnation never opened.
+        if self._refuse_stale_epoch(event, "finished"):
+            return
         received_wall = time.time()
         received_mono = time.perf_counter()
         unit = WorkUnit(event.frame_index, event.tile)

@@ -113,6 +113,28 @@ def _job_id_from_payload(payload: dict[str, Any]) -> str | None:
     return job_id
 
 
+def _epoch_from_payload(payload: dict[str, Any]) -> int | None:
+    """Decode the optional ``epoch`` key (piggyback idiom: absent -> None).
+
+    The monotonic master-incarnation counter of the replicated control
+    plane (PROTOCOL.md §Epoch fencing & failover): a ledger-backed master
+    stamps its epoch on the handshake request and every queue-add, and
+    (Python) workers echo it on their frame events, so a master that took
+    over after a failover can refuse results belonging to a predecessor's
+    assignments instead of silently applying them. Masters without a
+    ledger never set it — their traffic stays byte-identical to the
+    reference, and C++ peers route unmodified.
+    """
+    epoch = payload.get("epoch")
+    if epoch is None:
+        return None
+    if isinstance(epoch, bool) or not isinstance(epoch, int):
+        raise ValueError("epoch must be an integer")
+    if epoch < 0:
+        raise ValueError(f"epoch must be >= 0, got {epoch}")
+    return epoch
+
+
 def _tile_from_payload(payload: dict[str, Any]) -> int | None:
     """Decode the optional ``tile`` key (piggyback idiom: absent -> None).
 
@@ -184,13 +206,24 @@ class MasterHandshakeRequest(Message):
 
     type_name: ClassVar[str] = "handshake_request"
     server_version: str
+    # Optional master epoch (replicated control plane, piggyback idiom):
+    # a reconnecting worker that sees a DIFFERENT epoch than the master it
+    # lost knows it is talking to a new incarnation and re-announces as a
+    # fresh session instead of replaying stale queue state into it.
+    epoch: int | None = None
 
     def to_payload(self) -> dict[str, Any]:
-        return {"server_version": self.server_version}
+        out: dict[str, Any] = {"server_version": self.server_version}
+        if self.epoch is not None:
+            out["epoch"] = self.epoch
+        return out
 
     @classmethod
     def from_payload(cls, payload: dict[str, Any]) -> "MasterHandshakeRequest":
-        return cls(server_version=str(payload["server_version"]))
+        return cls(
+            server_version=str(payload["server_version"]),
+            epoch=_epoch_from_payload(payload),
+        )
 
 
 @dataclass(frozen=True)
@@ -248,6 +281,10 @@ class MasterFrameQueueAddRequest(Message):
     job_id: str | None = None
     # Optional sub-frame tile index (tiled jobs only, same idiom).
     tile: int | None = None
+    # Optional master epoch (ledger-backed masters only, same idiom): the
+    # worker stamps its copy and echoes it on the frame's events, fencing
+    # a pre-failover assignment's results out of the successor master.
+    epoch: int | None = None
 
     @classmethod
     def new(
@@ -258,9 +295,11 @@ class MasterFrameQueueAddRequest(Message):
         trace: TraceContext | None = None,
         job_id: str | None = None,
         tile: int | None = None,
+        epoch: int | None = None,
     ) -> "MasterFrameQueueAddRequest":
         return cls(
-            generate_message_request_id(), job, frame_index, trace, job_id, tile
+            generate_message_request_id(), job, frame_index, trace, job_id,
+            tile, epoch,
         )
 
     def to_payload(self) -> dict[str, Any]:
@@ -275,6 +314,8 @@ class MasterFrameQueueAddRequest(Message):
             out["job_id"] = self.job_id
         if self.tile is not None:
             out["tile"] = self.tile
+        if self.epoch is not None:
+            out["epoch"] = self.epoch
         return out
 
     @classmethod
@@ -286,6 +327,7 @@ class MasterFrameQueueAddRequest(Message):
             trace=_trace_from_payload(payload),
             job_id=_job_id_from_payload(payload),
             tile=_tile_from_payload(payload),
+            epoch=_epoch_from_payload(payload),
         )
 
 
@@ -400,6 +442,8 @@ class WorkerFrameQueueItemRenderingEvent(Message):
     job_id: str | None = None
     # Echo of the queue-add request's optional tile index.
     tile: int | None = None
+    # Echo of the queue-add request's optional master epoch (fencing).
+    epoch: int | None = None
 
     def to_payload(self) -> dict[str, Any]:
         out: dict[str, Any] = {
@@ -412,6 +456,8 @@ class WorkerFrameQueueItemRenderingEvent(Message):
             out["job_id"] = self.job_id
         if self.tile is not None:
             out["tile"] = self.tile
+        if self.epoch is not None:
+            out["epoch"] = self.epoch
         return out
 
     @classmethod
@@ -422,6 +468,7 @@ class WorkerFrameQueueItemRenderingEvent(Message):
             trace=_trace_from_payload(payload),
             job_id=_job_id_from_payload(payload),
             tile=_tile_from_payload(payload),
+            epoch=_epoch_from_payload(payload),
         )
 
 
@@ -447,6 +494,10 @@ class WorkerFrameQueueItemFinishedEvent(Message):
     # Echo of the queue-add request's optional tile index: the master's
     # assembly ledger credits the finished TILE, not the whole frame.
     tile: int | None = None
+    # Echo of the queue-add request's optional master epoch: a result
+    # stamped with a predecessor master's epoch is refused (and counted)
+    # by the successor instead of silently applied.
+    epoch: int | None = None
 
     @classmethod
     def new_ok(
@@ -457,10 +508,11 @@ class WorkerFrameQueueItemFinishedEvent(Message):
         trace: TraceContext | None = None,
         job_id: str | None = None,
         tile: int | None = None,
+        epoch: int | None = None,
     ) -> "WorkerFrameQueueItemFinishedEvent":
         return cls(
             job_name, frame_index, FRAME_QUEUE_ITEM_FINISHED_OK, trace=trace,
-            job_id=job_id, tile=tile,
+            job_id=job_id, tile=tile, epoch=epoch,
         )
 
     @classmethod
@@ -473,10 +525,11 @@ class WorkerFrameQueueItemFinishedEvent(Message):
         trace: TraceContext | None = None,
         job_id: str | None = None,
         tile: int | None = None,
+        epoch: int | None = None,
     ) -> "WorkerFrameQueueItemFinishedEvent":
         return cls(
             job_name, frame_index, FRAME_QUEUE_ITEM_FINISHED_ERRORED, reason,
-            trace=trace, job_id=job_id, tile=tile,
+            trace=trace, job_id=job_id, tile=tile, epoch=epoch,
         )
 
     def to_payload(self) -> dict[str, Any]:
@@ -491,6 +544,8 @@ class WorkerFrameQueueItemFinishedEvent(Message):
             out["job_id"] = self.job_id
         if self.tile is not None:
             out["tile"] = self.tile
+        if self.epoch is not None:
+            out["epoch"] = self.epoch
         return out
 
     @classmethod
@@ -504,6 +559,7 @@ class WorkerFrameQueueItemFinishedEvent(Message):
             trace=_trace_from_payload(payload),
             job_id=_job_id_from_payload(payload),
             tile=_tile_from_payload(payload),
+            epoch=_epoch_from_payload(payload),
         )
 
 

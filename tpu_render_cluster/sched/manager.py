@@ -116,6 +116,7 @@ class JobManager(ClusterManager):
         dispatch_delay_fn=None,
         output_base_directory: str | Path | None = None,
         telemetry_port: int | None = None,
+        ledger=None,
     ) -> None:
         super().__init__(
             host,
@@ -127,6 +128,7 @@ class JobManager(ClusterManager):
             dispatch_delay_fn=dispatch_delay_fn,
             output_base_directory=output_base_directory,
             telemetry_port=telemetry_port,
+            ledger=ledger,
         )
         self.config = config if config is not None else SchedulerConfig.from_env()
         self._runs: dict[str, JobRun] = {}  # job_id -> run, submit order
@@ -430,6 +432,24 @@ class JobManager(ClusterManager):
         self._admission.remove(run.job_id)
         run.state = ClusterManagerState(run.spec.job)
         run.state.sched_job_id = run.job_id
+        if self.ledger is not None:
+            # WAL the admission + restore what a predecessor incarnation
+            # already finished of this job (matched by job_name — the wire
+            # routes results by it and active names are unique), then
+            # journal new transitions.
+            from tpu_render_cluster.ha.failover import adopt_ledger
+
+            _replayed, needs_stitch = adopt_ledger(
+                run.state,
+                self.ledger,
+                metrics=self.metrics,
+                spec=run.spec.job.to_dict(),
+                job_id=run.job_id,
+                weight=run.spec.weight,
+                priority=run.spec.priority,
+            )
+            for frame_index in needs_stitch:
+                self.assembly.schedule(run.state, frame_index)
         run.status = JOB_RUNNING
         run.admitted_at = now
         self._running.append(run.job_id)
@@ -467,6 +487,18 @@ class JobManager(ClusterManager):
     def _finish_run(self, run: JobRun, status: str, now: float) -> None:
         run.status = status
         run.finished_at = now
+        if self.ledger is not None and run.state is not None:
+            # Close the job's ledger lifecycle so a restarted service does
+            # not re-admit it (and a later same-name submission starts a
+            # fresh generation). Never-admitted cancels (state None) were
+            # never journaled, so there is nothing to close.
+            try:
+                if status == JOB_FINISHED:
+                    self.ledger.append_job_finished(run.job_name)
+                else:
+                    self.ledger.append_job_cancelled(run.job_name)
+            except OSError as e:
+                logger.error("Ledger job-close append failed: %s", e)
         # Final SLO verdict (deadline judged at the true end; no-op for
         # jobs without objectives or never admitted).
         self.slo.finish_job(run.job_name)

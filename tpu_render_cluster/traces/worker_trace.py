@@ -208,6 +208,15 @@ class WorkerTraceBuilder:
         with self._lock:
             self._job_start_time = ts
 
+    def ensure_job_start_time(self, ts: float) -> None:
+        """Stamp the start time only if no job-started event ever did —
+        the close-out path of a worker that served an idle master (a
+        drained shard with zero jobs) must still produce a buildable
+        trace without clobbering a real job's start."""
+        with self._lock:
+            if self._job_start_time is None:
+                self._job_start_time = ts
+
     def set_job_finish_time(self, ts: float) -> None:
         with self._lock:
             self._job_finish_time = ts

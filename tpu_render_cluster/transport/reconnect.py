@@ -232,7 +232,28 @@ class ReconnectingClient:
                 reconnects += 1
                 if reconnects > reconnect_budget or loop.time() > deadline:
                     raise
-                await self._reconnect(generation, lost_at)
+                while True:
+                    try:
+                        await self._reconnect(generation, lost_at)
+                        break
+                    except WebSocketClosed:
+                        # The reconnect ATTEMPT failed — e.g. the master
+                        # died mid-handshake (TCP accepted, then the
+                        # process was torn down before its
+                        # acknowledgement). That must not kill the op (a
+                        # worker racing a master failover would give up
+                        # exactly when its standby is about to appear),
+                        # and it must not burn the per-op reconnect
+                        # budget either: a dying master can refuse
+                        # handshakes in MILLISECONDS, faster than any
+                        # budget survives. Attempt failures are bounded
+                        # by the op DEADLINE instead, with a short pause
+                        # so refusals don't spin the loop hot.
+                        if self._closed or loop.time() > deadline:
+                            raise
+                        await asyncio.sleep(
+                            min(0.25, backoff_cap_seconds())
+                        )
 
     async def send_text(self, text: str) -> None:
         await self._with_retries(lambda c: c.send_text(text))

@@ -61,6 +61,12 @@ class QueuedFrame:
     # Sub-frame tile index from the queue-add request (None = whole
     # frame); echoed on rendering/finished events.
     tile: int | None = None
+    # Master epoch from the queue-add request (None from epoch-less
+    # masters); echoed on rendering/finished events so a successor master
+    # can fence out a predecessor's assignments after a failover.
+    epoch: int | None = None
+    # Worker-local session generation at queue time (see reset_session).
+    session: int = 0
 
     @property
     def unit(self) -> WorkUnit:
@@ -97,6 +103,11 @@ class WorkerAutomaticQueue:
         )
         self._frames: list[QueuedFrame] = []
         self._finished_indices: set[tuple[str, int, int | None]] = set()
+        # Bumped by reset_session(): a frame queued under a previous
+        # master session that only finishes rendering AFTER the reset
+        # must not re-enter the finished index (the new master may
+        # legitimately re-assign that unit).
+        self._session_generation = 0
         self._task: asyncio.Task | None = None
         self._draining = False
         # Wakes the render loop as soon as work arrives; the 100 ms sleep
@@ -114,6 +125,7 @@ class WorkerAutomaticQueue:
         trace: pm.TraceContext | None = None,
         job_id: str | None = None,
         tile: int | None = None,
+        epoch: int | None = None,
     ) -> None:
         if self._draining:
             # Refuse, don't silently park: the add RPC answers errored and
@@ -121,7 +133,10 @@ class WorkerAutomaticQueue:
             # accepted here after drain() collected the queue would be lost.
             raise RuntimeError("Worker is draining; not accepting new frames.")
         self._frames.append(
-            QueuedFrame(job, frame_index, trace=trace, job_id=job_id, tile=tile)
+            QueuedFrame(
+                job, frame_index, trace=trace, job_id=job_id, tile=tile,
+                epoch=epoch, session=self._session_generation,
+            )
         )
         self._work_available.set()
 
@@ -175,6 +190,32 @@ class WorkerAutomaticQueue:
         ]
         self._frames = [f for f in self._frames if f.state is not FrameState.QUEUED]
         return returned
+
+    def reset_session(self) -> int:
+        """Drop the previous master session's queue state (failover).
+
+        Called when the worker re-announces itself to a NEW master
+        incarnation (epoch change / refused reconnect): the queued-but-
+        not-started frames belong to assignments the new master does not
+        know about, so replaying them would render work nobody tracks.
+        The frame currently RENDERING is left to finish — its finished
+        event carries the OLD epoch and the new master refuses it as
+        stale, which is the fence working as designed. The already-
+        finished index is cleared too: the new master may legitimately
+        re-assign a unit this worker rendered for the predecessor, and an
+        ``already-finished`` answer to a later remove RPC would lie about
+        the NEW assignment. Returns how many queued frames were dropped.
+        """
+        dropped = [f for f in self._frames if f.state is FrameState.QUEUED]
+        self._frames = [
+            f for f in self._frames if f.state is not FrameState.QUEUED
+        ]
+        self._finished_indices.clear()
+        # The frame left mid-RENDER belongs to the OLD session: when it
+        # finishes, it must not re-enter the just-cleared finished index
+        # (the generation check at insert time fences it out).
+        self._session_generation += 1
+        return len(dropped)
 
     # -- render loop ---------------------------------------------------------
 
@@ -230,7 +271,7 @@ class WorkerAutomaticQueue:
         await self._sender.send_message(
             pm.WorkerFrameQueueItemRenderingEvent(
                 job_name, frame.frame_index, trace=frame.trace,
-                job_id=frame.job_id, tile=frame.tile,
+                job_id=frame.job_id, tile=frame.tile, epoch=frame.epoch,
             )
         )
         try:
@@ -250,18 +291,25 @@ class WorkerAutomaticQueue:
             await self._sender.send_message(
                 pm.WorkerFrameQueueItemFinishedEvent.new_errored(
                     job_name, frame.frame_index, str(e), trace=frame.trace,
-                    job_id=frame.job_id, tile=frame.tile,
+                    job_id=frame.job_id, tile=frame.tile, epoch=frame.epoch,
                 )
             )
             return
         self._tracer.trace_new_rendered_frame(frame.frame_index, timing)
         self._observe_frame_phases(frame, timing)
         self._remove(frame)
-        self._finished_indices.add((job_name, frame.frame_index, frame.tile))
+        if frame.session == self._session_generation:
+            # A frame queued under a PREVIOUS master session (failover hit
+            # while it rendered) stays out of the index: the new master
+            # may re-assign this unit, and an "already-finished" answer to
+            # a later remove RPC would lie about the NEW assignment.
+            self._finished_indices.add(
+                (job_name, frame.frame_index, frame.tile)
+            )
         await self._sender.send_message(
             pm.WorkerFrameQueueItemFinishedEvent.new_ok(
                 job_name, frame.frame_index, trace=frame.trace,
-                job_id=frame.job_id, tile=frame.tile,
+                job_id=frame.job_id, tile=frame.tile, epoch=frame.epoch,
             )
         )
 

@@ -37,18 +37,45 @@ TRACE_EVERY_NTH_PING = 8  # reference: worker/src/connection/mod.rs:46
 HANDSHAKE_TIMEOUT = 30.0
 
 
-async def _perform_handshake(
-    ws: WebSocketConnection, worker_id: int, *, is_reconnect: bool
-) -> None:
-    """Client side of the 3-step handshake.
+class ReconnectRefused(WebSocketClosed):
+    """The master refused a RECONNECTING handshake (it does not know this
+    worker — typically a restarted master whose in-memory registry died).
+    The caller retries with a fresh first-connection announce instead of
+    replaying stale session state into a master that never saw it."""
 
-    Reference: worker/src/connection/mod.rs:402-454.
+
+async def _perform_handshake(
+    ws: WebSocketConnection,
+    worker_id: int,
+    *,
+    is_reconnect: bool,
+    last_epoch: int | None = None,
+) -> tuple[int | None, bool]:
+    """Client side of the 3-step handshake; returns ``(epoch, fresh)``.
+
+    Reference: worker/src/connection/mod.rs:402-454, extended with epoch
+    fencing (PROTOCOL.md §Epoch fencing & failover): the master's
+    handshake request optionally carries its ledger epoch. A reconnecting
+    worker that sees a DIFFERENT epoch than the master it lost is talking
+    to a new incarnation — it announces ``first-connection`` (a fresh
+    session) instead of ``reconnecting``, because the new master has no
+    session to resume. ``fresh`` is True when a first-connection announce
+    was sent.
     """
     request = pm.decode_message(await ws.receive_text())
     if not isinstance(request, pm.MasterHandshakeRequest):
         raise WebSocketClosed(f"Expected handshake request, got {type(request)}")
+    announce_fresh = not is_reconnect or request.epoch != last_epoch
+    if is_reconnect and announce_fresh:
+        logger.info(
+            "Master epoch changed (%s -> %s); re-announcing as a fresh session.",
+            last_epoch,
+            request.epoch,
+        )
     handshake_type = (
-        pm.HANDSHAKE_TYPE_RECONNECTING if is_reconnect else pm.HANDSHAKE_TYPE_FIRST_CONNECTION
+        pm.HANDSHAKE_TYPE_FIRST_CONNECTION
+        if announce_fresh
+        else pm.HANDSHAKE_TYPE_RECONNECTING
     )
     await ws.send_text(
         pm.encode_message(
@@ -57,7 +84,13 @@ async def _perform_handshake(
     )
     ack = pm.decode_message(await ws.receive_text())
     if not isinstance(ack, pm.MasterHandshakeAcknowledgement) or not ack.ok:
+        if handshake_type == pm.HANDSHAKE_TYPE_RECONNECTING:
+            # An epoch-less restarted master refuses reconnects from
+            # workers it never met; fall back to a fresh announce on the
+            # next attempt (the master aborts this socket after refusing).
+            raise ReconnectRefused("Master refused the reconnect handshake.")
         raise WebSocketClosed("Master refused the handshake.")
+    return request.epoch, announce_fresh
 
 
 class Worker:
@@ -98,6 +131,38 @@ class Worker:
         self._drain_requested = asyncio.Event()
         self._client: ReconnectingClient | None = None
         self._final_trace: WorkerTrace | None = None
+        # Epoch of the master incarnation this worker last handshook with
+        # (None until the first connect, and forever against epoch-less
+        # masters). A reconnect that lands on a DIFFERENT epoch is a new
+        # master: the worker re-announces fresh and drops stale queue
+        # state instead of replaying it (PROTOCOL.md §Epoch fencing).
+        self._master_epoch: int | None = None
+        # Set when a RECONNECTING handshake was refused: the next attempt
+        # announces first-connection (restarted epoch-less master).
+        self._force_fresh_announce = False
+        self._frame_queue: WorkerAutomaticQueue | None = None
+
+    def _begin_fresh_session(self) -> None:
+        """A reconnect landed on a NEW master incarnation (epoch change or
+        refused reconnect): drop queue state belonging to the lost
+        session. Anything still rendering finishes and is fenced by its
+        old-epoch result; anything merely queued is work the new master
+        will re-dispatch itself (its ledger knows what actually finished).
+        """
+        dropped = 0
+        if self._frame_queue is not None:
+            dropped = self._frame_queue.reset_session()
+        self.metrics.counter(
+            "worker_session_reannounces_total",
+            "Reconnects that re-announced a fresh session to a new master "
+            "incarnation (epoch change or refused reconnect)",
+        ).inc()
+        logger.info(
+            "Fresh session with master (epoch %s); dropped %d stale "
+            "queued frame(s).",
+            self._master_epoch,
+            dropped,
+        )
 
     def request_drain(self) -> None:
         """Ask the worker to drain gracefully: finish the frame being
@@ -122,10 +187,28 @@ class Worker:
                     metrics=transport_metrics,
                     wrap=self._connection_wrapper,
                 )
-                await asyncio.wait_for(
-                    _perform_handshake(ws, self.worker_id, is_reconnect=is_reconnect),
-                    HANDSHAKE_TIMEOUT,
-                )
+                announce_reconnect = is_reconnect and not self._force_fresh_announce
+                try:
+                    epoch, fresh = await asyncio.wait_for(
+                        _perform_handshake(
+                            ws,
+                            self.worker_id,
+                            is_reconnect=announce_reconnect,
+                            last_epoch=self._master_epoch,
+                        ),
+                        HANDSHAKE_TIMEOUT,
+                    )
+                except ReconnectRefused:
+                    # Retry (through the reconnect budget) with a fresh
+                    # first-connection announce — the refusing master has
+                    # no session to resume.
+                    self._force_fresh_announce = True
+                    ws.abort()
+                    raise
+                self._force_fresh_announce = False
+                self._master_epoch = epoch
+                if fresh and is_reconnect:
+                    self._begin_fresh_session()
             return ws
 
         first = await fresh_connection(False)
@@ -165,6 +248,7 @@ class Worker:
             metrics=self.metrics,
             span_tracer=self.span_tracer,
         )
+        self._frame_queue = frame_queue
         frame_queue.start()
 
         heartbeat_task = asyncio.create_task(
@@ -234,10 +318,32 @@ class Worker:
         async def handle_adds() -> None:
             while True:
                 request = await add_queue.get()
+                if (
+                    request.epoch is not None
+                    and self._master_epoch is not None
+                    and request.epoch != self._master_epoch
+                ):
+                    # A queue-add stamped with a different incarnation's
+                    # epoch (a partitioned predecessor's socket flushing
+                    # late): refuse and count, never silently enqueue.
+                    self.metrics.counter(
+                        "worker_stale_epoch_requests_total",
+                        "Queue-add requests refused because their epoch "
+                        "does not match the current master session",
+                    ).inc()
+                    await sender.send_message(
+                        pm.WorkerFrameQueueAddResponse.new_errored(
+                            request.message_request_id,
+                            f"stale epoch {request.epoch} "
+                            f"(current session epoch {self._master_epoch})",
+                        )
+                    )
+                    continue
                 try:
                     frame_queue.queue_frame(
                         request.job, request.frame_index, trace=request.trace,
                         job_id=request.job_id, tile=request.tile,
+                        epoch=request.epoch,
                     )
                     self.tracer.increment_total_queued_frames()
                     response = pm.WorkerFrameQueueAddResponse.new_ok(
@@ -287,6 +393,12 @@ class Worker:
         async def handle_job_finished() -> None:
             request = await finished_queue.get()
             logger.info("Job finished; sending trace.")
+            # A worker that never received event_job-started (an idle
+            # shard drained before any job reached it) must still answer:
+            # an unset start time would make build() raise, silently
+            # killing this handler while the master waits out its 600 s
+            # trace budget.
+            self.tracer.ensure_job_start_time(time.time())
             self.tracer.set_job_finish_time(time.time())
             trace = self.tracer.build()
             self._final_trace = trace
@@ -334,7 +446,9 @@ class Worker:
                 len(returned),
             )
             # No job-finished request will come for a departed worker:
-            # close out the trace locally so the caller still gets one.
+            # close out the trace locally so the caller still gets one
+            # (ensure_* covers a drain before any job ever started).
+            self.tracer.ensure_job_start_time(time.time())
             self.tracer.set_job_finish_time(time.time())
             self._final_trace = self.tracer.build()
             job_done.set()
