@@ -820,9 +820,15 @@ def _ha_shard_process(conn, worker_count: int, render_seconds: float) -> None:
     from tpu_render_cluster.worker.runtime import Worker
 
     async def serve() -> dict:
-        ledger = JobLedger.open(tempfile.mkdtemp(prefix="trc-ha-bench-"))
+        registry = MetricsRegistry()
+        # The shard's registry also receives the ledger's append-latency
+        # histogram (ha_ledger_append_seconds): the fsync-per-transition
+        # cost is part of what the shard A/B measures, so report it.
+        ledger = JobLedger.open(
+            tempfile.mkdtemp(prefix="trc-ha-bench-"), metrics=registry
+        )
         manager = JobManager(
-            "127.0.0.1", 0, metrics=MetricsRegistry(), ledger=ledger
+            "127.0.0.1", 0, metrics=registry, ledger=ledger
         )
         serve_task = asyncio.create_task(manager.serve())
         while manager._server is None:
@@ -853,7 +859,7 @@ def _ha_shard_process(conn, worker_count: int, render_seconds: float) -> None:
             task.cancel()
         await asyncio.gather(*worker_tasks, return_exceptions=True)
         runs = [r for r in manager._runs.values() if r.state is not None]
-        return {
+        out = {
             "units": sum(r.state.finished_count() for r in runs),
             "first_admit": min(
                 (r.admitted_at for r in runs if r.admitted_at), default=0.0
@@ -862,6 +868,17 @@ def _ha_shard_process(conn, worker_count: int, render_seconds: float) -> None:
                 (r.finished_at for r in runs if r.finished_at), default=0.0
             ),
         }
+        # The ledger's per-append durability cost (ha_ledger_append_seconds,
+        # fsync included) rides back raw so the parent can fold one
+        # cross-shard distribution and report its percentiles.
+        histogram = manager.metrics.histogram("ha_ledger_append_seconds")
+        series = histogram.series()
+        if series is not None:
+            out["append_bounds"] = list(histogram.buckets)
+            out["append_buckets"] = list(series.counts) + [series.overflow]
+            out["append_count"] = series.count
+            out["append_sum"] = series.sum
+        return out
 
     try:
         conn.send(asyncio.run(serve()))
@@ -954,7 +971,10 @@ def ha_shard_bench(
             output_file_format="PNG",
         ).to_dict()
 
+    append_stats: dict[str, object] = {}
+
     def run_once(shard_count: int) -> float:
+        nonlocal append_stats
         workers_per_shard = total_workers // shard_count
         saved = {k: os.environ.get(k) for k in sched_env}
         os.environ.update(sched_env)
@@ -1001,6 +1021,34 @@ def ha_shard_bench(
                 if "error" in result:
                     raise RuntimeError(f"shard failed: {result['error']}")
             total_units = sum(r["units"] for r in results)
+            # Fold every shard's ledger-append histogram into one
+            # distribution (shared DEFAULT_BUCKETS bounds): the fsync
+            # cost per journaled transition, now a headline number.
+            from tpu_render_cluster.obs.history import (
+                quantile_from_bucket_counts,
+            )
+
+            bounds = next(
+                (r["append_bounds"] for r in results if "append_bounds" in r),
+                None,
+            )
+            if bounds is not None:
+                merged = [0.0] * (len(bounds) + 1)
+                count, total_s = 0, 0.0
+                for r in results:
+                    if "append_buckets" not in r:
+                        continue
+                    for i, c in enumerate(r["append_buckets"][: len(merged)]):
+                        merged[i] += c
+                    count += r["append_count"]
+                    total_s += r["append_sum"]
+                if count:
+                    append_stats = {
+                        "appends": count,
+                        "mean_s": total_s / count,
+                        "p50_s": quantile_from_bucket_counts(bounds, merged, 0.5),
+                        "p99_s": quantile_from_bucket_counts(bounds, merged, 0.99),
+                    }
             window = max(r["last_finish"] for r in results) - min(
                 r["first_admit"] for r in results
             )
@@ -1079,6 +1127,10 @@ def ha_shard_bench(
             ),
             "mttr_seconds_all": [round(m, 3) for m in mttrs],
         },
+        # Per-append ledger durability cost (fsync incl.) folded across
+        # the final rep's shards — the ha_ledger_append_seconds histogram
+        # that PR 12's HA metrics satellite made visible.
+        "ledger_append": append_stats or None,
     }
     record["shard_scaling"] = round(
         record["assignments_per_s_2_shards"]

@@ -8,7 +8,14 @@ Usage:
     python scripts/validate_trace.py results/cluster-runs   # a directory:
                                                             # validates every
                                                             # *trace-events.json
+                                                            # AND every flight-
+                                                            # recorder
+                                                            # *_blackbox.json
                                                             # under it
+
+Flight-recorder bundles (``*_blackbox.json``, obs/flightrec.py) get the
+blackbox checks on top of the trace invariants: a coherent ``[t0, t1]``
+window with every metric sample and protocol digest stamped inside it.
 
 Exit status 0 when every file passes, 1 otherwise. The checker itself
 lives in ``tpu_render_cluster/obs/validate.py`` so tests can call it
@@ -22,7 +29,10 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
-from tpu_render_cluster.obs.validate import validate_trace_file  # noqa: E402
+from tpu_render_cluster.obs.validate import (  # noqa: E402
+    validate_blackbox_file,
+    validate_trace_file,
+)
 
 
 def expand(arguments: list[str]) -> list[Path]:
@@ -31,6 +41,7 @@ def expand(arguments: list[str]) -> list[Path]:
         path = Path(argument)
         if path.is_dir():
             paths.extend(sorted(path.rglob("*trace-events.json")))
+            paths.extend(sorted(path.rglob("*_blackbox.json")))
         else:
             paths.append(path)
     return paths
@@ -43,7 +54,12 @@ def main(argv: list[str]) -> int:
         return 2
     failures = 0
     for path in paths:
-        problems = validate_trace_file(path)
+        validator = (
+            validate_blackbox_file
+            if path.name.endswith("_blackbox.json")
+            else validate_trace_file
+        )
+        problems = validator(path)
         if problems:
             failures += 1
             print(f"FAIL {path} ({len(problems)} problem(s))")

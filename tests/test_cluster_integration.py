@@ -271,6 +271,17 @@ def test_render_error_is_rescheduled():
     assert traced == list(range(1, frames + 1))
 
 
+# The two reference-loader tests below import the ORIGINAL thesis repo's
+# analysis suite from a checkout at /root/reference — an acceptance
+# surface, not shippable code. Hosts without the checkout skip them
+# (tier-1 must be green everywhere) instead of failing on the import.
+requires_reference_checkout = pytest.mark.skipif(
+    not REFERENCE_ANALYSIS.is_dir(),
+    reason=f"reference analysis checkout not present at {REFERENCE_ANALYSIS}",
+)
+
+
+@requires_reference_checkout
 def test_raw_trace_parses_with_reference_analysis(tmp_path):
     job = make_job(DistributionStrategy.eager_naive_coarse(2), 6, 2)
     backends = [MockBackend(), MockBackend()]
@@ -306,6 +317,7 @@ def test_raw_trace_parses_with_reference_analysis(tmp_path):
         sys.path.remove(str(REFERENCE_ANALYSIS))
 
 
+@requires_reference_checkout
 def test_worker_count_mismatch_detected_by_reference_loader(tmp_path):
     # The reference loader refuses traces whose worker count disagrees with
     # the job's barrier - make sure our writer preserves that invariant.

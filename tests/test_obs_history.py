@@ -1,0 +1,781 @@
+"""Continuous-observability suite (obs/history, obs/flightrec, the
+federated scraping in ha/shards, and the statistics.json fold).
+
+Fast deterministic tier-1 subset (marked ``obshistory``):
+
+- history store units: delta encoding + ring eviction at the boundary
+  (absolute reconstruction stays exact through anchor folding), counter
+  reset detection across a simulated process restart,
+  quantile-from-bucket-deltas against an exact reference, rate();
+- /history endpoint: summary + range/rate/quantile queries over real
+  HTTP, and a MID-JOB e2e scrape through the real harness whose rate()
+  matches the final counter deltas within sampling tolerance;
+- flight recorder: bundle structure + window coverage + trace-invariant
+  cleanliness (obs/validate.validate_blackbox_document), debounce,
+  obs_flight_dumps_total accounting, and the chaos acceptance — a seeded
+  SLO-breach run emits EXACTLY ONE bundle whose window contains the
+  injected fault's timestamp;
+- federation: a 2-endpoint fan-out re-serving shard-tagged /metrics +
+  /history, degrading (not failing) when a shard is down;
+- dashboard: sparkline rendering and the HA section;
+- analysis: the summarize_history fold.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_render_cluster.jobs.models import (
+    BlenderJob,
+    DistributionStrategy,
+    JobSlo,
+)
+from tpu_render_cluster.obs import MetricsRegistry, Tracer
+from tpu_render_cluster.obs.flightrec import FlightRecorder
+from tpu_render_cluster.obs.history import (
+    HistoryStore,
+    quantile_from_bucket_counts,
+)
+from tpu_render_cluster.obs.http import TelemetryServer
+from tpu_render_cluster.obs.prometheus import parse_prometheus
+from tpu_render_cluster.obs.validate import (
+    validate_blackbox_document,
+    validate_blackbox_file,
+)
+
+pytestmark = pytest.mark.obshistory
+
+
+def _fetch(port: int, path: str):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    )
+
+
+def _fetch_json(port: int, path: str) -> dict:
+    with _fetch(port, path) as response:
+        return json.loads(response.read().decode("utf-8"))
+
+
+# ---------------------------------------------------------------------------
+# History store units
+
+
+def test_ring_eviction_keeps_absolute_reconstruction_exact():
+    """Eviction at the ring boundary folds deltas into the anchor: the
+    newest absolute value must equal the raw counter no matter how many
+    samples fell off the trailing edge."""
+    registry = MetricsRegistry()
+    counter = registry.counter("master_frame_results_total", "x", labels=("result",))
+    store = HistoryStore(registry, interval=0.1, retention=0.5)
+    t = 1_000.0
+    for i in range(40):
+        counter.inc(3, result="ok")
+        store.sample(now=t + i * 0.1)
+    retained = store._snapshot_samples()
+    assert len(retained) == store.capacity < 40  # the ring actually evicted
+    series = store.range_series("master_frame_results_total")["result=ok"]
+    assert series["v"][-1] == pytest.approx(120.0)  # 40 * 3, not just the ring
+    # Every reconstructed point equals the raw value at its sample time.
+    first_kept = 40 - len(retained)
+    for offset, value in enumerate(series["v"]):
+        assert value == pytest.approx(3.0 * (first_kept + offset + 1))
+    # The summary's increase covers only the retained window's deltas.
+    summary = store.summary_dict()
+    entry = summary["counters"]["master_frame_results_total|result=ok"]
+    assert entry["increase"] == pytest.approx(3.0 * (len(retained) - 1))
+
+
+def test_counter_reset_detection_across_process_restart():
+    """A counter that comes back BELOW its previous value is a process
+    restart: the delta becomes the raw value (increase since reset, the
+    promql convention), the sample records the reset, and rate() stays
+    positive instead of going hugely negative."""
+    registry_a = MetricsRegistry()
+    counter_a = registry_a.counter("worker_frames_rendered_total", "x")
+    store = HistoryStore(registry_a, interval=0.1, retention=60.0)
+    t = 2_000.0
+    counter_a.inc(50)
+    store.sample(now=t)
+    counter_a.inc(50)
+    store.sample(now=t + 0.1)
+    # "Restart": a fresh registry re-registers the same series at 0.
+    registry_b = MetricsRegistry()
+    counter_b = registry_b.counter("worker_frames_rendered_total", "x")
+    store.registry = registry_b
+    counter_b.inc(7)
+    store.sample(now=t + 0.2)
+    samples = store._snapshot_samples()
+    assert samples[-1]["r"] == ["worker_frames_rendered_total|"]
+    assert store.resets_total == 1
+    assert samples[-1]["c"]["worker_frames_rendered_total|"] == pytest.approx(7.0)
+    # Rate over the full window: (50 + 7) increase after the first sample.
+    rate = store.rate("worker_frames_rendered_total")[""]
+    assert rate == pytest.approx((50.0 + 7.0) / 0.2)
+    # Absolute reconstruction keeps growing (cumulative increase).
+    series = store.range_series("worker_frames_rendered_total")[""]
+    assert series["v"] == pytest.approx([50.0, 100.0, 107.0])
+
+
+def test_histogram_reset_detected_on_shrinking_count():
+    registry_a = MetricsRegistry()
+    hist_a = registry_a.histogram("worker_frame_phase_seconds", "x")
+    store = HistoryStore(registry_a, interval=0.1, retention=60.0)
+    for _ in range(5):
+        hist_a.observe(0.2)
+    store.sample(now=3_000.0)
+    registry_b = MetricsRegistry()
+    hist_b = registry_b.histogram("worker_frame_phase_seconds", "x")
+    store.registry = registry_b
+    hist_b.observe(0.2)
+    store.sample(now=3_000.1)
+    assert store.resets_total == 1
+    samples = store._snapshot_samples()
+    assert samples[-1]["h"]["worker_frame_phase_seconds|"]["n"] == 1
+
+
+def test_quantile_from_bucket_deltas_vs_exact_reference():
+    """The window quantile reconstructed from bucket deltas must agree
+    with the exact percentile of the raw observations to within one
+    bucket's resolution — and must describe ONLY the window, unlike the
+    cumulative /metrics histogram."""
+    registry = MetricsRegistry()
+    bounds = tuple(0.05 * i for i in range(1, 41))  # 50 ms grid to 2 s
+    hist = registry.histogram(
+        "master_unit_latency_seconds", "x", buckets=bounds
+    )
+    store = HistoryStore(registry, interval=1.0, retention=600.0)
+    t = 4_000.0
+    # Pre-window observations the window quantile must NOT see.
+    for _ in range(100):
+        hist.observe(1.9)
+    store.sample(now=t)
+    # Window observations: a known uniform grid.
+    window_values = [0.05 + 0.01 * i for i in range(100)]  # 0.05 .. 1.04
+    for value in window_values:
+        hist.observe(value)
+    store.sample(now=t + 1.0)
+    for q in (0.5, 0.9, 0.99):
+        estimated = store.quantile("master_unit_latency_seconds", q)["merged"]
+        exact = sorted(window_values)[int(q * (len(window_values) - 1))]
+        assert estimated == pytest.approx(exact, abs=0.051), (q, estimated, exact)
+    # The cumulative histogram would put the median at 1.9; the window
+    # quantile must not.
+    assert store.quantile("master_unit_latency_seconds", 0.5)["merged"] < 1.0
+
+
+def test_quantile_from_bucket_counts_edges():
+    assert quantile_from_bucket_counts([1.0, 2.0], [0, 0, 0], 0.5) is None
+    # Everything in the overflow bucket clamps to the last finite bound.
+    assert quantile_from_bucket_counts([1.0, 2.0], [0, 0, 5], 0.5) == 2.0
+    # Interpolation inside the landing bucket.
+    assert quantile_from_bucket_counts([1.0, 2.0], [0, 10, 0], 0.5) == pytest.approx(1.5)
+
+
+def test_windowed_range_keeps_absolute_baseline():
+    """A seconds window limits which POINTS come back, not the baseline:
+    deltas of retained samples OLDER than the cutoff still accumulate, so
+    a counter that rose early and then went idle reads its true absolute
+    value inside the window."""
+    registry = MetricsRegistry()
+    counter = registry.counter("master_frame_results_total", "x")
+    store = HistoryStore(registry, interval=1.0, retention=600.0)
+    t = 6_000.0
+    counter.inc(1000)
+    store.sample(now=t)  # the rise happens well before the window
+    for i in range(1, 6):
+        store.sample(now=t + i)  # idle tail
+    windowed = store.range_series("master_frame_results_total", seconds=2.0)
+    series = windowed[""]
+    assert len(series["t"]) == 3  # only the window's points
+    assert all(v == pytest.approx(1000.0) for v in series["v"])
+
+
+def test_gauge_series_and_empty_queries():
+    registry = MetricsRegistry()
+    gauge = registry.gauge("master_worker_queue_depth", "x", labels=("worker",))
+    store = HistoryStore(registry, interval=0.1, retention=60.0)
+    for i in range(4):
+        gauge.set(i, worker="w-1")
+        store.sample(now=5_000.0 + i)
+    series = store.range_series("master_worker_queue_depth")
+    assert series["worker=w-1"]["v"] == [0.0, 1.0, 2.0, 3.0]
+    assert store.range_series("no_such_metric_seconds") == {}
+    assert store.rate("no_such_metric_total") == {}
+    assert store.quantile("no_such_metric_seconds", 0.5)["merged"] is None
+
+
+# ---------------------------------------------------------------------------
+# /history endpoint
+
+
+def test_history_endpoint_queries_over_real_http():
+    registry = MetricsRegistry()
+    counter = registry.counter("master_frame_results_total", "x", labels=("result",))
+    hist = registry.histogram("master_unit_latency_seconds", "x", buckets=(0.1, 1.0, 10.0))
+    store = HistoryStore(registry, interval=0.05, retention=60.0)
+    now = time.time()
+    for i in range(5):
+        counter.inc(4, result="ok")
+        hist.observe(0.5)
+        store.sample(now=now + i * 0.05)
+
+    async def scenario():
+        server = TelemetryServer(registry, port=0, history=store)
+        await server.start()
+        try:
+            port = server.port
+            summary = await asyncio.to_thread(_fetch_json, port, "/history")
+            assert summary["ok"] is True
+            assert summary["samples"] == 5
+            assert summary["names"]["master_frame_results_total"] == "counter"
+            ranged = await asyncio.to_thread(
+                _fetch_json, port, "/history?name=master_frame_results_total"
+            )
+            assert ranged["kind"] == "counter"
+            assert ranged["series"]["result=ok"]["v"][-1] == 20.0
+            rate = await asyncio.to_thread(
+                _fetch_json,
+                port,
+                "/history?name=master_frame_results_total&query=rate",
+            )
+            assert rate["series"]["result=ok"] == pytest.approx(4 * 4 / 0.2)
+            quantile = await asyncio.to_thread(
+                _fetch_json,
+                port,
+                "/history?name=master_unit_latency_seconds&query=quantile&q=0.5",
+            )
+            assert 0.1 < quantile["merged"] <= 1.0
+            bad = await asyncio.to_thread(
+                _fetch_json,
+                port,
+                "/history?name=master_frame_results_total&query=nope",
+            )
+            assert bad["ok"] is False and "unknown query" in bad["error"]
+        finally:
+            await server.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), 30))
+
+
+def test_history_endpoint_404_without_store():
+    async def scenario():
+        server = TelemetryServer(MetricsRegistry(), port=0)
+        await server.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as not_found:
+                await asyncio.to_thread(_fetch, server.port, "/history")
+            assert not_found.value.code == 404
+        finally:
+            await server.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), 30))
+
+
+def _job(frames: int, workers: int = 2, name: str = "history-e2e") -> BlenderJob:
+    return BlenderJob(
+        job_name=name,
+        job_description="continuous observability e2e",
+        project_file_path="%BASE%/p.blend",
+        render_script_path="%BASE%/s.py",
+        frame_range_from=1,
+        frame_range_to=frames,
+        wait_for_number_of_workers=workers,
+        frame_distribution_strategy=DistributionStrategy.naive_fine(),
+        output_directory_path="%BASE%/out",
+        output_file_name_format="rendered-#####",
+        output_file_format="PNG",
+    )
+
+
+def test_history_scrapeable_mid_job_and_rate_matches_final_deltas(monkeypatch):
+    """Acceptance: /history scraped MID-JOB through the real harness
+    returns series, and rate() over the whole run matches the final
+    counter deltas within sampling tolerance."""
+    monkeypatch.setenv("TRC_OBS_HISTORY_INTERVAL", "0.05")
+    from tpu_render_cluster.harness.local import _run
+    from tpu_render_cluster.master.cluster import ClusterManager
+    from tpu_render_cluster.worker.backends.mock import MockBackend
+
+    frames = 8
+    job = _job(frames=frames, workers=2)
+    backends = [
+        MockBackend(load_seconds=0.0, save_seconds=0.0, render_seconds=0.3)
+        for _ in range(2)
+    ]
+    scraped: dict = {}
+
+    async def on_cluster_started(manager, workers, worker_tasks) -> None:
+        async def scrape():
+            while manager.telemetry.port == 0:
+                await asyncio.sleep(0.01)
+            port = manager.telemetry.port
+            # Poll until the sampler has captured at least one landed
+            # result WHILE work is still outstanding (the counter incs
+            # before the next 50 ms sampling tick, so the series can lag
+            # a moment behind finished_count).
+            while True:
+                ranged = await asyncio.to_thread(
+                    _fetch_json,
+                    port,
+                    "/history?name=master_frame_results_total",
+                )
+                finished = manager.state.finished_count()
+                series = (ranged.get("series") or {}).get("result=ok")
+                if series and series["v"][-1] > 0 and finished < frames:
+                    scraped["range"] = ranged
+                    scraped["summary"] = await asyncio.to_thread(
+                        _fetch_json, port, "/history"
+                    )
+                    break
+                if finished >= frames:
+                    scraped["too_late"] = True
+                    break
+                await asyncio.sleep(0.02)
+
+        scraped["task"] = asyncio.create_task(scrape())
+
+    async def scenario():
+        result = await _run(
+            job,
+            backends,
+            manager_factory=lambda job: ClusterManager(
+                "127.0.0.1",
+                0,
+                job,
+                metrics=MetricsRegistry(),
+                telemetry_port=0,
+            ),
+            on_cluster_started=on_cluster_started,
+        )
+        await scraped.pop("task")
+        return result
+
+    _trace, _worker_traces, manager, _workers = asyncio.run(
+        asyncio.wait_for(scenario(), 60)
+    )
+    assert manager.state.all_frames_finished()
+    # Mid-job: the store was live, sampling, and saw partial progress
+    # (0.3 s renders leave ~1 s of mid-job window for the 50 ms sampler).
+    assert "too_late" not in scraped, "job finished before a mid-job sample"
+    assert scraped["summary"]["samples"] >= 1
+    mid_values = scraped["range"]["series"]["result=ok"]["v"]
+    assert 0 < mid_values[-1] <= frames
+    # Post-run: rate * elapsed reconstructs the final counter delta. The
+    # sampler's final stop() sample makes the window cover the whole run.
+    final_ok = manager.metrics.counter(
+        "master_frame_results_total", labels=("result",)
+    ).value(result="ok")
+    assert final_ok == frames
+    rates = manager.history.rate("master_frame_results_total")
+    window = manager.history.window()
+    elapsed = window[1] - window[0]
+    assert elapsed > 0
+    # The first sample's delta is excluded by rate(); it fired before any
+    # result landed, so the reconstruction covers every unit.
+    assert rates["result=ok"] * elapsed == pytest.approx(final_ok, rel=0.15)
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+
+
+def test_flight_recorder_bundle_window_and_validation(tmp_path, monkeypatch):
+    monkeypatch.setenv("TRC_OBS_FLIGHT_SECONDS", "30")
+    monkeypatch.setenv("TRC_OBS_FLIGHT_DEBOUNCE", "100")
+    registry = MetricsRegistry()
+    counter = registry.counter("master_frame_results_total", "x", labels=("result",))
+    store = HistoryStore(registry, interval=0.1, retention=60.0)
+    tracer = Tracer("master-test")
+    recorder = FlightRecorder(
+        history=store,
+        span_tracer=tracer,
+        metrics=registry,
+        directory=tmp_path,
+    )
+    counter.inc(3, result="ok")
+    store.sample()
+    with tracer.span("assign frame", cat="master", track="job"):
+        pass
+    incident_at = time.time()
+    recorder.record_event("dispatch", worker="w-1", unit="7")
+    path = recorder.trigger("worker_eviction", {"worker": "w-1"})
+    assert path is not None and path.exists()
+    document = json.loads(path.read_text())
+    assert validate_blackbox_document(document) == []
+    assert validate_blackbox_file(path) == []
+    box = document["blackbox"]
+    assert box["trigger"] == "worker_eviction"
+    assert box["window"][0] <= incident_at <= box["window"][1]
+    assert box["metric_samples"], "history samples must ride in the bundle"
+    assert box["protocol_events"][0]["kind"] == "dispatch"
+    # Trace events: the span made it in, and only validate-safe phases.
+    phases = {e["ph"] for e in document["traceEvents"]}
+    assert phases <= {"M", "X", "i"}
+    assert any(e.get("name") == "assign frame" for e in document["traceEvents"])
+    # Accounting: exactly one dump, counted by trigger.
+    assert registry.counter(
+        "obs_flight_dumps_total", labels=("trigger",)
+    ).value(trigger="worker_eviction") == 1
+    # Debounce: the same trigger kind inside the window does not re-dump...
+    assert recorder.trigger("worker_eviction", {"worker": "w-2"}) is None
+    assert recorder.triggers["worker_eviction"] == 2
+    assert len([d for d in recorder.dumps if d["path"]]) == 1
+    # ...but a DIFFERENT trigger kind still does.
+    assert recorder.trigger("job_failure", {"reason": "x"}) is not None
+
+
+def test_flight_recorder_without_directory_counts_only():
+    recorder = FlightRecorder(metrics=MetricsRegistry(), directory=None)
+    assert recorder.trigger("epoch_fence", {"epoch": 1}) is None
+    view = recorder.view()
+    assert view["triggers"] == {"epoch_fence": 1}
+    assert view["dumps"][0]["path"] is None
+
+
+def test_validate_blackbox_rejects_malformed_bundles():
+    good = {
+        "traceEvents": [],
+        "blackbox": {
+            "trigger": "slo_alert",
+            "window": [10.0, 20.0],
+            "dumped_at": 20.0,
+            "metric_samples": [{"t": 15.0}],
+            "protocol_events": [{"t": 12.0, "kind": "dispatch"}],
+        },
+    }
+    assert validate_blackbox_document(good) == []
+    assert validate_blackbox_document({"traceEvents": []})  # no blackbox
+    bad_window = json.loads(json.dumps(good))
+    bad_window["blackbox"]["window"] = [20.0, 10.0]
+    assert any("window" in p for p in validate_blackbox_document(bad_window))
+    stray_sample = json.loads(json.dumps(good))
+    stray_sample["blackbox"]["metric_samples"] = [{"t": 5.0}]
+    assert any(
+        "outside the window" in p
+        for p in validate_blackbox_document(stray_sample)
+    )
+
+
+@pytest.mark.chaos
+def test_seeded_slo_breach_emits_exactly_one_blackbox(tmp_path, monkeypatch):
+    """The tentpole acceptance: the existing seeded SLO-breach plan (one
+    straggler, objective 0.3 s — test_telemetry's scenario) must produce
+    EXACTLY ONE flight-recorder bundle, triggered by the alert fire,
+    whose sample window contains the injected fault's timestamp, and the
+    bundle must pass the blackbox validator."""
+    from tpu_render_cluster.chaos.plan import FaultPlan
+    from tpu_render_cluster.chaos.runner import run_chaos_job
+
+    monkeypatch.delenv("TRC_SLO_SHORT_WINDOW_SECONDS", raising=False)
+    monkeypatch.delenv("TRC_SLO_LONG_WINDOW_SECONDS", raising=False)
+    monkeypatch.setenv("TRC_OBS_HISTORY_INTERVAL", "0.1")
+    # Window wide enough to cover the whole compressed run: the fault
+    # fires seconds before the burn crosses the threshold.
+    monkeypatch.setenv("TRC_OBS_FLIGHT_SECONDS", "120")
+    plan = FaultPlan.generate(
+        907,
+        3,
+        kills=0,
+        partitions=0,
+        duplicate_sends=0,
+        stragglers=1,
+        wedges=0,
+        drops=0,
+        dispatch_delays=0,
+    )
+    started = time.time()
+    report = run_chaos_job(
+        plan,
+        frames=18,
+        timeout=120.0,
+        slo=JobSlo(unit_latency_p99_seconds=0.3),
+        flight_directory=tmp_path,
+    )
+    assert report.ok, report.violations
+    # The SLO engine fired exactly once (asserted independently by
+    # test_seeded_chaos_slo_breach); the recorder must have dumped
+    # exactly one bundle for it — no eviction/failure triggers exist in
+    # this plan.
+    bundles = sorted(tmp_path.glob("*_blackbox.json"))
+    assert len(bundles) == 1, [b.name for b in bundles]
+    assert "slo_alert" in bundles[0].name
+    assert validate_blackbox_file(bundles[0]) == []
+    document = json.loads(bundles[0].read_text())
+    box = document["blackbox"]
+    assert box["detail"]["transition"] == "fire"
+    # The injected fault's wall-clock timestamp falls inside the window.
+    straggler_offsets = [
+        event.at_seconds
+        for event in plan.events
+        if event.kind == "slow_render"
+    ]
+    assert straggler_offsets, "plan must carry the straggler fault"
+    # slow_render is active from run start (at_seconds 0): the injection
+    # timestamp is the run's start, which the window must reach back to.
+    fault_at = started + min(straggler_offsets)
+    t0, t1 = box["window"]
+    assert t0 <= fault_at <= t1, (t0, fault_at, t1)
+    # The bundle carries history samples from the breach window.
+    assert box["metric_samples"]
+    # And the report's flight ledger agrees.
+    assert report.stats["flight"]["triggers"] == {"slo_alert": 1}
+
+
+# ---------------------------------------------------------------------------
+# HA metrics satellites
+
+
+def test_ledger_append_histogram_records(tmp_path):
+    """The previously-invisible fsync cost: every durable append lands in
+    ha_ledger_append_seconds, and the registry stays lint-clean."""
+    from tpu_render_cluster.ha.ledger import JobLedger
+    from tpu_render_cluster.obs.prometheus import render_prometheus
+
+    registry = MetricsRegistry()
+    ledger = JobLedger.open(tmp_path / "ledger", metrics=registry)
+    ledger.append_job_started("j")
+    ledger.append_unit_finished("j", 1)
+    ledger.append_job_finished("j")
+    ledger.close()
+    series = registry.histogram("ha_ledger_append_seconds").series()
+    assert series is not None and series.count == 3
+    assert series.sum > 0
+    render_prometheus(registry.snapshot())  # exporter accepts the name
+
+
+# ---------------------------------------------------------------------------
+# Federated scraping (ha/shards.py)
+
+
+def test_federated_metrics_and_history_across_two_shards():
+    from tpu_render_cluster.ha.shards import TelemetryFederation
+
+    async def scenario():
+        servers = []
+        stores = []
+        now = time.time()
+        for shard in range(2):
+            registry = MetricsRegistry()
+            registry.counter(
+                "master_frame_results_total", "x", labels=("result",)
+            ).inc(10 * (shard + 1), result="ok")
+            registry.histogram(
+                "ha_ledger_append_seconds", "x", buckets=(0.001, 0.01, 0.1)
+            ).observe(0.005)
+            store = HistoryStore(registry, interval=0.05, retention=60.0)
+            store.sample(now=now)
+            registry.counter(
+                "master_frame_results_total", "x", labels=("result",)
+            ).inc(5, result="ok")
+            store.sample(now=now + 0.05)
+            server = TelemetryServer(registry, port=0, history=store)
+            await server.start()
+            servers.append(server)
+            stores.append(store)
+        router_registry = MetricsRegistry()
+        federation = TelemetryFederation(
+            [("127.0.0.1", s.port) for s in servers],
+            metrics=router_registry,
+        )
+        front = TelemetryServer(
+            router_registry,
+            port=0,
+            extra_routes={
+                "/metrics": federation.federated_metrics,
+                "/history": federation.federated_history,
+            },
+        )
+        await front.start()
+        def fetch_text(port: int, path: str) -> str:
+            with _fetch(port, path) as response:
+                return response.read().decode("utf-8")
+
+        try:
+            text = await asyncio.to_thread(fetch_text, front.port, "/metrics")
+            parsed = parse_prometheus(text)
+            rows = parsed["master_frame_results_total"]
+            by_shard = {
+                labels["shard"]: value
+                for labels, value in rows
+                if "shard" in labels
+            }
+            assert by_shard == {"0": 15.0, "1": 25.0}
+            # Shard-tagged histogram expansions survive the round trip.
+            assert any(
+                labels.get("shard") == "1"
+                for labels, _ in parsed["ha_ledger_append_seconds_bucket"]
+            )
+            # The router's own scrape accounting is in the same document.
+            assert "ha_router_scrapes_total" in parsed
+
+            merged = await asyncio.to_thread(
+                _fetch_json,
+                front.port,
+                "/history?name=master_frame_results_total",
+            )
+            assert merged["federated"] is True
+            assert merged["ok"] is True
+            assert set(merged["series"]) == {
+                "result=ok,shard=0",
+                "result=ok,shard=1",
+            }
+            assert merged["series"]["result=ok,shard=1"]["v"][-1] == 25.0
+            summary = await asyncio.to_thread(
+                _fetch_json, front.port, "/history"
+            )
+            assert set(summary["shards"]) == {"0", "1"}
+            assert summary["shards"]["0"]["samples"] == 2
+
+            # A dead shard degrades to absence, not a router failure.
+            await servers[1].stop()
+            text = await asyncio.to_thread(fetch_text, front.port, "/metrics")
+            degraded = parse_prometheus(text)
+            shards_present = {
+                labels.get("shard")
+                for labels, _ in degraded.get("master_frame_results_total", [])
+            }
+            assert shards_present == {"0"}
+            assert router_registry.counter(
+                "ha_router_scrape_failures_total", labels=("shard",)
+            ).value(shard="1") >= 1
+        finally:
+            for server in servers:
+                await server.stop()
+            await front.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), 30))
+
+
+# ---------------------------------------------------------------------------
+# Dashboard: sparklines + HA section
+
+
+def test_sparkline_rendering():
+    from tpu_render_cluster.obs.dashboard import sparkline
+
+    assert sparkline([]) == ""
+    assert sparkline([3.0, 3.0, 3.0]) == "▁▁▁"
+    line = sparkline([0.0, 1.0, 2.0, 3.0])
+    assert line[0] == "▁" and line[-1] == "█" and len(line) == 4
+    assert len(sparkline(list(range(100)), width=16)) == 16
+
+
+def test_dashboard_history_and_ha_sections():
+    from tpu_render_cluster.obs.dashboard import render_dashboard
+
+    samples = {
+        "ha_router_requests_total": [
+            ({"op": "submit", "shard": "0"}, 7.0),
+            ({"op": "status", "shard": "0"}, 3.0),
+            ({"op": "submit", "shard": "1"}, 4.0),
+            ({"op": "status", "shard": "all"}, 9.0),
+        ],
+        "ha_router_jobs_routed_total": [
+            ({"shard": "0"}, 7.0),
+            ({"shard": "1"}, 4.0),
+        ],
+        "ha_ledger_append_seconds_bucket": [
+            ({"shard": "0", "le": "0.001"}, 90.0),
+            ({"shard": "0", "le": "0.01"}, 100.0),
+            ({"shard": "0", "le": "+Inf"}, 100.0),
+            ({"shard": "1", "le": "0.001"}, 10.0),
+            ({"shard": "1", "le": "0.01"}, 100.0),
+            ({"shard": "1", "le": "+Inf"}, 100.0),
+        ],
+        "ha_failover_mttr_seconds": [({"shard": "1"}, 1.25)],
+    }
+    history = {
+        "master_frame_results_total": {
+            "kind": "counter",
+            "series": {"result=ok": {"t": [1, 2, 3], "v": [0.0, 5.0, 12.0]}},
+        },
+        "master_worker_queue_depth": {
+            "kind": "gauge",
+            "series": {"worker=w-1": {"t": [1, 2, 3], "v": [3.0, 2.0, 1.0]}},
+        },
+    }
+    clusterz = {
+        "cluster": {"frames_total": 4, "frames_finished": 1, "frames_pending": 1},
+        "flight": {"triggers": {"slo_alert": 1}, "dumps": [{"path": "x"}]},
+    }
+    frame = render_dashboard(samples, clusterz, history=history, now=0.0)
+    assert "HA shard" in frame
+    assert "s0" in frame and "s1" in frame
+    assert "1.25s" in frame  # MTTR column
+    assert "history" in frame
+    assert "master_frame_results_total{result=ok}" in frame
+    assert "▁" in frame  # some sparkline landed
+    assert "flight rec" in frame and "slo_alert 1" in frame
+    # Per-shard p99: shard 0 lands in the first bucket, shard 1 the second.
+    from tpu_render_cluster.obs.dashboard import histogram_quantiles
+
+    p99_s0 = histogram_quantiles(
+        samples, "ha_ledger_append_seconds", (0.99,), where={"shard": "0"}
+    )[0.99]
+    p99_s1 = histogram_quantiles(
+        samples, "ha_ledger_append_seconds", (0.99,), where={"shard": "1"}
+    )[0.99]
+    assert p99_s0 < p99_s1
+
+
+# ---------------------------------------------------------------------------
+# Analysis fold
+
+
+def test_summarize_history_fold():
+    from tpu_render_cluster.analysis.obs_events import summarize_history
+
+    assert summarize_history([{}]) is None
+    metrics = [
+        {
+            "written_at": 100.0,
+            "metrics": {},
+            "history": {
+                "interval_seconds": 1.0,
+                "samples": 3,
+                "window": [90.0, 92.0],
+                "counters": {
+                    "master_frame_results_total|result=ok": {
+                        "increase": 12.0,
+                        "rate_per_second": 6.0,
+                        "trend": 2.0,
+                    },
+                    "idle_total|": {"increase": 0.0},
+                },
+                "gauges": {"master_worker_queue_depth|worker=w": {"last": 1.0}},
+            },
+        },
+        # An older snapshot must lose to the newer one.
+        {
+            "written_at": 50.0,
+            "metrics": {},
+            "history": {"samples": 1, "counters": {}, "gauges": {}},
+        },
+    ]
+    bundles = [
+        {
+            "path": "/tmp/x_blackbox.json",
+            "blackbox": {
+                "trigger": "slo_alert",
+                "window": [80.0, 95.0],
+                "dumped_at": 95.0,
+            },
+        }
+    ]
+    section = summarize_history(metrics, bundles)
+    assert section["samples"] == 3
+    assert "idle_total|" not in section["counters"]  # zero-increase dropped
+    assert section["counters"][
+        "master_frame_results_total|result=ok"
+    ]["trend"] == 2.0
+    assert section["flight_bundles"]["count"] == 1
+    assert section["flight_bundles"]["triggers"] == {"slo_alert": 1}
+    # Bundles alone still produce a section.
+    assert summarize_history([{}], bundles)["flight_bundles"]["count"] == 1

@@ -51,6 +51,14 @@ def find_metrics_files(results_directory: str | Path) -> list[Path]:
     return sorted(Path(results_directory).rglob(METRICS_SNAPSHOT_GLOB))
 
 
+# Flight-recorder post-mortem bundles (obs/flightrec.py).
+BLACKBOX_GLOB = "*_blackbox.json"
+
+
+def find_blackbox_files(results_directory: str | Path) -> list[Path]:
+    return sorted(Path(results_directory).rglob(BLACKBOX_GLOB))
+
+
 @dataclass(frozen=True)
 class ObsTrace:
     """One loaded trace-event file."""
@@ -156,6 +164,29 @@ def load_cluster_traces(
                 raise
             on_error(path, e)
     return traces
+
+
+def load_blackbox_bundles(
+    results_directory: str | Path,
+    *,
+    on_error: "Callable[[Path, Exception], None] | None" = None,
+) -> list[dict[str, Any]]:
+    """Load every flight-recorder bundle under a results directory; each
+    returned dict gains a ``path`` key for provenance."""
+    bundles: list[dict[str, Any]] = []
+    for path in find_blackbox_files(results_directory):
+        try:
+            data = json.loads(path.read_text(encoding="utf-8"))
+            if not isinstance(data, dict) or not isinstance(
+                data.get("blackbox"), dict
+            ):
+                raise ValueError("not a flight-recorder bundle")
+            bundles.append({**data, "path": str(path)})
+        except (ValueError, OSError, json.JSONDecodeError) as e:
+            if on_error is None:
+                raise
+            on_error(path, e)
+    return bundles
 
 
 def _percentile(sorted_values: list[float], q: float) -> float:
@@ -659,6 +690,74 @@ def summarize_slo(metrics: list[dict[str, Any]]) -> dict[str, Any] | None:
     return out
 
 
+def summarize_history(
+    metrics: list[dict[str, Any]],
+    flight_bundles: list[dict[str, Any]] | None = None,
+) -> dict[str, Any] | None:
+    """Roll the continuous-observability evidence up (obs/history.py +
+    obs/flightrec.py).
+
+    The ``history`` section a master stamps into its metrics snapshots
+    carries per-counter increase/rate/trend and per-gauge envelopes over
+    the run's sampled window — newest snapshot wins (it is cumulative
+    over the retained ring). Flight-recorder bundles contribute a
+    post-mortem ledger: dumps per trigger and each bundle's covered
+    window. None when no snapshot carries a history section and no
+    bundles exist — uninstrumented populations get no section.
+    """
+    live: dict[str, Any] | None = None
+    live_at = -1.0
+    for snapshot in metrics:
+        written_at = float(snapshot.get("written_at", 0.0))
+        section = snapshot.get("history")
+        if isinstance(section, dict) and section and written_at >= live_at:
+            live = section
+            live_at = written_at
+    out: dict[str, Any] = {}
+    if live is not None:
+        for key in (
+            "interval_seconds",
+            "retention_seconds",
+            "samples",
+            "resets_total",
+            "window",
+        ):
+            if key in live:
+                out[key] = live[key]
+        # Rate trends: keep only series that actually moved — the roll-up
+        # reads as "what was happening", not a registry dump.
+        counters = {
+            key: entry
+            for key, entry in (live.get("counters") or {}).items()
+            if isinstance(entry, dict) and entry.get("increase")
+        }
+        if counters:
+            out["counters"] = counters
+        if live.get("gauges"):
+            out["gauges"] = live["gauges"]
+    if flight_bundles:
+        triggers: dict[str, int] = {}
+        windows: list[dict[str, Any]] = []
+        for bundle in flight_bundles:
+            box = bundle.get("blackbox") or {}
+            trigger = str(box.get("trigger", "unknown"))
+            triggers[trigger] = triggers.get(trigger, 0) + 1
+            windows.append(
+                {
+                    "trigger": trigger,
+                    "window": box.get("window"),
+                    "dumped_at": box.get("dumped_at"),
+                    "path": bundle.get("path"),
+                }
+            )
+        out["flight_bundles"] = {
+            "count": len(flight_bundles),
+            "triggers": triggers,
+            "bundles": windows,
+        }
+    return out or None
+
+
 def summarize_roofline(metrics: list[dict[str, Any]]) -> dict[str, Any] | None:
     """Roll the kernel roofline evidence up (obs/profiling.py).
 
@@ -803,6 +902,7 @@ def summarize_obs(
     traces: list[ObsTrace],
     metrics: list[dict[str, Any]],
     cluster_traces: list[ObsTrace] | None = None,
+    flight_bundles: list[dict[str, Any]] | None = None,
 ) -> dict[str, Any]:
     """Roll obs artifacts into a ``statistics.json``-shaped summary.
 
@@ -810,7 +910,8 @@ def summarize_obs(
     ``load_cluster_traces``) additionally contribute a ``critical_path``
     section — per-run makespan critical path, per-worker idle attribution,
     and straggler scores (``analysis/critical_path.py``) — keyed by the
-    run's file stem.
+    run's file stem. ``flight_bundles`` (``load_blackbox_bundles``) fold
+    into the ``history`` section's post-mortem ledger.
     """
     span_counts: dict[str, int] = {}
     durations: dict[str, list[float]] = {}
@@ -856,6 +957,9 @@ def summarize_obs(
     slo = summarize_slo(metrics)
     if slo is not None:
         out["slo"] = slo
+    history = summarize_history(metrics, flight_bundles)
+    if history is not None:
+        out["history"] = history
     roofline = summarize_roofline(metrics)
     if roofline is not None:
         out["roofline"] = roofline

@@ -18,6 +18,7 @@ from pathlib import Path
 
 from tpu_render_cluster.analysis import metrics as M
 from tpu_render_cluster.analysis.obs_events import (
+    load_blackbox_bundles,
     load_cluster_traces,
     load_obs_artifacts,
     summarize_obs,
@@ -60,11 +61,15 @@ def main(argv: list[str] | None = None) -> int:
             args.results, on_error=on_obs_error
         )
         cluster_traces = load_cluster_traces(args.results, on_error=on_obs_error)
-    if obs_traces or obs_metrics or cluster_traces:
+        flight_bundles = load_blackbox_bundles(
+            args.results, on_error=on_obs_error
+        )
+    if obs_traces or obs_metrics or cluster_traces or flight_bundles:
         print(
             f"Loaded {len(obs_traces)} trace-event file(s), "
             f"{len(obs_metrics)} metrics snapshot(s), "
-            f"{len(cluster_traces)} merged cluster timeline(s)."
+            f"{len(cluster_traces)} merged cluster timeline(s), "
+            f"{len(flight_bundles)} flight-recorder bundle(s)."
         )
 
     out = Path(args.out)
@@ -79,8 +84,10 @@ def main(argv: list[str] | None = None) -> int:
         "phase_split": {str(k): v for k, v in M.phase_split_stats(traces).items()},
         "run_statistics": {str(k): v for k, v in M.run_statistics(traces).items()},
     }
-    if obs_traces or obs_metrics or cluster_traces:
-        stats["obs"] = summarize_obs(obs_traces, obs_metrics, cluster_traces)
+    if obs_traces or obs_metrics or cluster_traces or flight_bundles:
+        stats["obs"] = summarize_obs(
+            obs_traces, obs_metrics, cluster_traces, flight_bundles
+        )
     stats_path = out / "statistics.json"
     stats_path.write_text(json.dumps(stats, indent=2))
     print(f"Statistics written to {stats_path}")

@@ -192,6 +192,7 @@ async def _chaos_run(
     hooks: MasterChaosHooks,
     registries: list[MetricsRegistry],
     master_registry: MetricsRegistry,
+    flight_directory: str | Path | None = None,
 ):
     watchdogs: list[asyncio.Task] = []
 
@@ -216,6 +217,7 @@ async def _chaos_run(
                 job,
                 metrics=master_registry,
                 dispatch_delay_fn=hooks.dispatch_delay,
+                flight_directory=flight_directory,
             ),
             worker_factory=lambda slot, port, backend: Worker(
                 "127.0.0.1",
@@ -259,6 +261,7 @@ def run_chaos_job(
     timeout: float = 180.0,
     tile_grid: tuple[int, int] | None = None,
     slo=None,
+    flight_directory: str | Path | None = None,
 ) -> ChaosReport:
     """Run one seeded chaos job end to end and audit the invariants.
 
@@ -272,6 +275,11 @@ def run_chaos_job(
     job so seeded fault schedules can drive the SLO engine into breach;
     the report's ``stats["slo"]`` then carries the final per-job
     attainment/burn view and the alert edge ledger.
+
+    ``flight_directory`` arms the master's flight recorder with a dump
+    target: incident triggers (an SLO fire, an eviction, a job failure)
+    emit ``*_blackbox.json`` bundles there, and the report's
+    ``stats["flight"]`` carries the trigger/dump ledger either way.
     """
     job = _make_job(plan, frames, strategy, tile_grid, slo)
     registries = [MetricsRegistry() for _ in range(plan.workers)]
@@ -297,7 +305,13 @@ def run_chaos_job(
         master_trace, worker_traces, manager, workers = asyncio.run(
             asyncio.wait_for(
                 _chaos_run(
-                    job, backends, controllers, hooks, registries, master_registry
+                    job,
+                    backends,
+                    controllers,
+                    hooks,
+                    registries,
+                    master_registry,
+                    flight_directory,
                 ),
                 timeout,
             )
@@ -351,6 +365,8 @@ def run_chaos_job(
         stats["speculation"] = manager.speculation.view()
     if manager.slo.tracked():
         stats["slo"] = manager.slo.view()
+    if manager.flightrec.triggers or manager.flightrec.dumps:
+        stats["flight"] = manager.flightrec.view()
     return ChaosReport(
         plan=plan, violations=violations, stats=stats, artifacts=artifacts
     )
@@ -591,6 +607,9 @@ def main(argv: list[str] | None = None) -> int:
         results_directory=results_directory,
         timeout=args.timeout,
         tile_grid=tile_grid,
+        # Operator runs get blackbox bundles beside the other artifacts;
+        # an incident-free run writes none.
+        flight_directory=results_directory,
     )
     print(json.dumps(report.to_dict(), indent=2))
     return 0 if report.ok else 1

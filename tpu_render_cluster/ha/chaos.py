@@ -288,9 +288,18 @@ async def _failover_run(
             )
         master_trace, worker_traces = await standby_task
         if "first_dispatch_at" in failover_stats and "kill_at" in failover_stats:
-            failover_stats["mttr_seconds"] = (
+            mttr = (
                 failover_stats["first_dispatch_at"] - failover_stats["kill_at"]
             )
+            failover_stats["mttr_seconds"] = mttr
+            # Registered, not just computed: the recovery time of the last
+            # failover belongs on the standby's /metrics beside the other
+            # ha_* series (the dashboard's HA section reads it federated).
+            standby_registry.gauge(
+                "ha_failover_mttr_seconds",
+                "Master kill to the standby's first post-adoption dispatch "
+                "in the most recent failover",
+            ).set(mttr)
         return master_trace, worker_traces, standby, workers
     finally:
         for watchdog in watchdogs:

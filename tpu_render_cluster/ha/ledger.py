@@ -332,11 +332,21 @@ class JobLedger:
             **fields,
         }
         line = json.dumps(record, separators=(",", ":")) + "\n"
+        started = time.perf_counter()
         f = self._current_segment()
         f.write(line)
         f.flush()
         if _fsync_enabled():
             os.fsync(f.fileno())
+        if self.metrics is not None:
+            # The fsync is the dominant (and previously invisible) cost of
+            # every journaled transition; per-append timing makes a slow
+            # disk show up in /metrics instead of as mystery tail latency.
+            self.metrics.histogram(
+                "ha_ledger_append_seconds",
+                "Durable append latency of the write-ahead job ledger "
+                "(write + flush + fsync when TRC_HA_FSYNC is on)",
+            ).observe(time.perf_counter() - started)
         self._segment_records += 1
         # Keep the live replay coherent so snapshot() needs no re-read.
         if self._replay is not None:

@@ -20,14 +20,29 @@ unchanged against it) and routes:
 - ``status`` (global), ``alerts``, ``drain``, ``ping`` — fanned out to
   every shard and aggregated under ``shards``.
 
+Federated telemetry (``TelemetryFederation``): with ``--telemetryPort``
+and ``--shardTelemetry`` the router additionally serves ``/metrics`` and
+``/history`` that fan out to every shard's telemetry endpoints and
+re-serve the merged result with each series tagged ``shard="<i>"`` — one
+scrape sees the whole replicated control plane (per-shard ledger append
+histograms, failover MTTR, queue depths) without N scrape targets. The
+federated ``/metrics`` re-emits scraped samples through the 0.0.4 parser
+(``parse_prometheus`` -> ``render_sample_line``; HELP/TYPE of remote
+series are not retained — untyped samples are valid exposition), with the
+router's OWN registry (the ``ha_router_*`` family) rendered first.
+
 CLI::
 
     python -m tpu_render_cluster.ha.shards --controlPort 9900 \\
-        --shards 127.0.0.1:9902,127.0.0.1:9912
+        --shards 127.0.0.1:9902,127.0.0.1:9912 \\
+        [--telemetryPort 9800 --shardTelemetry 127.0.0.1:9801,127.0.0.1:9811]
 
 Shard health is the operator's concern (each shard exposes its own
 ``/healthz``); a shard that is down answers requests routed to it with
-``ok: false`` and an explanatory error instead of taking the router down.
+``ok: false`` and an explanatory error instead of taking the router down
+— and a shard whose telemetry endpoint is unreachable degrades to its
+absence in the federated view (counted in
+``ha_router_scrape_failures_total``), never to a router 500.
 """
 
 from __future__ import annotations
@@ -37,10 +52,18 @@ import asyncio
 import json
 import logging
 import sys
+import urllib.parse
+import urllib.request
 import zlib
 from typing import Any
 
 from tpu_render_cluster.obs import MetricsRegistry, get_registry
+from tpu_render_cluster.obs.prometheus import (
+    CONTENT_TYPE,
+    parse_prometheus,
+    render_prometheus,
+    render_sample_line,
+)
 from tpu_render_cluster.sched.control import MAX_LINE_BYTES, control_request
 
 logger = logging.getLogger(__name__)
@@ -163,6 +186,154 @@ class ShardRouter:
         return {"ok": False, "error": f"unknown op: {op!r}"}
 
 
+_JSON_CONTENT_TYPE = "application/json; charset=utf-8"
+
+
+class TelemetryFederation:
+    """Fan-out scraper over every shard's telemetry endpoints.
+
+    Serves (through ``TelemetryServer`` ``extra_routes``) a federated
+    ``/metrics`` and ``/history``: each shard is scraped concurrently,
+    its series re-tagged ``shard="<i>"``, and the merge re-served as one
+    document. Reuses the exposition parser/renderer (obs/prometheus.py)
+    so label escaping survives the round trip.
+    """
+
+    def __init__(
+        self,
+        telemetry_endpoints: list[tuple[str, int]],
+        *,
+        metrics: MetricsRegistry | None = None,
+        timeout: float = 5.0,
+    ) -> None:
+        if not telemetry_endpoints:
+            raise ValueError("TelemetryFederation needs at least one endpoint")
+        self.endpoints = telemetry_endpoints
+        self.timeout = timeout
+        self.metrics = metrics if metrics is not None else get_registry()
+        self._scrapes = self.metrics.counter(
+            "ha_router_scrapes_total",
+            "Federated telemetry scrapes issued to shards, by path",
+            labels=("path", "shard"),
+        )
+        self._scrape_failures = self.metrics.counter(
+            "ha_router_scrape_failures_total",
+            "Shard telemetry scrapes that failed (shard absent from the "
+            "federated view)",
+            labels=("shard",),
+        )
+
+    async def _fetch(self, shard: int, path_and_query: str) -> str | None:
+        host, port = self.endpoints[shard]
+        url = f"http://{host}:{port}{path_and_query}"
+        self._scrapes.inc(
+            path=path_and_query.partition("?")[0], shard=str(shard)
+        )
+
+        def get() -> str:
+            with urllib.request.urlopen(url, timeout=self.timeout) as response:
+                return response.read().decode("utf-8")
+
+        try:
+            return await asyncio.to_thread(get)
+        except Exception as e:  # noqa: BLE001 - a dead shard degrades, not breaks
+            logger.warning("Shard %d telemetry scrape %s failed: %s", shard, url, e)
+            self._scrape_failures.inc(shard=str(shard))
+            return None
+
+    @staticmethod
+    def _shard_series_key(label_str: str, shard: int) -> str:
+        suffix = f"shard={shard}"
+        return f"{label_str},{suffix}" if label_str else suffix
+
+    async def federated_metrics(
+        self, query: dict[str, str]
+    ) -> tuple[int, str, str]:
+        """Merged /metrics: router-own families first (typed), then every
+        shard's samples re-labeled ``shard="<i>"``."""
+        texts = await asyncio.gather(
+            *(self._fetch(i, "/metrics") for i in range(len(self.endpoints)))
+        )
+
+        def merge() -> str:
+            # O(total lines) regex parsing + re-rendering: off-loop, like
+            # the built-in /metrics render — the router's event loop also
+            # serves control traffic (submit/status/drain) and must not
+            # stall for the duration of a big federated scrape.
+            lines = [render_prometheus(self.metrics.snapshot()).rstrip("\n")]
+            for shard, text in enumerate(texts):
+                if text is None:
+                    continue
+                try:
+                    parsed = parse_prometheus(text)
+                except ValueError as e:
+                    logger.warning(
+                        "Shard %d served malformed exposition: %s", shard, e
+                    )
+                    self._scrape_failures.inc(shard=str(shard))
+                    continue
+                for name in sorted(parsed):
+                    for labels, value in parsed[name]:
+                        lines.append(
+                            render_sample_line(
+                                name, {**labels, "shard": str(shard)}, value
+                            )
+                        )
+            return "\n".join(line for line in lines if line) + "\n"
+
+        return 200, CONTENT_TYPE, await asyncio.to_thread(merge)
+
+    async def federated_history(
+        self, query: dict[str, str]
+    ) -> tuple[int, str, str]:
+        """Merged /history: the query is forwarded verbatim to every
+        shard; series responses merge under shard-tagged keys, summary
+        responses nest per shard."""
+        suffix = "/history"
+        if query:
+            suffix += "?" + urllib.parse.urlencode(query)
+        documents = await asyncio.gather(
+            *(self._fetch(i, suffix) for i in range(len(self.endpoints)))
+        )
+        shards: dict[str, Any] = {}
+        merged_series: dict[str, Any] = {}
+        merged_rest: dict[str, Any] = {}
+        for shard, text in enumerate(documents):
+            if text is None:
+                shards[str(shard)] = {"ok": False, "error": "unreachable"}
+                continue
+            try:
+                document = json.loads(text)
+            except json.JSONDecodeError as e:
+                shards[str(shard)] = {"ok": False, "error": f"bad JSON: {e}"}
+                self._scrape_failures.inc(shard=str(shard))
+                continue
+            if isinstance(document.get("series"), dict):
+                for label_str, series in document["series"].items():
+                    merged_series[
+                        self._shard_series_key(label_str, shard)
+                    ] = series
+                # Echo the query shape, not per-shard aggregates (a single
+                # shard's "merged" quantile would masquerade as global).
+                merged_rest = {
+                    k: document[k]
+                    for k in ("name", "kind", "query", "seconds", "q")
+                    if k in document
+                }
+                shards[str(shard)] = {"ok": bool(document.get("ok", True))}
+            else:
+                shards[str(shard)] = document
+        payload: dict[str, Any] = {
+            "ok": all(bool(entry.get("ok", True)) for entry in shards.values()),
+            "federated": True,
+            "shards": shards,
+        }
+        if merged_series:
+            payload.update(merged_rest)
+            payload["series"] = merged_series
+        return 200, _JSON_CONTENT_TYPE, json.dumps(payload, default=str)
+
+
 class ShardRouterServer:
     """JSON-lines TCP front end over a ``ShardRouter`` (the shard-side
     twin of ``sched/control.py``'s ``ControlServer``)."""
@@ -258,15 +429,73 @@ def build_parser() -> argparse.ArgumentParser:
         "shard (the `master serve --controlPort` addresses).",
     )
     parser.add_argument("--timeout", type=float, default=30.0)
+    parser.add_argument(
+        "--telemetryPort",
+        dest="telemetry_port",
+        type=int,
+        default=None,
+        help="Serve FEDERATED telemetry on this port: /metrics and "
+        "/history fan out to every --shardTelemetry endpoint and re-serve "
+        "the merged series tagged shard=\"<i>\" (0 picks an ephemeral "
+        "port). Defaults to the TRC_OBS_ROUTER_PORT environment variable; "
+        "omit both to disable.",
+    )
+    parser.add_argument(
+        "--shardTelemetry",
+        dest="shard_telemetry",
+        default=None,
+        help="Comma-separated host:port TELEMETRY endpoints, one per "
+        "shard in --shards order (each master's --telemetryPort address). "
+        "Required when --telemetryPort is set.",
+    )
     return parser
 
 
 async def serve(args: argparse.Namespace) -> int:
+    from tpu_render_cluster.obs.http import TelemetryServer, resolve_telemetry_port
+
     router = ShardRouter(
         parse_shard_list(args.shards), timeout=args.timeout
     )
     server = ShardRouterServer(router, args.host, args.control_port)
     await server.start()
+    telemetry = None
+    telemetry_port = resolve_telemetry_port(
+        args.telemetry_port, "TRC_OBS_ROUTER_PORT"
+    )
+    if telemetry_port is not None:
+        if not args.shard_telemetry:
+            raise SystemExit(
+                "--telemetryPort needs --shardTelemetry (one telemetry "
+                "host:port per shard)"
+            )
+        endpoints = parse_shard_list(args.shard_telemetry)
+        if len(endpoints) != len(router.shards):
+            raise SystemExit(
+                f"--shardTelemetry lists {len(endpoints)} endpoint(s) for "
+                f"{len(router.shards)} shard(s)"
+            )
+        federation = TelemetryFederation(
+            endpoints, metrics=router.metrics, timeout=args.timeout
+        )
+        telemetry = TelemetryServer(
+            router.metrics,
+            host=args.host,
+            port=telemetry_port,
+            healthz_fn=lambda: {
+                "role": "shard-router",
+                "shards": len(router.shards),
+            },
+            extra_routes={
+                "/metrics": federation.federated_metrics,
+                "/history": federation.federated_history,
+            },
+        )
+        await telemetry.start()
+        print(
+            f"Federated telemetry on {args.host}:{telemetry.port} "
+            f"(/metrics + /history across {len(endpoints)} shard(s))"
+        )
     print(
         f"Shard router on {args.host}:{server.port} over "
         f"{len(router.shards)} shard(s): "
@@ -275,6 +504,8 @@ async def serve(args: argparse.Namespace) -> int:
     try:
         await asyncio.Event().wait()  # serve until interrupted
     finally:
+        if telemetry is not None:
+            await telemetry.stop()
         await server.stop()
     return 0
 

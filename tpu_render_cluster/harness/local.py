@@ -304,6 +304,10 @@ def save_obs_artifacts(
             # cumulative — summarize_roofline keeps newest-wins per
             # kernel key.
             "roofline": _process_roofline(),
+            # Continuous-observability roll-up (obs/history.py): per-
+            # counter increase/rate/trend and per-gauge envelopes over the
+            # run's sampled window — the statistics.json `history` fold.
+            "history": manager.history.summary_dict(),
         },
     )
     return trace_path, metrics_path, cluster_trace_path

@@ -34,16 +34,27 @@ from tpu_render_cluster.master.state import ClusterManagerState, FrameStatus
 from tpu_render_cluster.master.strategies import run_strategy
 from tpu_render_cluster.master.worker_handle import WorkerHandle
 from tpu_render_cluster.obs import (
+    FlightRecorder,
+    HistorySampler,
+    HistoryStore,
     MetricsRegistry,
     SnapshotWriter,
     TimelineProcess,
     Tracer,
     get_registry,
     merge_wire,
+    resolve_flight_directory,
     tracer_process,
 )
+from tpu_render_cluster.obs.flightrec import (
+    TRIGGER_EPOCH_FENCE,
+    TRIGGER_JOB_FAILURE,
+    TRIGGER_MASTER_FAILOVER,
+    TRIGGER_SLO_ALERT,
+    TRIGGER_WORKER_EVICTION,
+)
 from tpu_render_cluster.obs.http import TelemetryServer
-from tpu_render_cluster.obs.slo import SloService, slo_loop
+from tpu_render_cluster.obs.slo import TRANSITION_FIRE, SloService, slo_loop
 from tpu_render_cluster.protocol import messages as pm
 from tpu_render_cluster.traces.master_trace import MasterTrace
 from tpu_render_cluster.traces.worker_trace import WorkerTrace
@@ -102,6 +113,7 @@ class ClusterManager:
         telemetry_port: int | None = None,
         ledger=None,
         ledger_resume: bool = False,
+        flight_directory: str | Path | None = None,
     ) -> None:
         self.host = host
         self.port = port
@@ -172,11 +184,34 @@ class ClusterManager:
             metrics=self.metrics,
             span_tracer=self.span_tracer,
         )
+        # Continuous observability (obs/history.py + obs/flightrec.py):
+        # the embedded metrics-history ring sampled by an in-process loop
+        # (started at bind, final sample at shutdown) serves /history and
+        # feeds the always-on flight recorder, which dumps a blackbox
+        # bundle on SLO fires, evictions, job failures, epoch-fence
+        # refusals, and failover adoption.
+        self.history = HistoryStore(self.metrics)
+        self._history_sampler = HistorySampler(self.history)
+        self.flightrec = FlightRecorder(
+            history=self.history,
+            span_tracer=self.span_tracer,
+            metrics=self.metrics,
+            directory=resolve_flight_directory(
+                flight_directory,
+                Path(metrics_snapshot_path).parent
+                if metrics_snapshot_path is not None
+                else None,
+            ),
+        )
         # Per-job SLO engine (obs/slo.py): fed by every winning result's
         # dispatch-to-result latency, ticked by a sidecar (single-job) or
         # the scheduler loop (service mode). Inert for jobs without an
         # [slo] table.
-        self.slo = SloService(metrics=self.metrics, span_tracer=self.span_tracer)
+        self.slo = SloService(
+            metrics=self.metrics,
+            span_tracer=self.span_tracer,
+            on_alert=self._on_slo_alert,
+        )
         # Pull-based telemetry endpoints (obs/http.py): /metrics (Prom
         # text exposition), /healthz, /clusterz (cluster_view). None =
         # disabled; 0 = ephemeral port (resolved after _bind_server).
@@ -187,6 +222,7 @@ class ClusterManager:
                 port=telemetry_port,
                 clusterz_fn=self.cluster_view,
                 healthz_fn=self._healthz_view,
+                history=self.history,
             )
             if telemetry_port is not None
             else None
@@ -223,6 +259,20 @@ class ClusterManager:
                 include_closed=ledger_resume,
                 spec=job.to_dict(),
             )
+            if self.replayed_units or self._replay_stitch_frames:
+                # This incarnation adopted a predecessor's in-flight job:
+                # record the takeover as a post-mortem bundle (the window
+                # is empty this early — the bundle documents the adoption
+                # itself: epoch, replayed unit count, pending stitches).
+                self.flightrec.trigger(
+                    TRIGGER_MASTER_FAILOVER,
+                    {
+                        "epoch": self.epoch,
+                        "replayed_units": self.replayed_units,
+                        "replay_stitch_frames": len(self._replay_stitch_frames),
+                        "job": job.job_name,
+                    },
+                )
 
     # -- multi-job hooks (overridden by sched/manager.py JobManager) --------
 
@@ -260,8 +310,24 @@ class ClusterManager:
         logger.info("Master listening on %s:%d", self.host, actual_port)
         if self._snapshot_writer is not None:
             self._snapshot_writer.start()
+        self._history_sampler.start()
         if self.telemetry is not None:
             await self.telemetry.start()
+
+    def _on_slo_alert(self, alert) -> None:
+        """SLO edge -> flight recorder: a FIRE is exactly the incident the
+        blackbox exists for (the clear is history, not an emergency)."""
+        if alert.transition == TRANSITION_FIRE:
+            self.flightrec.trigger(TRIGGER_SLO_ALERT, alert.to_dict())
+
+    def _on_worker_protocol_event(self, kind: str, detail: dict) -> None:
+        """Worker-handle digest feed for the flight recorder's ring; an
+        epoch-fence refusal additionally triggers a dump — stale traffic
+        arriving at a live master means a failover just happened and the
+        predecessor's final moments are worth keeping."""
+        self.flightrec.record_event(kind, **detail)
+        if kind == "stale_epoch_refusal":
+            self.flightrec.trigger(TRIGGER_EPOCH_FENCE, detail)
 
     def _healthz_view(self) -> dict:
         view = {
@@ -278,6 +344,7 @@ class ClusterManager:
         """Stop the writer, cancel, close worker sockets, close the server."""
         if self.telemetry is not None:
             await self.telemetry.stop()
+        await self._history_sampler.stop()
         if self._snapshot_writer is not None:
             await self._snapshot_writer.stop()
         self.cancellation.cancel()
@@ -374,6 +441,8 @@ class ClusterManager:
             view["speculation"] = self.speculation.view()
         if self.slo.tracked():
             view["slo"] = self.slo.view()
+        if self.flightrec.triggers or self.flightrec.dumps:
+            view["flight"] = self.flightrec.view()
         if worker_payloads:
             view["worker_metrics"] = worker_payloads
             # Payloads crossed the wire from workers we don't control;
@@ -549,6 +618,7 @@ class ClusterManager:
             state_resolver=self._state_for_job,
             on_frame_complete=self.assembly.schedule,
             on_unit_latency=self.slo.observe_unit_latency,
+            on_protocol_event=self._on_worker_protocol_event,
             epoch=self.epoch,
         )
         self.workers[worker_id] = worker
@@ -569,6 +639,14 @@ class ClusterManager:
     async def _evict_worker(self, worker: WorkerHandle, reason: str) -> None:
         """Return a dead worker's units to the pool so its jobs can finish."""
         logger.warning("Evicting worker %08x: %s", worker.worker_id, reason)
+        self.flightrec.trigger(
+            TRIGGER_WORKER_EVICTION,
+            {
+                "worker": pm.worker_id_to_string(worker.worker_id),
+                "reason": reason,
+                "queued_units": len(worker.queue),
+            },
+        )
         for frame in worker.queue.all_frames():
             state = self._state_for_job(frame.job_name)
             if state is None:
@@ -707,6 +785,16 @@ class ClusterManager:
                 # deadline verdict and the closing attainment are stamped
                 # whether the strategy finished or raised.
                 self.slo.finish_job(self.job.job_name)
+                if self.state.failed_reason:
+                    # Deterministic unit failure killed the job: dump the
+                    # window leading up to it while the evidence is warm.
+                    self.flightrec.trigger(
+                        TRIGGER_JOB_FAILURE,
+                        {
+                            "job": self.job.job_name,
+                            "reason": self.state.failed_reason,
+                        },
+                    )
                 # Accepted late results can finish a unit while its
                 # re-dispatched twin still sits queued on a live worker;
                 # the job is over, so those mirror entries are ghosts now

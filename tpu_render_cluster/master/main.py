@@ -134,11 +134,14 @@ def open_ledger(args: argparse.Namespace):
     import os
 
     from tpu_render_cluster.ha.ledger import JobLedger
+    from tpu_render_cluster.obs import get_registry
 
     directory = args.ledger_directory or os.environ.get("TRC_HA_LEDGER")
     if not directory:
         return None
-    ledger = JobLedger.open(directory)
+    # The CLI's managers default to the process-global registry, so the
+    # ledger's append-latency histogram lands in the same /metrics.
+    ledger = JobLedger.open(directory, metrics=get_registry())
     print(
         f"Job ledger at {directory}: epoch {ledger.epoch}, "
         f"{ledger.replay.records} record(s) replayed."
@@ -250,7 +253,10 @@ async def serve_command(args: argparse.Namespace) -> int:
             write_metrics_snapshot(
                 results_directory / f"{prefix}_metrics.json",
                 manager.metrics,
-                extra=manager.cluster_view(),
+                extra={
+                    **manager.cluster_view(),
+                    "history": manager.history.summary_dict(),
+                },
             )
 
         for step in (_save_model, _export_obs_artifacts):
@@ -363,7 +369,10 @@ async def run_job_command(args: argparse.Namespace) -> int:
             write_metrics_snapshot(
                 results_directory / f"{prefix}_metrics.json",
                 manager.metrics,
-                extra=manager.cluster_view(),
+                extra={
+                    **manager.cluster_view(),
+                    "history": manager.history.summary_dict(),
+                },
             )
 
         for step in (

@@ -95,6 +95,7 @@ class WorkerHandle:
     # them attribute-by-attribute) behave like epoch-less production ones.
     epoch: int | None = None
     _shutdown_started = False
+    _on_protocol_event = None
 
     def __init__(
         self,
@@ -112,6 +113,7 @@ class WorkerHandle:
         | None = None,
         on_unit_latency: Callable[[ClusterManagerState, WorkUnit, float], None]
         | None = None,
+        on_protocol_event: Callable[[str, dict], None] | None = None,
         epoch: int | None = None,
     ) -> None:
         self.worker_id = worker_id
@@ -160,6 +162,10 @@ class WorkerHandle:
         # Fires with each unit's winning-result dispatch-to-result latency
         # (the master_unit_latency_seconds stream) — the SLO engine's feed.
         self._on_unit_latency = on_unit_latency
+        # Flight-recorder digest feed (obs/flightrec.py): compact
+        # protocol-event summaries (dispatches, accepted results, fence
+        # refusals, death) — cheap enough for the hottest event paths.
+        self._on_protocol_event = on_protocol_event
         # Observed per-unit render durations (for scheduler cost models),
         # keyed (job_name, unit) — frame indices alias across jobs.
         self._rendering_started_at: dict[tuple[str, WorkUnit], float] = {}
@@ -228,6 +234,11 @@ class WorkerHandle:
             return
         self.is_dead = True
         self.logger.warning("Worker marked dead: %s", reason)
+        if self._on_protocol_event is not None:
+            self._on_protocol_event(
+                "worker_dead",
+                {"worker": self._worker_label(), "reason": reason},
+            )
         # Terminate the Perfetto flows of every assignment still mirrored
         # here: the requeued frames open fresh chains elsewhere, and a
         # dangling flow-start would fail the trace validator on artifacts
@@ -475,6 +486,17 @@ class WorkerHandle:
             )
         )
         self._update_queue_depth_gauge()
+        if self._on_protocol_event is not None:
+            self._on_protocol_event(
+                "dispatch",
+                {
+                    "worker": self._worker_label(),
+                    "job": job.job_name,
+                    "unit": unit.label,
+                    "speculative": speculative,
+                    "stolen_from": stolen_from,
+                },
+            )
         if not speculative:
             state.mark_frame_as_queued(
                 unit,
@@ -641,6 +663,18 @@ class WorkerHandle:
         state = self._state_for(event.job_name)
         if state is not None:
             state.ledger["stale_epoch_results"] += 1
+        if self._on_protocol_event is not None:
+            self._on_protocol_event(
+                "stale_epoch_refusal",
+                {
+                    "worker": self._worker_label(),
+                    "job": event.job_name,
+                    "unit": WorkUnit(event.frame_index, event.tile).label,
+                    "event": kind,
+                    "epoch": event.epoch,
+                    "current_epoch": self.epoch,
+                },
+            )
         self.logger.warning(
             "Refused %s event for unit %s with stale epoch %d "
             "(current epoch %d).",
@@ -966,6 +1000,16 @@ class WorkerHandle:
         latency_from = min(dispatch_times) if dispatch_times else processing_from
         latency = max(1e-4, now - latency_from)
         state.unit_seconds.append(latency)
+        if self._on_protocol_event is not None:
+            self._on_protocol_event(
+                "unit_finished",
+                {
+                    "worker": self._worker_label(),
+                    "job": job_name,
+                    "unit": unit.label,
+                    "latency_seconds": round(latency, 6),
+                },
+            )
         if self.metrics is not None:
             self.metrics.histogram(
                 "master_unit_latency_seconds",

@@ -21,6 +21,11 @@ instances so per-component views stay separable.
 from __future__ import annotations
 
 from tpu_render_cluster.obs.clocksync import ClockOffsetEstimator
+from tpu_render_cluster.obs.flightrec import (
+    FlightRecorder,
+    resolve_flight_directory,
+)
+from tpu_render_cluster.obs.history import HistorySampler, HistoryStore
 from tpu_render_cluster.obs.registry import (
     DEFAULT_BUCKETS,
     Counter,
@@ -47,8 +52,11 @@ __all__ = [
     "DEFAULT_BUCKETS",
     "ClockOffsetEstimator",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
+    "HistorySampler",
+    "HistoryStore",
     "MetricsRegistry",
     "SnapshotWriter",
     "TimelineProcess",
@@ -61,6 +69,7 @@ __all__ = [
     "merge_timeline",
     "merge_wire",
     "render_fps_gauge",
+    "resolve_flight_directory",
     "tracer_process",
     "validate_trace_document",
     "validate_trace_file",

@@ -10,7 +10,13 @@ polls a master's ``/metrics`` (Prometheus text exposition, parsed with
 - unit-latency percentiles reconstructed from the
   ``master_unit_latency_seconds`` histogram buckets;
 - the speculation and assembly ledgers;
-- SLO attainment/burn per job and the most recent alert edges.
+- SLO attainment/burn per job and the most recent alert edges;
+- sparkline columns over the embedded metrics history (``/history``,
+  obs/history.py): per-interval unit-completion rate and queue depth,
+  so a stall or burst is visible as a *shape*, not one number;
+- an HA section when the endpoint is the shard router's federated view
+  (ha/shards.py): per-shard routed requests, ledger append p99
+  (``ha_ledger_append_seconds``), and last-failover MTTR.
 
 Stdlib-only (urllib + ANSI clears), like the rest of ``obs``: the
 dashboard must run on any operator box that can reach the master, with
@@ -26,6 +32,7 @@ import json
 import sys
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Any, Iterable
 
@@ -33,14 +40,43 @@ from tpu_render_cluster.obs.prometheus import parse_prometheus
 
 __all__ = [
     "fetch_endpoints",
+    "fetch_history",
     "histogram_quantiles",
     "render_dashboard",
+    "sparkline",
     "main",
 ]
 
 Samples = dict[str, list[tuple[dict[str, str], float]]]
 
 _CLEAR = "\x1b[2J\x1b[H"
+
+# History series the dashboard sparklines by default: the unit-completion
+# counter (rendered as per-interval rate) and the queue-depth gauge.
+HISTORY_NAMES = (
+    "master_frame_results_total",
+    "master_worker_queue_depth",
+)
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: list[float], width: int = 32) -> str:
+    """Unicode block sparkline over ``values`` (newest right), resampled
+    to ``width`` columns; a flat series renders as a flat low line."""
+    if not values:
+        return ""
+    if len(values) > width:
+        # Keep the newest `width` points — the dashboard shows recency.
+        values = values[-width:]
+    lo, hi = min(values), max(values)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK_BLOCKS[0] * len(values)
+    top = len(_SPARK_BLOCKS) - 1
+    return "".join(
+        _SPARK_BLOCKS[int(round((v - lo) / span * top))] for v in values
+    )
 
 
 def fetch_endpoints(
@@ -64,8 +100,38 @@ def fetch_endpoints(
     return metrics, clusterz
 
 
+def fetch_history(
+    host: str,
+    port: int,
+    names: Iterable[str] = HISTORY_NAMES,
+    timeout: float = 5.0,
+) -> dict[str, Any]:
+    """Range series for each ``name`` from ``/history`` (absent store —
+    a pre-history master, a 404 — yields an empty dict, never a failed
+    poll)."""
+    out: dict[str, Any] = {}
+    for name in names:
+        url = (
+            f"http://{host}:{port}/history?name="
+            f"{urllib.parse.quote(name)}"
+        )
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as response:
+                document = json.loads(response.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+            return {}
+        if document.get("ok") and document.get("series"):
+            out[name] = document
+    return out
+
+
 def histogram_quantiles(
-    samples: Samples, name: str, quantiles: Iterable[float]
+    samples: Samples,
+    name: str,
+    quantiles: Iterable[float],
+    where: dict[str, str] | None = None,
 ) -> dict[float, float] | None:
     """Quantile estimates from a histogram's ``_bucket`` expansion.
 
@@ -73,14 +139,17 @@ def histogram_quantiles(
     the landing bucket (what promql's histogram_quantile does); the +Inf
     bucket clamps to the previous finite bound. Buckets with differing
     labels (multi-series histograms) are summed — the dashboard shows the
-    cluster-wide distribution. Returns None when the histogram is absent
-    or empty.
+    cluster-wide distribution — unless ``where`` narrows them (the HA
+    section computes per-shard percentiles from federated samples this
+    way). Returns None when the histogram is absent or empty.
     """
     rows = samples.get(f"{name}_bucket")
     if not rows:
         return None
     by_bound: dict[float, float] = {}
     for labels, value in rows:
+        if where and any(labels.get(k) != v for k, v in where.items()):
+            continue
         le = labels.get("le")
         if le is None:
             continue
@@ -137,8 +206,86 @@ def _fmt_share(value: Any) -> str:
     return f"{value:.2f}" if isinstance(value, (int, float)) else "-"
 
 
+def _history_sparkline_rows(history: dict[str, Any]) -> list[str]:
+    """Sparkline rows from /history range responses: counters render as
+    per-interval deltas (the *rate* shape), gauges as raw values."""
+    rows: list[str] = []
+    for name, document in sorted(history.items()):
+        kind = document.get("kind")
+        for label_str, series in sorted((document.get("series") or {}).items()):
+            values = [float(v) for v in series.get("v") or []]
+            if not values:
+                continue
+            if kind == "counter":
+                values = [
+                    b - a for a, b in zip(values, values[1:])
+                ] or values
+                suffix = f"rate~{values[-1]:g}/t" if values else ""
+            else:
+                suffix = f"last={values[-1]:g}"
+            label = f"{name}{{{label_str}}}" if label_str else name
+            rows.append(f"{label:<44.44} {sparkline(values):<32} {suffix}")
+    return rows
+
+
+def _ha_shard_ids(samples: Samples) -> list[str]:
+    """Shard ids present in the federated HA families ('all' fan-out rows
+    excluded — they aggregate, they aren't a shard)."""
+    shards: set[str] = set()
+    for name in (
+        "ha_router_requests_total",
+        "ha_router_jobs_routed_total",
+        "ha_router_scrapes_total",
+        "ha_ledger_append_seconds_count",
+        "ha_failover_mttr_seconds",
+    ):
+        for labels, _value in samples.get(name, ()):
+            shard = labels.get("shard")
+            if shard is not None and shard != "all":
+                shards.add(shard)
+    return sorted(shards, key=lambda s: (len(s), s))
+
+
+def _render_ha_section(samples: Samples) -> list[str]:
+    shards = _ha_shard_ids(samples)
+    if not shards:
+        return []
+    lines = ["", f"{'HA shard':<9} {'requests':>8} {'jobs':>5} "
+                 f"{'append p99':>11} {'last MTTR':>10}"]
+    for shard in shards:
+        requests = sum(
+            value
+            for labels, value in samples.get("ha_router_requests_total", ())
+            if labels.get("shard") == shard
+        )
+        jobs = sum(
+            value
+            for labels, value in samples.get("ha_router_jobs_routed_total", ())
+            if labels.get("shard") == shard
+        )
+        append_quantiles = histogram_quantiles(
+            samples,
+            "ha_ledger_append_seconds",
+            (0.99,),
+            where={"shard": shard},
+        )
+        mttr = _sample_value(
+            samples, "ha_failover_mttr_seconds", shard=shard
+        )
+        lines.append(
+            f"{'s' + shard:<9} {requests:>8.0f} {jobs:>5.0f} "
+            f"{_fmt_seconds(append_quantiles.get(0.99) if append_quantiles else None):>11} "
+            f"{_fmt_seconds(mttr):>10}"
+        )
+    return lines
+
+
 def render_dashboard(
-    samples: Samples, clusterz: dict[str, Any], *, now: float | None = None
+    samples: Samples,
+    clusterz: dict[str, Any],
+    *,
+    history: dict[str, Any] | None = None,
+    now: float | None = None,
 ) -> str:
     """One dashboard frame as plain text (pure: canned payloads in, text
     out — the tests and --once path share it with the live loop)."""
@@ -241,6 +388,26 @@ def render_dashboard(
             f"{str(alert.get('transition', '')).upper()}"
         )
 
+    lines.extend(_render_ha_section(samples))
+
+    if history:
+        rows = _history_sparkline_rows(history)
+        if rows:
+            lines.append("")
+            lines.append("history")
+            lines.extend(rows)
+
+    flight = clusterz.get("flight") or {}
+    if flight.get("triggers"):
+        lines.append(
+            "flight rec    "
+            + "  ".join(
+                f"{trigger} {count}"
+                for trigger, count in sorted(flight["triggers"].items())
+            )
+            + f"  ({len(flight.get('dumps') or [])} bundle(s))"
+        )
+
     return "\n".join(lines) + "\n"
 
 
@@ -262,10 +429,14 @@ def main(argv: list[str] | None = None) -> int:
     while True:
         try:
             samples, clusterz = fetch_endpoints(args.host, args.port)
+            try:
+                history = fetch_history(args.host, args.port)
+            except (OSError, urllib.error.URLError, ValueError):
+                history = {}  # sparklines degrade; the snapshot view stays
         except (OSError, urllib.error.URLError, ValueError) as e:
             frame = f"telemetry endpoint unreachable: {e}\n"
         else:
-            frame = render_dashboard(samples, clusterz)
+            frame = render_dashboard(samples, clusterz, history=history)
         if args.once:
             sys.stdout.write(frame)
             return 0

@@ -1,0 +1,501 @@
+"""Embedded metrics-history store: a bounded ring of registry samples.
+
+The telemetry plane so far is *instantaneous*: ``/metrics`` serves the
+current snapshot and the moments leading up to an incident (SLO breach,
+eviction, failover) are gone by the time anyone looks. This module keeps
+a short, bounded history in process memory — the same place the registry
+lives — so every master, worker, and scheduler service can answer range,
+``rate()``, and quantile-over-window queries (``/history`` in obs/http.py)
+and feed the flight recorder (obs/flightrec.py) without any external TSDB.
+
+Design:
+
+- **Fixed-interval samples** of every registered counter/gauge plus full
+  histogram bucket vectors, taken from ``MetricsRegistry.snapshot()`` by
+  an in-process sampler loop (``HistorySampler``).
+- **Delta-encoded**: counters and histogram bucket vectors store the
+  per-interval *increase* (zero-delta entries are omitted, so an idle
+  registry costs almost nothing per sample); gauges store raw values.
+  Absolute values are reconstructible because evicted samples fold their
+  deltas into per-series anchors (the absolute value at the ring's
+  trailing edge).
+- **Counter reset detection**: a raw value below the previous sample's
+  means the producing process restarted mid-series; the delta becomes the
+  raw value (the increase since the reset, exactly promql's ``rate()``
+  convention) and the sample records the reset so consumers can tell a
+  restart from a quiet interval.
+- **Bounded**: the ring holds ``retention / interval`` samples; both knobs
+  are env-tunable (``TRC_OBS_HISTORY_INTERVAL`` / ``TRC_OBS_HISTORY_RETENTION``).
+
+Queries reconstruct from deltas:
+
+- ``range_series(name)`` — absolute per-series time series;
+- ``rate(name, seconds)`` — increase/elapsed over the window (the first
+  retained sample's delta describes pre-window time and is excluded);
+- ``quantile(name, q, seconds)`` — quantile-over-window reconstructed
+  from bucket *deltas*, so it describes only the window's observations —
+  unlike the cumulative ``/metrics`` histogram, which never forgets.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+import threading
+import time
+from collections import deque
+from typing import Any
+
+from tpu_render_cluster.obs.registry import MetricsRegistry
+from tpu_render_cluster.utils.env import env_float
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "HistoryStore",
+    "HistorySampler",
+    "history_interval_seconds",
+    "history_retention_seconds",
+    "quantile_from_bucket_counts",
+]
+
+
+def history_interval_seconds() -> float:
+    return max(0.01, env_float("TRC_OBS_HISTORY_INTERVAL", 1.0))
+
+
+def history_retention_seconds() -> float:
+    return max(0.1, env_float("TRC_OBS_HISTORY_RETENTION", 600.0))
+
+
+def quantile_from_bucket_counts(
+    bounds: list[float], counts: list[float], q: float
+) -> float | None:
+    """Quantile estimate from per-bucket counts (NOT cumulative).
+
+    ``counts`` carries one entry per bound plus the +inf overflow. The
+    classic cumulative walk with linear interpolation inside the landing
+    bucket (what promql's histogram_quantile does); the overflow bucket
+    clamps to the last finite bound. None when the window saw nothing.
+    """
+    total = float(sum(counts))
+    if total <= 0:
+        return None
+    rank = q * total
+    cumulative = 0.0
+    previous_bound = 0.0
+    for i, bound in enumerate(bounds):
+        count = float(counts[i]) if i < len(counts) else 0.0
+        if cumulative + count >= rank and count > 0:
+            fraction = (rank - cumulative) / count
+            return previous_bound + fraction * (bound - previous_bound)
+        cumulative += count
+        previous_bound = bound
+    return bounds[-1] if bounds else None
+
+
+class HistoryStore:
+    """Bounded delta-encoded sample ring over one ``MetricsRegistry``."""
+
+    def __init__(
+        self,
+        registry: MetricsRegistry,
+        *,
+        interval: float | None = None,
+        retention: float | None = None,
+    ) -> None:
+        self.registry = registry
+        self.interval = (
+            interval if interval is not None else history_interval_seconds()
+        )
+        self.retention = (
+            retention if retention is not None else history_retention_seconds()
+        )
+        self.capacity = max(2, int(round(self.retention / self.interval)) + 1)
+        self._lock = threading.Lock()
+        # Serializes whole sample() passes: cancelling the sampler task
+        # does NOT stop an in-flight to_thread sample, so stop()'s final
+        # synchronous sample could otherwise interleave with it — both
+        # reading the same previous raw values (double-counted deltas)
+        # and appending out of timestamp order.
+        self._sample_lock = threading.Lock()
+        self._samples: deque[dict[str, Any]] = deque()
+        # Metric shape memory (name -> kind, histogram name -> bounds).
+        self._kinds: dict[str, str] = {}
+        self._bounds: dict[str, list[float]] = {}
+        # Last RAW values per series key ("name|label_str"), for deltas
+        # and reset detection. Touched only by sample() (single writer).
+        self._last_counter: dict[str, float] = {}
+        self._last_hist: dict[str, tuple[list[int], int, float]] = {}
+        # Absolute values at the ring's trailing edge: evicted samples
+        # fold their deltas here so range queries stay exact.
+        self._anchor_counter: dict[str, float] = {}
+        self._anchor_hist: dict[str, dict[str, Any]] = {}
+        self.samples_total = 0
+        self.resets_total = 0
+
+    # -- sampling ------------------------------------------------------------
+
+    def sample(self, now: float | None = None) -> None:
+        """Take one fixed-interval sample of the whole registry."""
+        with self._sample_lock:
+            self._sample_locked(now)
+
+    def _sample_locked(self, now: float | None) -> None:
+        # `now` resolved under the sample lock so two near-simultaneous
+        # callers cannot append out of timestamp order.
+        now = time.time() if now is None else now
+        snapshot = self.registry.snapshot()
+        counters: dict[str, float] = {}
+        gauges: dict[str, float] = {}
+        hists: dict[str, dict[str, Any]] = {}
+        resets: list[str] = []
+        for name, entry in snapshot.items():
+            kind = str(entry.get("type"))
+            self._kinds[name] = kind
+            if kind == "histogram":
+                self._bounds[name] = [
+                    float(b) for b in entry.get("bucket_bounds") or []
+                ]
+            for label_str, value in (entry.get("series") or {}).items():
+                key = f"{name}|{label_str}"
+                if kind == "counter":
+                    raw = float(value)
+                    previous = self._last_counter.get(key)
+                    if previous is None:
+                        delta = raw
+                    elif raw < previous:
+                        # The producing process restarted: the counter came
+                        # back below its old value, so the increase since
+                        # the reset is the raw value itself.
+                        delta = raw
+                        resets.append(key)
+                    else:
+                        delta = raw - previous
+                    self._last_counter[key] = raw
+                    if delta or previous is None:
+                        counters[key] = delta
+                elif kind == "gauge":
+                    gauges[key] = float(value)
+                elif kind == "histogram":
+                    buckets = [int(b) for b in value.get("bucket_counts") or []]
+                    count = int(value.get("count", 0))
+                    total = float(value.get("sum", 0.0))
+                    previous_hist = self._last_hist.get(key)
+                    if previous_hist is None:
+                        deltas, dn, ds = buckets, count, total
+                    else:
+                        pb, pn, ps = previous_hist
+                        if (
+                            count < pn
+                            or len(buckets) != len(pb)
+                            or any(b < p for b, p in zip(buckets, pb))
+                        ):
+                            deltas, dn, ds = buckets, count, total
+                            resets.append(key)
+                        else:
+                            deltas = [b - p for b, p in zip(buckets, pb)]
+                            dn, ds = count - pn, total - ps
+                    self._last_hist[key] = (buckets, count, total)
+                    if dn or previous_hist is None:
+                        hists[key] = {"b": deltas, "n": dn, "s": ds}
+        with self._lock:
+            self._samples.append(
+                {"t": now, "c": counters, "g": gauges, "h": hists, "r": resets}
+            )
+            self.samples_total += 1
+            self.resets_total += len(resets)
+            while len(self._samples) > self.capacity or (
+                len(self._samples) > 1
+                and now - self._samples[0]["t"] > self.retention
+            ):
+                self._fold_into_anchor(self._samples.popleft())
+
+    def _fold_into_anchor(self, evicted: dict[str, Any]) -> None:
+        for key, delta in evicted["c"].items():
+            self._anchor_counter[key] = (
+                self._anchor_counter.get(key, 0.0) + delta
+            )
+        for key, entry in evicted["h"].items():
+            base = self._anchor_hist.get(key)
+            if base is None or len(base["b"]) != len(entry["b"]):
+                self._anchor_hist[key] = {
+                    "b": list(entry["b"]),
+                    "n": entry["n"],
+                    "s": entry["s"],
+                }
+            else:
+                base["b"] = [a + b for a, b in zip(base["b"], entry["b"])]
+                base["n"] += entry["n"]
+                base["s"] += entry["s"]
+
+    # -- query plumbing ------------------------------------------------------
+
+    def _snapshot_samples(self) -> list[dict[str, Any]]:
+        with self._lock:
+            return list(self._samples)
+
+    def _window_samples(
+        self, seconds: float | None
+    ) -> list[dict[str, Any]]:
+        samples = self._snapshot_samples()
+        if seconds is None or not samples:
+            return samples
+        cutoff = samples[-1]["t"] - seconds
+        return [s for s in samples if s["t"] >= cutoff]
+
+    @staticmethod
+    def _keys_for(name: str, samples, *fields: str) -> set[str]:
+        prefix = f"{name}|"
+        keys: set[str] = set()
+        for sample in samples:
+            for field in fields:
+                keys.update(k for k in sample[field] if k.startswith(prefix))
+        return keys
+
+    # -- queries -------------------------------------------------------------
+
+    def window(self) -> tuple[float, float] | None:
+        with self._lock:
+            if not self._samples:
+                return None
+            return (self._samples[0]["t"], self._samples[-1]["t"])
+
+    def names(self) -> dict[str, str]:
+        return dict(self._kinds)
+
+    def samples_since(self, t0: float) -> list[dict[str, Any]]:
+        """Raw retained samples at or after ``t0`` (the flight recorder's
+        window cut)."""
+        return [s for s in self._snapshot_samples() if s["t"] >= t0]
+
+    def range_series(
+        self, name: str, seconds: float | None = None
+    ) -> dict[str, Any]:
+        """Absolute per-series time series for one metric.
+
+        Counters/histograms accumulate anchor + deltas (so the values are
+        cumulative increase since the store first saw the series — after
+        a reset they keep growing rather than re-dropping to the raw
+        post-restart value). Gauges are raw samples. A ``seconds`` window
+        limits which POINTS are emitted, never the baseline: deltas of
+        retained samples older than the cutoff still accumulate before
+        the first emitted point, so windowed values stay absolute.
+        """
+        kind = self._kinds.get(name)
+        samples = self._snapshot_samples()
+        cutoff = (
+            samples[-1]["t"] - seconds
+            if seconds is not None and samples
+            else -math.inf
+        )
+        prefix = f"{name}|"
+        out: dict[str, Any] = {}
+        if kind == "gauge":
+            for sample in samples:
+                if sample["t"] < cutoff:
+                    continue
+                for key, value in sample["g"].items():
+                    if not key.startswith(prefix):
+                        continue
+                    series = out.setdefault(
+                        key[len(prefix):], {"t": [], "v": []}
+                    )
+                    series["t"].append(sample["t"])
+                    series["v"].append(value)
+            return out
+        if kind == "counter":
+            keys = self._keys_for(name, samples, "c")
+            with self._lock:
+                running = {
+                    k: self._anchor_counter.get(k, 0.0) for k in keys
+                }
+            for sample in samples:
+                for key in keys:
+                    running[key] += sample["c"].get(key, 0.0)
+                    if sample["t"] < cutoff:
+                        continue
+                    series = out.setdefault(
+                        key[len(prefix):], {"t": [], "v": []}
+                    )
+                    series["t"].append(sample["t"])
+                    series["v"].append(running[key])
+            return out
+        if kind == "histogram":
+            keys = self._keys_for(name, samples, "h")
+            with self._lock:
+                anchors = {
+                    k: dict(self._anchor_hist.get(k) or {"n": 0, "s": 0.0})
+                    for k in keys
+                }
+            running_n = {k: int(anchors[k].get("n", 0)) for k in keys}
+            running_s = {k: float(anchors[k].get("s", 0.0)) for k in keys}
+            for sample in samples:
+                for key in keys:
+                    entry = sample["h"].get(key)
+                    if entry is not None:
+                        running_n[key] += entry["n"]
+                        running_s[key] += entry["s"]
+                    if sample["t"] < cutoff:
+                        continue
+                    series = out.setdefault(
+                        key[len(prefix):], {"t": [], "count": [], "sum": []}
+                    )
+                    series["t"].append(sample["t"])
+                    series["count"].append(running_n[key])
+                    series["sum"].append(running_s[key])
+            return out
+        return {}
+
+    def rate(
+        self, name: str, seconds: float | None = None
+    ) -> dict[str, float]:
+        """Per-series increase/second over the window (counters; for
+        histograms the observation-count rate). The first retained
+        sample's delta describes pre-window time and is excluded."""
+        samples = self._window_samples(seconds)
+        if len(samples) < 2:
+            return {}
+        elapsed = samples[-1]["t"] - samples[0]["t"]
+        if elapsed <= 0:
+            return {}
+        prefix = f"{name}|"
+        kind = self._kinds.get(name)
+        increase: dict[str, float] = {}
+        for sample in samples[1:]:
+            if kind == "histogram":
+                for key, entry in sample["h"].items():
+                    if key.startswith(prefix):
+                        increase[key] = increase.get(key, 0.0) + entry["n"]
+            else:
+                for key, delta in sample["c"].items():
+                    if key.startswith(prefix):
+                        increase[key] = increase.get(key, 0.0) + delta
+        return {
+            key[len(prefix):]: total / elapsed
+            for key, total in increase.items()
+        }
+
+    def quantile(
+        self, name: str, q: float, seconds: float | None = None
+    ) -> dict[str, Any]:
+        """Quantile-over-window from bucket deltas, per series plus the
+        all-series merge (the cluster-wide view the dashboard shows)."""
+        bounds = self._bounds.get(name)
+        if not bounds:
+            return {"series": {}, "merged": None}
+        samples = self._window_samples(seconds)
+        prefix = f"{name}|"
+        per_series: dict[str, list[float]] = {}
+        merged = [0.0] * (len(bounds) + 1)
+        for sample in samples[1:] if len(samples) > 1 else samples:
+            for key, entry in sample["h"].items():
+                if not key.startswith(prefix):
+                    continue
+                counts = per_series.setdefault(
+                    key[len(prefix):], [0.0] * (len(bounds) + 1)
+                )
+                for i, delta in enumerate(entry["b"][: len(counts)]):
+                    counts[i] += delta
+                    merged[i] += delta
+        return {
+            "series": {
+                label: quantile_from_bucket_counts(bounds, counts, q)
+                for label, counts in per_series.items()
+            },
+            "merged": quantile_from_bucket_counts(bounds, merged, q),
+        }
+
+    # -- export --------------------------------------------------------------
+
+    def meta(self) -> dict[str, Any]:
+        window = self.window()
+        with self._lock:
+            retained = len(self._samples)
+        return {
+            "interval_seconds": self.interval,
+            "retention_seconds": self.retention,
+            "samples": retained,
+            "samples_total": self.samples_total,
+            "resets_total": self.resets_total,
+            "window": list(window) if window else None,
+        }
+
+    def summary_dict(self) -> dict[str, Any]:
+        """Compact roll-up stamped into metrics artifacts (the
+        ``statistics.json`` fold consumes it): per-counter increase + rate
+        + trend (second-half rate / first-half rate) over the retained
+        window, per-gauge last/min/max/mean."""
+        samples = self._snapshot_samples()
+        out: dict[str, Any] = {**self.meta(), "counters": {}, "gauges": {}}
+        if len(samples) < 2:
+            return out
+        t0, t1 = samples[0]["t"], samples[-1]["t"]
+        elapsed = t1 - t0
+        mid = t0 + elapsed / 2.0
+        increase: dict[str, float] = {}
+        halves: dict[str, list[float]] = {}
+        for sample in samples[1:]:
+            late = sample["t"] >= mid
+            for key, delta in sample["c"].items():
+                increase[key] = increase.get(key, 0.0) + delta
+                half = halves.setdefault(key, [0.0, 0.0])
+                half[1 if late else 0] += delta
+        for key, total in increase.items():
+            entry: dict[str, Any] = {"increase": total}
+            if elapsed > 0:
+                entry["rate_per_second"] = total / elapsed
+                early, late = halves[key]
+                if early > 0:
+                    entry["trend"] = late / early
+            out["counters"][key] = entry
+        gauge_values: dict[str, list[float]] = {}
+        for sample in samples:
+            for key, value in sample["g"].items():
+                gauge_values.setdefault(key, []).append(value)
+        for key, values in gauge_values.items():
+            out["gauges"][key] = {
+                "last": values[-1],
+                "min": min(values),
+                "max": max(values),
+                "mean": sum(values) / len(values),
+            }
+        return out
+
+
+class HistorySampler:
+    """Asyncio-periodic sampler feeding one ``HistoryStore`` (the history
+    analog of ``SnapshotWriter``). ``stop()`` takes a final sample so runs
+    shorter than one interval still leave a usable window behind."""
+
+    def __init__(self, store: HistoryStore) -> None:
+        self.store = store
+        self._task: asyncio.Task | None = None
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                # snapshot() + delta fold go to a thread so a large
+                # registry never stalls heartbeat service on the loop.
+                await asyncio.to_thread(self.store.sample)
+            except Exception as e:  # noqa: BLE001 - observability must not kill jobs
+                logger.warning("History sample failed: %s", e)
+            await asyncio.sleep(self.store.interval)
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._run(), name="obs-history-sampler")
+
+    async def stop(self, *, final_sample: bool = True) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        if final_sample:
+            try:
+                self.store.sample()
+            except Exception as e:  # noqa: BLE001
+                logger.warning("Final history sample failed: %s", e)

@@ -9,7 +9,18 @@ process-with-a-registry:
   ``{"ok": true}``);
 - ``GET /clusterz`` — JSON live cluster view (``clusterz_fn``, the
   master's ``cluster_view()``; 404 on processes that have none, e.g. a
-  worker daemon).
+  worker daemon);
+- ``GET /history`` — the embedded metrics-history store (obs/history.py;
+  404 on processes without one): a summary with no query, or
+  ``?name=X[&seconds=S]`` for absolute range series,
+  ``?name=X&query=rate[&seconds=S]`` for increase/second, and
+  ``?name=X&query=quantile&q=0.99[&seconds=S]`` for
+  quantile-over-window reconstructed from bucket deltas.
+
+``extra_routes`` maps a path to an async handler ``(query) -> (status,
+content_type, body)`` and takes precedence over the built-ins — the HA
+shard router uses it to serve *federated* ``/metrics`` + ``/history``
+merged across every master shard (ha/shards.py).
 
 Replaces file-polling of ``metrics-live.json`` as the LIVE inspection
 path (the snapshot writer stays for post-hoc artifacts): an operator —
@@ -30,8 +41,10 @@ import asyncio
 import json
 import logging
 import time
-from typing import Any, Callable
+import urllib.parse
+from typing import Any, Awaitable, Callable
 
+from tpu_render_cluster.obs.history import HistoryStore
 from tpu_render_cluster.obs.prometheus import CONTENT_TYPE, render_prometheus
 from tpu_render_cluster.obs.registry import MetricsRegistry
 
@@ -68,12 +81,19 @@ class TelemetryServer:
         port: int = 0,
         clusterz_fn: Callable[[], dict[str, Any]] | None = None,
         healthz_fn: Callable[[], dict[str, Any]] | None = None,
+        history: HistoryStore | None = None,
+        extra_routes: dict[
+            str, Callable[[dict[str, str]], Awaitable[tuple[int, str, str]]]
+        ]
+        | None = None,
     ) -> None:
         self.registry = registry
         self.host = host
         self.port = port
         self.clusterz_fn = clusterz_fn
         self.healthz_fn = healthz_fn
+        self.history = history
+        self.extra_routes = dict(extra_routes or {})
         self.started_at = time.time()
         self._server: asyncio.Server | None = None
 
@@ -130,9 +150,11 @@ class TelemetryServer:
                     head_only=method == "HEAD",
                 )
                 return
-            path = target.partition("?")[0]
+            path, _, query_string = target.partition("?")
             try:
-                status, content_type, body = await self._route(path)
+                status, content_type, body = await self._route(
+                    path, query_string
+                )
             except Exception as e:  # noqa: BLE001 - one bad scrape must not kill the plane
                 # Answer with a self-diagnosing 500 instead of slamming the
                 # socket: a lint-refused metric or a clusterz_fn raising
@@ -154,7 +176,16 @@ class TelemetryServer:
         finally:
             writer.close()
 
-    async def _route(self, path: str) -> tuple[int, str, str]:
+    async def _route(
+        self, path: str, query_string: str = ""
+    ) -> tuple[int, str, str]:
+        query = {
+            name: values[-1]
+            for name, values in urllib.parse.parse_qs(query_string).items()
+        }
+        handler = self.extra_routes.get(path)
+        if handler is not None:
+            return await handler(query)
         if path == "/metrics":
             # Snapshot + render in a thread: the registry lock is cheap but
             # serialization of a big registry is not.
@@ -174,10 +205,63 @@ class TelemetryServer:
                 )
             view = self.clusterz_fn()
             return 200, _JSON_CONTENT_TYPE, json.dumps(view, default=str)
+        if path == "/history":
+            if self.history is None:
+                return 404, _JSON_CONTENT_TYPE, json.dumps(
+                    {"ok": False, "error": "no history store on this process"}
+                )
+            # Query reconstruction walks the sample ring; off-loop like
+            # the /metrics render.
+            payload = await asyncio.to_thread(self._history_query, query)
+            return 200, _JSON_CONTENT_TYPE, json.dumps(payload, default=str)
+        paths = ["/metrics", "/healthz", "/clusterz"]
+        if self.history is not None:
+            paths.append("/history")
+        paths.extend(sorted(self.extra_routes))
         return 404, _JSON_CONTENT_TYPE, json.dumps(
-            {"ok": False, "error": f"unknown path {path!r}",
-             "paths": ["/metrics", "/healthz", "/clusterz"]}
+            {"ok": False, "error": f"unknown path {path!r}", "paths": paths}
         )
+
+    def _history_query(self, query: dict[str, str]) -> dict[str, Any]:
+        """One /history query against the embedded store (obs/history.py)."""
+        store = self.history
+        assert store is not None
+        name = query.get("name")
+        if not name:
+            return {"ok": True, **store.meta(), "names": store.names()}
+        seconds = None
+        if query.get("seconds"):
+            try:
+                seconds = float(query["seconds"])
+            except ValueError:
+                return {"ok": False, "error": f"bad seconds={query['seconds']!r}"}
+        kind = store.names().get(name)
+        what = query.get("query", "range")
+        out: dict[str, Any] = {
+            "ok": True,
+            "name": name,
+            "kind": kind,
+            "query": what,
+            "seconds": seconds,
+        }
+        if what == "range":
+            out["series"] = store.range_series(name, seconds)
+        elif what == "rate":
+            out["series"] = store.rate(name, seconds)
+        elif what == "quantile":
+            try:
+                q = float(query.get("q", "0.99"))
+            except ValueError:
+                return {"ok": False, "error": f"bad q={query.get('q')!r}"}
+            out["q"] = q
+            out.update(store.quantile(name, q, seconds))
+        else:
+            return {
+                "ok": False,
+                "error": f"unknown query {what!r} "
+                "(expected range | rate | quantile)",
+            }
+        return out
 
     @staticmethod
     async def _respond(

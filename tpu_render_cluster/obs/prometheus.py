@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import math
 import re
-from typing import Any, Iterator
+from typing import Any, Iterator, Mapping
 
 __all__ = [
     "CONTENT_TYPE",
@@ -36,6 +36,7 @@ __all__ = [
     "lint_metric",
     "lint_snapshot",
     "render_prometheus",
+    "render_sample_line",
     "parse_prometheus",
 ]
 
@@ -218,6 +219,18 @@ def _render_metric_lines(
         yield f"{name}_bucket{_render_labels(inf_pairs)} {cumulative}"
         yield f"{name}_sum{_render_labels(pairs)} {_format_value(data.get('sum', 0.0))}"
         yield f"{name}_count{_render_labels(pairs)} {int(data.get('count', 0))}"
+
+
+def render_sample_line(
+    name: str, labels: Mapping[str, str], value: float
+) -> str:
+    """One exposition sample line from already-parsed pieces.
+
+    The inverse of one ``parse_prometheus`` row — the federation path
+    (ha/shards.py) re-serves scraped samples re-labeled with their shard,
+    and hand-assembled f-strings would skip the escaping rules.
+    """
+    return f"{name}{_render_labels(list(labels.items()))} {_format_value(value)}"
 
 
 def render_prometheus(snapshot: dict[str, Any]) -> str:

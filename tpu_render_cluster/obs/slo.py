@@ -325,11 +325,17 @@ class SloService:
         *,
         metrics: "MetricsRegistry | None" = None,
         span_tracer: "Tracer | None" = None,
+        on_alert=None,
     ) -> None:
         self.metrics = metrics
         self.span_tracer = span_tracer
         self.trackers: dict[str, SloTracker] = {}
         self.alerts: deque[SloAlert] = deque(maxlen=self.MAX_ALERTS)
+        # Fires with every alert edge AFTER the three standard sinks — the
+        # flight recorder's trigger seam (master/cluster.py dumps a
+        # blackbox on each FIRE). Failures are contained: an alert must
+        # land in the log/counter/track even when the hook explodes.
+        self.on_alert = on_alert
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -453,6 +459,11 @@ class SloService:
                 track="alerts",
                 args=alert.to_dict(),
             )
+        if self.on_alert is not None:
+            try:
+                self.on_alert(alert)
+            except Exception as e:  # noqa: BLE001 - sinks above already landed
+                logger.warning("SLO on_alert hook failed: %s", e)
 
     # -- views ---------------------------------------------------------------
 

@@ -41,6 +41,8 @@ __all__ = [
     "validate_trace_events",
     "validate_trace_document",
     "validate_trace_file",
+    "validate_blackbox_document",
+    "validate_blackbox_file",
 ]
 
 # End-time ordering tolerance per track, in trace microseconds. Spans anchor
@@ -207,3 +209,80 @@ def validate_trace_file(path: str | Path) -> list[str]:
     except (OSError, json.JSONDecodeError) as e:
         return [f"{path}: unreadable ({e})"]
     return [f"{path}: {p}" for p in validate_trace_document(document)]
+
+
+def validate_blackbox_document(document: Any) -> list[str]:
+    """Validate a flight-recorder blackbox bundle (obs/flightrec.py).
+
+    A bundle IS a trace document (its ``traceEvents`` must satisfy every
+    trace invariant — a post-mortem that lies in Perfetto is worse than
+    none) plus a ``blackbox`` section whose window must be coherent: a
+    finite ``[t0, t1]`` ordered pair with ``dumped_at`` at the closing
+    edge, and every metric sample / protocol digest stamped inside it.
+    """
+    problems = validate_trace_document(document)
+    if not isinstance(document, dict):
+        return problems
+    box = document.get("blackbox")
+    if not isinstance(box, dict):
+        problems.append("blackbox: section missing or not an object")
+        return problems
+    trigger = box.get("trigger")
+    if not isinstance(trigger, str) or not trigger:
+        problems.append("blackbox: missing trigger")
+    window = box.get("window")
+    if (
+        not isinstance(window, list)
+        or len(window) != 2
+        or not all(
+            isinstance(edge, (int, float)) and math.isfinite(edge)
+            for edge in window
+        )
+        or window[0] > window[1]
+    ):
+        problems.append(f"blackbox: malformed window {window!r}")
+        return problems
+    t0, t1 = float(window[0]), float(window[1])
+    dumped_at = box.get("dumped_at")
+    if not isinstance(dumped_at, (int, float)) or not (
+        t0 <= float(dumped_at) <= t1 + 1e-6
+    ):
+        problems.append(
+            f"blackbox: dumped_at {dumped_at!r} outside window [{t0}, {t1}]"
+        )
+    # A fraction of a sampling interval of slack at the edges: the sampler
+    # stamps before the recorder computes its cut.
+    slack = 1e-3
+    previous_t = -math.inf
+    for i, sample in enumerate(box.get("metric_samples") or []):
+        at = sample.get("t") if isinstance(sample, dict) else None
+        if not isinstance(at, (int, float)) or not (
+            t0 - slack <= float(at) <= t1 + slack
+        ):
+            problems.append(
+                f"blackbox: metric sample #{i} at {at!r} outside the window"
+            )
+            continue
+        if float(at) < previous_t:
+            problems.append(
+                f"blackbox: metric sample #{i} out of time order"
+            )
+        previous_t = float(at)
+    for i, event in enumerate(box.get("protocol_events") or []):
+        at = event.get("t") if isinstance(event, dict) else None
+        if not isinstance(at, (int, float)) or not (
+            t0 - slack <= float(at) <= t1 + slack
+        ):
+            problems.append(
+                f"blackbox: protocol event #{i} at {at!r} outside the window"
+            )
+    return problems
+
+
+def validate_blackbox_file(path: str | Path) -> list[str]:
+    path = Path(path)
+    try:
+        document = json.loads(path.read_text(encoding="utf-8"))
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{path}: unreadable ({e})"]
+    return [f"{path}: {p}" for p in validate_blackbox_document(document)]

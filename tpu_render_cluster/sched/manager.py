@@ -339,6 +339,20 @@ class JobManager(ClusterManager):
                         job_id,
                         run.state.failed_reason,
                     )
+                    # Flight-recorder seam: dump the window leading up to
+                    # the failure before the cancel sweeps its state.
+                    from tpu_render_cluster.obs.flightrec import (
+                        TRIGGER_JOB_FAILURE,
+                    )
+
+                    self.flightrec.trigger(
+                        TRIGGER_JOB_FAILURE,
+                        {
+                            "job_id": job_id,
+                            "job": run.job_name,
+                            "reason": run.state.failed_reason,
+                        },
+                    )
                     await self.cancel_job(job_id)
             if self._draining and not self._running and self._admission:
                 # Liveness under drain: a queued job whose worker barrier

@@ -198,9 +198,19 @@ async def _run_worker(
     except (NotImplementedError, RuntimeError):  # non-Unix loop
         pass
     telemetry = None
+    history_sampler = None
     if telemetry_port is not None:
+        from tpu_render_cluster.obs import HistorySampler, HistoryStore
         from tpu_render_cluster.obs.http import TelemetryServer
 
+        # The worker's own metrics-history ring (obs/history.py): the
+        # /history endpoint answers range/rate/quantile-over-window
+        # queries so an operator (or the federated router) can see the
+        # moments leading up to an incident on THIS daemon, not just the
+        # cumulative /metrics snapshot.
+        history = HistoryStore(worker.metrics)
+        history_sampler = HistorySampler(history)
+        history_sampler.start()
         telemetry = TelemetryServer(
             worker.metrics,
             host=telemetry_host,
@@ -210,6 +220,7 @@ async def _run_worker(
                 "worker_id": pm.worker_id_to_string(worker.worker_id),
                 "backend": type(worker.backend).__name__,
             },
+            history=history,
         )
         await telemetry.start()
     try:
@@ -217,6 +228,8 @@ async def _run_worker(
     finally:
         if telemetry is not None:
             await telemetry.stop()
+        if history_sampler is not None:
+            await history_sampler.stop()
         try:
             loop.remove_signal_handler(signal.SIGTERM)
         except (NotImplementedError, RuntimeError, ValueError):
