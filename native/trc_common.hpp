@@ -56,6 +56,10 @@ int trc_parse_header(const uint8_t* buf, size_t len, uint8_t* opcode, int* fin,
 // Small utilities
 
 // Timed condition-variable waits, routed through a system_clock deadline.
+// trc-sanitizer-suppression: pthread_cond_clockwait is uninstrumented in
+// older TSAN runtimes — the rerouted wait dodges a FALSE positive, not a
+// real race (audited by tests/test_cpp_sanitizers.py, which pins the
+// count of these markers so new ones cannot land silently).
 // libstdc++ (GCC 10+) lowers wait_for / steady_clock wait_until to
 // pthread_cond_clockwait, which older TSAN runtimes do not intercept — the
 // wait's internal mutex release becomes invisible and every subsequent

@@ -1,0 +1,21 @@
+#!/usr/bin/env python3
+"""Standalone trc-lint entry point (the validate_trace.py contract: works
+from a bare checkout with no package install and any cwd).
+
+    python scripts/lint.py [--json] [--passes loop-blocking,env-registry]
+
+Equivalent to ``python -m tpu_render_cluster.lint`` run from the repo
+root; see that module (tpu_render_cluster/lint/) for the pass catalog.
+"""
+
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+from tpu_render_cluster.lint.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,9 +24,44 @@ import pytest
 
 from tpu_render_cluster.native import build_master_daemon, build_worker_daemon
 
-pytestmark = pytest.mark.skipif(
+requires_gxx = pytest.mark.skipif(
     shutil.which("g++") is None, reason="g++ unavailable"
 )
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
+
+# The canonical marker every sanitizer workaround in the C++ sources must
+# carry (grep-able, reason required on the same comment). The count is
+# PINNED below: adding a workaround without updating the pin — and writing
+# down why it is a false positive — fails the suite, so the suppression
+# surface cannot grow silently.
+_SUPPRESSION_MARKER = "trc-sanitizer-suppression:"
+_EXPECTED_SUPPRESSIONS = 1  # trc_common.hpp cv_wait_for (uninstrumented
+#                             pthread_cond_clockwait in older TSAN runtimes)
+
+
+def test_sanitizer_suppression_count_is_pinned():
+    """Source-scan audit (runs even without a toolchain): every sanitizer
+    workaround is marked, reasoned, and counted."""
+    markers: list[tuple[str, int, str]] = []
+    for source in sorted(_NATIVE_DIR.glob("*.[ch]pp")):
+        for lineno, line in enumerate(
+            source.read_text().splitlines(), start=1
+        ):
+            if _SUPPRESSION_MARKER in line:
+                reason = line.split(_SUPPRESSION_MARKER, 1)[1].strip()
+                markers.append((source.name, lineno, reason))
+    for name, lineno, reason in markers:
+        assert reason, (
+            f"{name}:{lineno}: sanitizer suppression without a reason — "
+            f"write `// {_SUPPRESSION_MARKER} <why this is a false positive>`"
+        )
+    assert len(markers) == _EXPECTED_SUPPRESSIONS, (
+        f"sanitizer suppression count changed: expected "
+        f"{_EXPECTED_SUPPRESSIONS}, found {len(markers)}: {markers}. If the "
+        "new workaround is justified, update _EXPECTED_SUPPRESSIONS in the "
+        "same change — silent growth is exactly what this pin exists to stop."
+    )
 
 _SANITIZER_ENV = {
     "thread": {"TSAN_OPTIONS": "exitcode=66 halt_on_error=0"},
@@ -83,6 +118,7 @@ min_seconds_before_resteal_to_original_worker = 2
     return job_path
 
 
+@requires_gxx
 @pytest.mark.parametrize("sanitize", ["thread", "address"])
 def test_sanitized_cluster_run(tmp_path, sanitize):
     if not _sanitizer_works(sanitize):
@@ -154,5 +190,17 @@ def test_sanitized_cluster_run(tmp_path, sanitize):
     assert "SUMMARY:" not in master_err, master_err[-4000:]
     for rc, err in worker_reports:
         assert rc != 66 and "SUMMARY:" not in err, err[-4000:]
+        # Not just "the binaries started": each instrumented worker must
+        # have completed the 3-step handshake, received the job broadcast,
+        # and run the frame exchange through to the trace hand-off — the
+        # protocol paths are exactly where the hand-threaded daemons race.
+        assert "Job started." in err, (
+            f"{sanitize}-sanitized worker never completed the handshake/"
+            f"job-start exchange:\n{err[-4000:]}"
+        )
+        assert "Job finished; sending trace." in err, (
+            f"{sanitize}-sanitized worker never reached the job-finished "
+            f"exchange:\n{err[-4000:]}"
+        )
     rendered = sorted((tmp_path / "frames").glob("rendered-*.png"))
     assert len(rendered) == frames

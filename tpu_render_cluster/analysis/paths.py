@@ -19,19 +19,15 @@ has no mkdir side effects — callers create what they write.
 
 from __future__ import annotations
 
-import os
 from pathlib import Path
+from tpu_render_cluster.utils.env import env_str
 
 REPO_ROOT = Path(__file__).resolve().parents[2]
 
 BLENDER_PROJECTS_DIR = REPO_ROOT / "blender-projects"
 
-RESULTS_ROOT = Path(os.environ.get("TRC_RESULTS_ROOT", REPO_ROOT / "results"))
+RESULTS_ROOT = Path(env_str("TRC_RESULTS_ROOT") or REPO_ROOT / "results")
 
-DEFAULT_RESULTS_DIR = Path(
-    os.environ.get("TRC_RESULTS_DIR", RESULTS_ROOT / "cluster-runs")
-)
-DEFAULT_ANALYSIS_DIR = Path(
-    os.environ.get("TRC_ANALYSIS_DIR", RESULTS_ROOT / "analysis")
-)
+DEFAULT_RESULTS_DIR = Path(env_str("TRC_RESULTS_DIR") or RESULTS_ROOT / "cluster-runs")
+DEFAULT_ANALYSIS_DIR = Path(env_str("TRC_ANALYSIS_DIR") or RESULTS_ROOT / "analysis")
 DEFAULT_CACHE_DIR = RESULTS_ROOT / ".trace-cache"

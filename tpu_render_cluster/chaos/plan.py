@@ -24,12 +24,12 @@ from __future__ import annotations
 
 import hashlib
 import json
-import os
 from dataclasses import asdict, dataclass, field, fields
 from pathlib import Path
 from typing import Any
 
 import numpy as np
+from tpu_render_cluster.utils.env import env_int, env_str
 
 try:
     import tomllib
@@ -451,10 +451,10 @@ class FaultPlan:
     def from_env(cls) -> "FaultPlan":
         """``TRC_CHAOS_PLAN`` (TOML path) wins; else a generated plan from
         ``TRC_CHAOS_SEED`` / ``TRC_CHAOS_WORKERS`` (defaults 0 / 3)."""
-        plan_path = os.environ.get("TRC_CHAOS_PLAN")
+        plan_path = env_str("TRC_CHAOS_PLAN")
         if plan_path:
             return cls.from_toml(plan_path)
         return cls.generate(
-            int(os.environ.get("TRC_CHAOS_SEED", "0") or "0"),
-            int(os.environ.get("TRC_CHAOS_WORKERS", "3") or "3"),
+            env_int("TRC_CHAOS_SEED", 0),
+            env_int("TRC_CHAOS_WORKERS", 3),
         )

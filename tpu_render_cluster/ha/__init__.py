@@ -19,12 +19,14 @@ Three pieces make the master survivable and horizontally scalable:
 """
 
 from tpu_render_cluster.ha.ledger import (
+    AsyncLedgerAppender,
     JobLedger,
     LedgerCorruptError,
     LedgerReplay,
 )
 
 __all__ = [
+    "AsyncLedgerAppender",
     "JobLedger",
     "LedgerCorruptError",
     "LedgerReplay",

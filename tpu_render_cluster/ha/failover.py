@@ -24,6 +24,7 @@ from __future__ import annotations
 import logging
 from typing import TYPE_CHECKING
 
+from tpu_render_cluster.ha.ledger import AsyncLedgerAppender
 from tpu_render_cluster.jobs.tiles import WorkUnit
 
 if TYPE_CHECKING:
@@ -107,6 +108,7 @@ def adopt_ledger(
     job_id: str | None = None,
     weight: float = 1.0,
     priority: int = 0,
+    appender=None,
 ) -> tuple[int, list[int]]:
     """The full recovery sequence for one job joining a ledgered master:
     replay application, replayed-unit accounting, sink attachment (AFTER
@@ -126,10 +128,13 @@ def adopt_ledger(
             "Units restored as finished from ledger replay instead of "
             "being re-rendered",
         ).inc(replayed)
-    attach_ledger_sinks(state, ledger)
+    if appender is None:
+        appender = AsyncLedgerAppender(ledger)
+    attach_ledger_sinks(state, ledger, appender=appender)
     entry = ledger.replay.job(state.job.job_name)
     if entry is None or (entry.status != "started" and not include_closed):
-        ledger.append_job_started(
+        appender.schedule(
+            ledger.append_job_started,
             state.job.job_name,
             spec=spec,
             job_id=job_id,
@@ -140,31 +145,29 @@ def adopt_ledger(
 
 
 def attach_ledger_sinks(
-    state: "ClusterManagerState", ledger, *, metrics=None
+    state: "ClusterManagerState", ledger, *, metrics=None, appender=None
 ) -> None:
     """Journal the state's exactly-once transitions from here on.
 
     Must run AFTER ``apply_ledger_to_state`` — replayed units must not be
-    re-journaled. Append failures are logged, not raised: a full disk
-    degrades failover durability (those units re-render after a crash),
-    it must not kill the running job mid-event."""
+    re-journaled. The sinks fire inside the master's async event handlers
+    (the finished-event hot path), so the durable append is routed through
+    an :class:`~tpu_render_cluster.ha.ledger.AsyncLedgerAppender` — FIFO,
+    fsync on a worker thread, inline only when no loop is running. Append
+    failures are logged by the appender, not raised: a full disk degrades
+    failover durability (those units re-render after a crash), it must
+    not kill the running job mid-event."""
     job_name = state.job.job_name
+    if appender is None:
+        appender = AsyncLedgerAppender(ledger)
 
     def on_unit_finished(unit: WorkUnit) -> None:
-        try:
-            ledger.append_unit_finished(job_name, unit.frame_index, unit.tile)
-        except OSError as e:
-            logger.error("Ledger append failed for unit %s: %s", unit.label, e)
+        appender.schedule(
+            ledger.append_unit_finished, job_name, unit.frame_index, unit.tile
+        )
 
     def on_frame_assembled(frame_index: int) -> None:
-        try:
-            ledger.append_frame_assembled(job_name, frame_index)
-        except OSError as e:
-            logger.error(
-                "Ledger append failed for assembled frame %d: %s",
-                frame_index,
-                e,
-            )
+        appender.schedule(ledger.append_frame_assembled, job_name, frame_index)
 
     state.on_unit_finished = on_unit_finished
     if state.job.tile_grid is not None:

@@ -45,6 +45,7 @@ Tuning (``TRC_HA_*`` environment overrides, utils/env.py idiom):
 
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
 import os
@@ -52,7 +53,7 @@ import re
 import time
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Any
+from typing import Any, Callable
 
 from tpu_render_cluster.utils.env import env_int
 
@@ -226,6 +227,76 @@ def _check_version(record: dict[str, Any]) -> None:
             f"record format v{version} is newer than this build understands "
             f"(v{FORMAT_VERSION}); refusing to replay a future format"
         )
+
+
+class AsyncLedgerAppender:
+    """FIFO offload of durable ledger appends, off the event loop.
+
+    The per-append fsync is the dominant cost of every journaled
+    transition (``ha_ledger_append_seconds``), and the transitions fire
+    on the master's HOTTEST async paths — a finished-event handler, the
+    scheduler tick, admission. The WAL contract tolerates deferral (an
+    unrecorded unit re-renders at most once more and the dedup seam
+    absorbs it), so appends from the loop are queued here and a single
+    consumer task writes them through ``asyncio.to_thread`` in order.
+    ``schedule`` called with NO running loop (tests, the sync CLI paths)
+    degrades to the plain synchronous append — same ordering, no loop to
+    protect. ``drain()`` awaits everything scheduled so far: job-lifecycle
+    closure and admission-time replay reads call it first, keeping the
+    journal's record order identical to the synchronous ledger's.
+    """
+
+    def __init__(self, ledger: "JobLedger") -> None:
+        self.ledger = ledger
+        self._queue: asyncio.Queue | None = None
+        self._task: asyncio.Task | None = None
+
+    def schedule(self, fn: Callable[..., None], *args: Any, **kwargs: Any) -> None:
+        """Enqueue one append (``fn`` is a bound ``JobLedger.append_*``)."""
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            fn(*args, **kwargs)
+            return
+        if self._queue is None:
+            self._queue = asyncio.Queue()
+        if self._task is None or self._task.done():
+            self._task = loop.create_task(
+                self._consume(), name="ledger-appender"
+            )
+        self._queue.put_nowait((fn, args, kwargs))
+
+    async def _consume(self) -> None:
+        assert self._queue is not None
+        while True:
+            fn, args, kwargs = await self._queue.get()
+            try:
+                await asyncio.to_thread(fn, *args, **kwargs)
+            except Exception as e:  # noqa: BLE001 - consumer must survive
+                # Same contract as the sinks: a full disk (or an append
+                # racing close(), or an unserializable spec) degrades
+                # failover durability — it must not kill the running job,
+                # and it must not kill THIS task either: a dead consumer
+                # leaves later queued items un-acked and wedges drain().
+                logger.error("Deferred ledger append failed: %s", e)
+            finally:
+                self._queue.task_done()
+
+    async def drain(self) -> None:
+        """Await every append scheduled so far."""
+        if self._queue is not None:
+            await self._queue.join()
+
+    async def stop(self) -> None:
+        """Drain, then retire the consumer task (loop teardown hygiene)."""
+        await self.drain()
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
 
 
 class JobLedger:

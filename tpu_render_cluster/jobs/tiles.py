@@ -22,8 +22,8 @@ Conventions:
 
 from __future__ import annotations
 
-import os
 from typing import NamedTuple
+from tpu_render_cluster.utils.env import env_str
 
 # Grid ceiling: the unit tables, mirrors, and the assembly ledger are all
 # O(tiles) per frame, and a 16x16 grid already turns one frame into 256
@@ -76,7 +76,7 @@ def env_tile_grid() -> tuple[int, int] | None:
     that don't specify one. Read at job LOAD time only — never while
     decoding wire payloads, so a worker's environment cannot reinterpret
     a job the master defined."""
-    value = os.environ.get("TRC_TILE_GRID", "").strip()
+    value = (env_str("TRC_TILE_GRID") or "").strip()
     if not value or value in ("0", "off", "none", "1", "1x1"):
         return None
     return parse_tile_grid(value)

@@ -1,0 +1,62 @@
+"""trc-lint: the codebase-native static-analysis suite (ARCHITECTURE §L12).
+
+Four passes enforce the conventions the cluster's correctness rests on —
+``loop-blocking`` (never block the asyncio event loop), ``wire-schema``
+(the optional-key omitted-when-absent idiom, checked against
+``protocol/schema.py`` and PROTOCOL.md), ``jit-purity`` (no host effects
+inside traced render functions), and ``env-registry`` (every ``TRC_*``
+knob declared in ``utils/env.py`` and documented in README) — plus the
+``pragma`` meta-pass that keeps every suppression explained.
+
+Run it: ``python -m tpu_render_cluster.lint`` (``--json`` for machine
+output; nonzero exit on findings). The whole suite is a tier-1 gate
+(``tests/test_lint.py``), the same shape as the metric naming lint.
+"""
+
+from __future__ import annotations
+
+from tpu_render_cluster.lint import (
+    env_registry,
+    jit_purity,
+    loop_blocking,
+    wire_schema,
+)
+from tpu_render_cluster.lint.core import (
+    Finding,
+    LintContext,
+    LintReport,
+    Pragma,
+    SourceModule,
+    discover_modules,
+    run_lint,
+)
+
+PASSES = {
+    loop_blocking.PASS_ID: loop_blocking.run,
+    wire_schema.PASS_ID: wire_schema.run,
+    jit_purity.PASS_ID: jit_purity.run,
+    env_registry.PASS_ID: env_registry.run,
+}
+
+__all__ = [
+    "Finding",
+    "LintContext",
+    "LintReport",
+    "PASSES",
+    "Pragma",
+    "SourceModule",
+    "discover_modules",
+    "lint_package",
+    "run_lint",
+]
+
+
+def lint_package(
+    package_root=None,
+    repo_root=None,
+    pass_ids=None,
+    **overrides,
+) -> LintReport:
+    """One-call entry: lint the (real or fixture) package tree."""
+    ctx = LintContext.for_package(package_root, repo_root, **overrides)
+    return run_lint(ctx, PASSES, pass_ids)

@@ -1,0 +1,71 @@
+"""CLI: ``python -m tpu_render_cluster.lint`` (or ``scripts/lint.py`` from
+a bare checkout). Exit 0 when clean, 1 on findings, 2 on usage errors."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from tpu_render_cluster.lint import PASSES, lint_package
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpu_render_cluster.lint",
+        description=(
+            "trc-lint: event-loop blocking, wire-schema conformance, "
+            "jit purity, and the TRC_* env registry, over the whole package."
+        ),
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable report on stdout"
+    )
+    parser.add_argument(
+        "--passes",
+        default=None,
+        help=f"comma-separated subset of: {', '.join(PASSES)}",
+    )
+    parser.add_argument(
+        "--package-root",
+        type=Path,
+        default=None,
+        help="package directory to lint (default: the installed "
+        "tpu_render_cluster package)",
+    )
+    parser.add_argument(
+        "--repo-root",
+        type=Path,
+        default=None,
+        help="repo root holding README.md / PROTOCOL.md "
+        "(default: the package root's parent)",
+    )
+    parser.add_argument(
+        "--list-passes", action="store_true", help="list pass ids and exit"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_passes:
+        for pass_id, fn in PASSES.items():
+            doc = (sys.modules[fn.__module__].__doc__ or "").strip()
+            print(f"{pass_id}: {doc.splitlines()[0]}")
+        return 0
+
+    pass_ids = None
+    if args.passes:
+        pass_ids = tuple(p.strip() for p in args.passes.split(",") if p.strip())
+        unknown = [p for p in pass_ids if p not in PASSES]
+        if unknown:
+            parser.error(f"unknown pass(es): {', '.join(unknown)}")
+
+    report = lint_package(
+        package_root=args.package_root,
+        repo_root=args.repo_root,
+        pass_ids=pass_ids,
+    )
+    print(report.to_json() if args.json else report.format())
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

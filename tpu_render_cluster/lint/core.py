@@ -1,0 +1,337 @@
+"""trc-lint core: module walker, finding model, pragma grammar, pass runner.
+
+The codebase-native static-analysis layer (ARCHITECTURE §L12). Passes are
+plain functions ``run(ctx) -> list[Finding]`` registered in
+:data:`tpu_render_cluster.lint.PASSES`; this module owns everything they
+share — source discovery, the finding model, and the suppression pragma:
+
+    # trc-lint: disable=<pass>[,<pass>] (<reason>)
+
+A pragma suppresses findings of the named pass(es) on its own line, or on
+the line directly below when the pragma stands alone on its line; a
+call-chain finding is additionally suppressible at the blocking site it
+reports (``Finding.sites``), so one explained pragma covers every
+coroutine that reaches that site. The
+pragma grammar is itself linted (the ``pragma`` meta-pass): a suppression
+without a parenthesized reason, naming an unknown pass, or suppressing
+nothing is a finding — "the suite ships green" therefore also means
+"every suppression is explained and load-bearing".
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable
+
+PRAGMA_PASS_ID = "pragma"
+
+_PRAGMA_RE = re.compile(
+    r"trc-lint:\s*disable=(?P<passes>[A-Za-z0-9_-]+(?:\s*,\s*[A-Za-z0-9_-]+)*)"
+    r"(?P<rest>.*)$"
+)
+# Greedy to the LAST ')': reasons may themselves contain parentheses.
+_REASON_RE = re.compile(r"^\s*\((?P<reason>.+)\)\s*$")
+
+
+@dataclass(frozen=True)
+class Pragma:
+    """One ``trc-lint: disable=`` comment."""
+
+    line: int
+    passes: tuple[str, ...]
+    reason: str | None
+    standalone: bool  # comment-only line: also covers the next line
+
+    @property
+    def covered_lines(self) -> tuple[int, ...]:
+        return (self.line, self.line + 1) if self.standalone else (self.line,)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One defect: pass id, location, message, optional call chain."""
+
+    pass_id: str
+    path: str  # repo-relative where possible
+    line: int
+    message: str
+    severity: str = "error"
+    chain: tuple[str, ...] = ()
+    # Additional (path, line) anchors along a call chain: a pragma at ANY
+    # of them suppresses the finding, so one explained suppression at the
+    # blocking site covers every coroutine that reaches it.
+    sites: tuple[tuple[str, int], ...] = ()
+
+    def format(self) -> str:
+        text = f"{self.path}:{self.line}: [{self.pass_id}] {self.message}"
+        for hop in self.chain:
+            text += f"\n    {hop}"
+        return text
+
+    def to_dict(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "pass": self.pass_id,
+            "path": self.path,
+            "line": self.line,
+            "severity": self.severity,
+            "message": self.message,
+        }
+        if self.chain:
+            out["chain"] = list(self.chain)
+        if self.sites:
+            out["sites"] = [list(site) for site in self.sites]
+        return out
+
+
+class SourceModule:
+    """One parsed source file: AST + pragma table + dotted module name."""
+
+    def __init__(self, path: Path, text: str, module_name: str, relpath: str):
+        self.path = path
+        self.text = text
+        self.module_name = module_name
+        self.relpath = relpath
+        self.tree = ast.parse(text, filename=str(path))
+        self.pragmas: list[Pragma] = _parse_pragmas(text)
+
+    @classmethod
+    def load(cls, path: Path, package_root: Path) -> "SourceModule":
+        text = path.read_text(encoding="utf-8")
+        rel = path.relative_to(package_root.parent)
+        module_name = ".".join(rel.with_suffix("").parts)
+        if module_name.endswith(".__init__"):
+            module_name = module_name[: -len(".__init__")]
+        return cls(path, text, module_name, str(rel))
+
+    def pragmas_covering(self, line: int) -> list[Pragma]:
+        return [p for p in self.pragmas if line in p.covered_lines]
+
+
+def _parse_pragmas(text: str) -> list[Pragma]:
+    """Extract pragma comments via the tokenizer (never fooled by ``#``
+    inside string literals)."""
+    pragmas: list[Pragma] = []
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(text).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            match = _PRAGMA_RE.search(tok.string)
+            if match is None:
+                continue
+            passes = tuple(
+                p.strip() for p in match.group("passes").split(",") if p.strip()
+            )
+            reason_match = _REASON_RE.match(match.group("rest") or "")
+            reason = reason_match.group("reason").strip() if reason_match else None
+            standalone = tok.line[: tok.start[1]].strip() == ""
+            pragmas.append(
+                Pragma(tok.start[0], passes, reason or None, standalone)
+            )
+    except (tokenize.TokenError, IndentationError):  # pragma: no cover
+        pass
+    return pragmas
+
+
+def discover_modules(package_root: Path) -> list[SourceModule]:
+    modules = []
+    for path in sorted(package_root.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        modules.append(SourceModule.load(path, package_root))
+    return modules
+
+
+@dataclass
+class LintContext:
+    """Everything the passes need: the parsed package plus the documents
+    and registries the codebase-native checks bind to. Tests point the
+    registry/document fields at fixtures; the CLI uses the real ones."""
+
+    package_root: Path
+    repo_root: Path
+    modules: list[SourceModule] = field(default_factory=list)
+    # Overrides for tests (None -> the real registry / document).
+    env_registry: dict[str, Any] | None = None
+    wire_registry: dict[str, Any] | None = None
+    readme_text: str | None = None
+    protocol_text: str | None = None
+    # Dotted-name suffixes locating the codebase-native anchor modules.
+    env_module_suffix: str = "utils.env"
+    messages_module_suffix: str = "protocol.messages"
+
+    @classmethod
+    def for_package(
+        cls,
+        package_root: Path | None = None,
+        repo_root: Path | None = None,
+        **overrides: Any,
+    ) -> "LintContext":
+        if package_root is None:
+            package_root = Path(__file__).resolve().parents[1]
+        package_root = Path(package_root)
+        if repo_root is None:
+            repo_root = package_root.parent
+        ctx = cls(package_root=package_root, repo_root=Path(repo_root), **overrides)
+        ctx.modules = discover_modules(package_root)
+        return ctx
+
+    # -- document access -----------------------------------------------------
+
+    def readme(self) -> str:
+        if self.readme_text is not None:
+            return self.readme_text
+        path = self.repo_root / "README.md"
+        return path.read_text(encoding="utf-8") if path.is_file() else ""
+
+    def protocol_md(self) -> str:
+        if self.protocol_text is not None:
+            return self.protocol_text
+        path = self.repo_root / "PROTOCOL.md"
+        return path.read_text(encoding="utf-8") if path.is_file() else ""
+
+    def module_by_suffix(self, suffix: str) -> SourceModule | None:
+        for module in self.modules:
+            if module.module_name == suffix or module.module_name.endswith(
+                "." + suffix
+            ):
+                return module
+        return None
+
+    def display_path(self, path: Path | str) -> str:
+        path = Path(path)
+        try:
+            return str(path.relative_to(self.repo_root))
+        except ValueError:
+            return str(path)
+
+
+@dataclass
+class LintReport:
+    findings: list[Finding]
+    passes_run: tuple[str, ...]
+    files_scanned: int
+    suppressions_used: int
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def to_dict(self) -> dict[str, Any]:
+        counts: dict[str, int] = {}
+        for finding in self.findings:
+            counts[finding.pass_id] = counts.get(finding.pass_id, 0) + 1
+        return {
+            "ok": self.ok,
+            "passes": list(self.passes_run),
+            "files_scanned": self.files_scanned,
+            "suppressions_used": self.suppressions_used,
+            "counts": counts,
+            "findings": [f.to_dict() for f in self.findings],
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    def format(self) -> str:
+        if self.ok:
+            return (
+                f"trc-lint: clean — {self.files_scanned} file(s), "
+                f"{len(self.passes_run)} pass(es), "
+                f"{self.suppressions_used} explained suppression(s)."
+            )
+        lines = [f.format() for f in self.findings]
+        lines.append(
+            f"trc-lint: {len(self.findings)} finding(s) across "
+            f"{self.files_scanned} file(s)."
+        )
+        return "\n".join(lines)
+
+
+PassFn = Callable[[LintContext], list[Finding]]
+
+
+def run_lint(
+    ctx: LintContext,
+    passes: dict[str, PassFn],
+    pass_ids: tuple[str, ...] | None = None,
+) -> LintReport:
+    """Run the selected passes, apply suppression pragmas, and lint the
+    pragmas themselves (reason required; unknown pass refused; a pragma
+    that suppresses nothing is dead weight and flagged — but only when
+    every pass it names actually ran, so partial runs stay quiet)."""
+    selected = tuple(pass_ids) if pass_ids is not None else tuple(passes)
+    unknown = [p for p in selected if p not in passes]
+    if unknown:
+        raise ValueError(f"unknown pass(es): {', '.join(unknown)}")
+    raw: list[Finding] = []
+    for pass_id in selected:
+        raw.extend(passes[pass_id](ctx))
+
+    module_by_relpath = {m.relpath: m for m in ctx.modules}
+    used: set[tuple[str, int]] = set()  # (relpath, pragma line)
+    kept: list[Finding] = []
+    for finding in raw:
+        suppressing: list[tuple[str, int]] = []
+        for path, line in ((finding.path, finding.line), *finding.sites):
+            module = module_by_relpath.get(path)
+            if module is None:
+                continue
+            for pragma in module.pragmas_covering(line):
+                if finding.pass_id in pragma.passes:
+                    suppressing.append((module.relpath, pragma.line))
+        if suppressing:
+            used.update(suppressing)
+        else:
+            kept.append(finding)
+
+    known_ids = set(passes) | {PRAGMA_PASS_ID}
+    for module in ctx.modules:
+        for pragma in module.pragmas:
+            if pragma.reason is None:
+                kept.append(
+                    Finding(
+                        PRAGMA_PASS_ID,
+                        module.relpath,
+                        pragma.line,
+                        "suppression pragma without a reason — write "
+                        "`# trc-lint: disable=<pass> (<why this is safe>)`",
+                    )
+                )
+            bad = [p for p in pragma.passes if p not in known_ids]
+            if bad:
+                kept.append(
+                    Finding(
+                        PRAGMA_PASS_ID,
+                        module.relpath,
+                        pragma.line,
+                        f"suppression names unknown pass(es): {', '.join(bad)}",
+                    )
+                )
+            elif (
+                (module.relpath, pragma.line) not in used
+                and all(p in selected for p in pragma.passes)
+            ):
+                kept.append(
+                    Finding(
+                        PRAGMA_PASS_ID,
+                        module.relpath,
+                        pragma.line,
+                        "suppression suppresses nothing — remove it (the "
+                        "finding it once silenced is gone)",
+                    )
+                )
+
+    kept.sort(key=lambda f: (f.path, f.line, f.pass_id, f.message))
+    return LintReport(
+        findings=kept,
+        passes_run=selected,
+        files_scanned=len(ctx.modules),
+        suppressions_used=len(used),
+    )

@@ -1,0 +1,220 @@
+"""Pass ``env-registry``: every ``TRC_*`` knob declared once + documented.
+
+The package grew 58 ``TRC_*`` environment knobs across eleven subsystems;
+nothing enforced that a knob is declared, documented, or even still read
+(the README drifted to 57 rows before this pass existed). The contract,
+checked against ``utils/env.py``'s :data:`ENV_VARS` registry:
+
+1. ``os.environ`` / ``os.getenv`` access with a ``TRC_*`` name happens
+   ONLY inside ``utils/env.py`` — everywhere else reads go through the
+   ``env_int``/``env_float``/``env_str`` helpers (call-time semantics,
+   logged fallbacks, and a single choke point this pass can see).
+2. Every name passed to a helper (as a literal) is declared in the
+   registry; dynamic names (``resolve_telemetry_port(env_name)``) are
+   exempt — their literals still hit check 3 at the call site's module.
+3. Every declared name is mentioned somewhere in package code (a
+   declaration nothing reads is dead and must be deleted) and appears in
+   a README environment-table row; every ``TRC_*`` token in a README
+   table row is declared (a documented knob that does not exist is worse
+   than an undocumented one).
+4. ``utils/env.py`` declares each name exactly once.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tpu_render_cluster.lint.core import Finding, LintContext
+
+PASS_ID = "env-registry"
+
+_ENV_HELPERS = {"env_int", "env_float", "env_str"}
+_TRC = re.compile(r"TRC_[A-Z0-9_]*[A-Z0-9]")
+
+
+def _docstring_nodes(tree: ast.AST) -> set[int]:
+    """ids of Constant nodes that are module/class/function docstrings."""
+    out: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(
+            node, (ast.Module, ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            body = getattr(node, "body", [])
+            if (
+                body
+                and isinstance(body[0], ast.Expr)
+                and isinstance(body[0].value, ast.Constant)
+                and isinstance(body[0].value.value, str)
+            ):
+                out.add(id(body[0].value))
+    return out
+
+
+def _is_environ_access(node: ast.expr) -> bool:
+    """``os.environ`` attribute or ``os.getenv`` callee."""
+    return (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "os"
+        and node.attr in ("environ", "getenv")
+    )
+
+
+def run(ctx: LintContext) -> list[Finding]:
+    if ctx.env_registry is not None:
+        registry = dict(ctx.env_registry)
+    else:
+        from tpu_render_cluster.utils.env import ENV_VARS
+
+        registry = dict(ENV_VARS)
+
+    findings: list[Finding] = []
+    env_module = ctx.module_by_suffix(ctx.env_module_suffix)
+    mentioned: set[str] = set()
+    declare_lines: dict[str, int] = {}
+
+    for module in ctx.modules:
+        is_env_module = module is env_module
+        docstrings = _docstring_nodes(module.tree)
+        for node in ast.walk(module.tree):
+            # Non-docstring TRC_ literals count as "read/mentioned" —
+            # except inside utils/env.py itself, where the declare()
+            # literal must not count as its own reader.
+            if (
+                not is_env_module
+                and isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and id(node) not in docstrings
+            ):
+                mentioned.update(_TRC.findall(node.value))
+            # Direct os.environ/getenv reads of TRC_ names.
+            if not is_env_module:
+                trc_name = None
+                if isinstance(node, ast.Subscript) and _is_environ_access(
+                    node.value
+                ):
+                    if isinstance(node.slice, ast.Constant) and isinstance(
+                        node.slice.value, str
+                    ):
+                        trc_name = node.slice.value
+                elif isinstance(node, ast.Call):
+                    callee = node.func
+                    if _is_environ_access(callee) or (
+                        isinstance(callee, ast.Attribute)
+                        and callee.attr in ("get", "setdefault")
+                        and _is_environ_access(callee.value)
+                    ):
+                        # os.getenv("X") / os.environ.get("X")
+                        if (
+                            node.args
+                            and isinstance(node.args[0], ast.Constant)
+                            and isinstance(node.args[0].value, str)
+                        ):
+                            trc_name = node.args[0].value
+                if trc_name is not None and trc_name.startswith("TRC_"):
+                    findings.append(
+                        Finding(
+                            PASS_ID,
+                            module.relpath,
+                            node.lineno,
+                            f"direct os.environ read of {trc_name} — route "
+                            "through tpu_render_cluster.utils.env "
+                            "(env_int/env_float/env_str) so the knob is "
+                            "declared, documented, and read at call time",
+                        )
+                    )
+            # Helper reads: literal first arg must be declared.
+            if isinstance(node, ast.Call):
+                callee_name = None
+                if isinstance(node.func, ast.Name):
+                    callee_name = node.func.id
+                elif isinstance(node.func, ast.Attribute):
+                    callee_name = node.func.attr
+                if (
+                    callee_name in _ENV_HELPERS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and node.args[0].value.startswith("TRC_")
+                    and node.args[0].value not in registry
+                    and not is_env_module
+                ):
+                    findings.append(
+                        Finding(
+                            PASS_ID,
+                            module.relpath,
+                            node.lineno,
+                            f"read of undeclared {node.args[0].value} — "
+                            "declare() it in utils/env.py (and document it "
+                            "in README's environment table)",
+                        )
+                    )
+
+    # Declaration sites (line anchors + exactly-once check).
+    if env_module is not None:
+        for node in ast.walk(env_module.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "declare"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                name = node.args[0].value
+                if name in declare_lines:
+                    findings.append(
+                        Finding(
+                            PASS_ID,
+                            env_module.relpath,
+                            node.lineno,
+                            f"{name} declared more than once (first at line "
+                            f"{declare_lines[name]})",
+                        )
+                    )
+                else:
+                    declare_lines[name] = node.lineno
+
+    # README environment-table cross-check.
+    documented: dict[str, int] = {}
+    for lineno, line in enumerate(ctx.readme().splitlines(), start=1):
+        if line.lstrip().startswith("|"):
+            for name in _TRC.findall(line):
+                documented.setdefault(name, lineno)
+
+    env_relpath = env_module.relpath if env_module is not None else "utils/env.py"
+    for name in sorted(registry):
+        anchor = declare_lines.get(name, 1)
+        if name not in mentioned:
+            findings.append(
+                Finding(
+                    PASS_ID,
+                    env_relpath,
+                    anchor,
+                    f"{name} is declared but nothing in the package reads "
+                    "it — delete the dead declaration (and its README row)",
+                )
+            )
+        if name not in documented:
+            findings.append(
+                Finding(
+                    PASS_ID,
+                    env_relpath,
+                    anchor,
+                    f"{name} is declared but missing from README's "
+                    "environment tables — add a row",
+                )
+            )
+    for name, lineno in sorted(documented.items()):
+        if name not in registry:
+            findings.append(
+                Finding(
+                    PASS_ID,
+                    "README.md",
+                    lineno,
+                    f"README documents {name} but utils/env.py does not "
+                    "declare it — stale row or missing declare()",
+                )
+            )
+    return findings

@@ -1,0 +1,221 @@
+"""Pass ``jit-purity``: host side effects inside traced functions.
+
+A ``jax.jit``/``pallas_call``/``shard_map``-traced function body runs at
+TRACE time, once per compiled shape — a ``time.time()`` or ``np.random``
+call inside it silently bakes one host value into the executable (the
+classic "why does my render never change" bug), an env read makes the
+compiled program diverge from the environment after the first trace, and
+metric registration from inside a trace registers once per COMPILE, not
+per execution. This pass finds the traced functions statically:
+
+- defs decorated with ``jit``/``jax.jit``/``pjit``/``shard_map`` (bare or
+  under ``functools.partial``);
+- defs passed by name to ``jit(...)``, ``pallas_call(...)``,
+  ``shard_map(...)`` anywhere in the package (first positional or any
+  arg);
+- defs RETURNED by a factory whose call result is passed to one of those
+  wrappers (``jax.jit(make_renderer(...))`` — the dominant idiom in
+  ``render/``: the factory body is host code, the returned closure is
+  traced).
+
+Inside a traced body (nested defs included — they trace too) it flags:
+``time.*``, ``np.random``/``random``/``secrets``/``datetime.now``,
+``os.environ``/``os.getenv``/``env_int``/``env_float``/``env_str``,
+``print``/``open``/``input``, metric registration/mutation
+(``.counter``/``.gauge``/``.histogram``/``.observe``/``.inc``), and
+``global`` statements. ``jax.debug.print`` and the rest of the jax/jnp
+surface are pure by contract and not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpu_render_cluster.lint.core import Finding, LintContext, SourceModule
+
+PASS_ID = "jit-purity"
+
+_TRACE_WRAPPER_NAMES = {"jit", "pjit", "pallas_call", "shard_map"}
+_ENV_HELPERS = {"env_int", "env_float", "env_str"}
+_METRIC_METHODS = {"counter", "gauge", "histogram", "observe", "inc"}
+_IMPURE_MODULES = {"time", "random", "secrets"}
+_IMPURE_BUILTINS = {"print", "open", "input"}
+
+
+def _callable_name(node: ast.expr) -> str | None:
+    """Final name of a call target: ``jax.jit`` -> ``jit``."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _unwrap_partial(node: ast.expr) -> ast.expr:
+    """``functools.partial(jax.jit, ...)`` decorators / wrappers."""
+    if (
+        isinstance(node, ast.Call)
+        and _callable_name(node.func) == "partial"
+        and node.args
+    ):
+        return node.args[0]
+    return node
+
+
+def _is_trace_wrapper(node: ast.expr) -> bool:
+    return _callable_name(_unwrap_partial(node)) in _TRACE_WRAPPER_NAMES
+
+
+class _ModuleDefs(ast.NodeVisitor):
+    """Index every def in a module by bare name (innermost duplicates
+    shadow is fine — names are module-unique in practice)."""
+
+    def __init__(self) -> None:
+        self.defs: dict[str, ast.AST] = {}
+
+    def visit_FunctionDef(self, node):  # noqa: N802
+        self.defs.setdefault(node.name, node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _returned_defs(factory: ast.AST) -> list[ast.AST]:
+    """Inner defs a factory returns (directly or via a local name)."""
+    inner: dict[str, ast.AST] = {}
+    for child in ast.walk(factory):
+        if isinstance(child, ast.FunctionDef) and child is not factory:
+            inner[child.name] = child
+    out = []
+    for child in ast.walk(factory):
+        if isinstance(child, ast.Return) and isinstance(child.value, ast.Name):
+            if child.value.id in inner:
+                out.append(inner[child.value.id])
+    return out
+
+
+def _traced_defs(module: SourceModule, package_defs: dict[str, list[ast.AST]]):
+    """AST nodes of this module's traced functions (and which modules the
+    cross-module factory resolution touched)."""
+    defs = _ModuleDefs()
+    defs.visit(module.tree)
+    traced: list[ast.AST] = []
+
+    # Decorated defs.
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.FunctionDef):
+            for decorator in node.decorator_list:
+                target = _unwrap_partial(decorator)
+                if isinstance(target, ast.Call):
+                    target = target.func
+                if _callable_name(target) in _TRACE_WRAPPER_NAMES:
+                    traced.append(node)
+                    break
+
+    # Wrapper call sites: jit(f), pallas_call(kernel, ...), shard_map(f,...).
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Call) and _is_trace_wrapper(node.func)):
+            continue
+        candidates = list(node.args) + [kw.value for kw in node.keywords]
+        for arg in candidates:
+            arg = _unwrap_partial(arg)
+            if isinstance(arg, ast.Name):
+                hit = defs.defs.get(arg.id)
+                if hit is not None:
+                    traced.append(hit)
+            elif isinstance(arg, ast.Call):
+                factory_name = _callable_name(arg.func)
+                if factory_name is None:
+                    continue
+                factory = defs.defs.get(factory_name)
+                if factory is not None:
+                    traced.extend(_returned_defs(factory))
+                else:
+                    # Cross-module factory: resolve by package-unique name.
+                    matches = package_defs.get(factory_name, [])
+                    if len(matches) == 1:
+                        traced.extend(_returned_defs(matches[0]))
+    return traced
+
+
+class _ImpurityScanner(ast.NodeVisitor):
+    """Flag host effects anywhere inside one traced def (nested included)."""
+
+    def __init__(self, module: SourceModule, qualname: str):
+        self.module = module
+        self.qualname = qualname
+        self.findings: list[Finding] = []
+
+    def _flag(self, node: ast.AST, what: str) -> None:
+        self.findings.append(
+            Finding(
+                PASS_ID,
+                self.module.relpath,
+                node.lineno,
+                f"traced function {self.qualname!r} {what} — host effects "
+                "run once per trace, not per execution; hoist to the "
+                "factory/caller or thread the value in as an argument",
+            )
+        )
+
+    def visit_Global(self, node):  # noqa: N802
+        self._flag(node, "mutates module globals (`global` statement)")
+
+    def visit_Attribute(self, node: ast.Attribute):  # noqa: N802
+        base = node.value
+        if isinstance(base, ast.Name):
+            if base.id == "os" and node.attr in ("environ", "getenv"):
+                self._flag(node, "reads os.environ at trace time")
+            elif (
+                base.id in ("np", "numpy") and node.attr == "random"
+            ):
+                self._flag(node, "uses host numpy RNG (np.random)")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):  # noqa: N802
+        callee = node.func
+        if isinstance(callee, ast.Name):
+            if callee.id in _IMPURE_BUILTINS:
+                self._flag(node, f"calls {callee.id}()")
+            elif callee.id in _ENV_HELPERS:
+                self._flag(node, f"reads the environment via {callee.id}()")
+        elif isinstance(callee, ast.Attribute):
+            base = callee.value
+            if isinstance(base, ast.Name) and base.id in _IMPURE_MODULES:
+                self._flag(node, f"calls {base.id}.{callee.attr}()")
+            elif isinstance(base, ast.Name) and base.id == "datetime":
+                self._flag(node, f"calls datetime.{callee.attr}()")
+            elif callee.attr in _METRIC_METHODS and node.args:
+                first = node.args[0]
+                if isinstance(first, ast.Constant) and isinstance(
+                    first.value, str
+                ):
+                    self._flag(
+                        node, f"registers/mutates a metric (.{callee.attr}())"
+                    )
+        self.generic_visit(node)
+
+
+def run(ctx: LintContext) -> list[Finding]:
+    # Package-wide def index for cross-module factory resolution.
+    package_defs: dict[str, list[ast.AST]] = {}
+    def_module: dict[int, SourceModule] = {}
+    for module in ctx.modules:
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.FunctionDef):
+                package_defs.setdefault(node.name, []).append(node)
+                def_module[id(node)] = module
+
+    findings: list[Finding] = []
+    seen: set[int] = set()
+    for module in ctx.modules:
+        for node in _traced_defs(module, package_defs):
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            owner = def_module.get(id(node), module)
+            scanner = _ImpurityScanner(owner, node.name)
+            for child in ast.iter_child_nodes(node):
+                scanner.visit(child)
+            findings.extend(scanner.findings)
+    return findings

@@ -1,0 +1,309 @@
+"""Pass ``loop-blocking``: blocking calls reachable from coroutines.
+
+The asyncio control plane (master/worker/sched/ha) runs dispatch,
+heartbeats, and telemetry on ONE event loop; a single ``os.fsync`` on
+that loop stalls every worker's heartbeat service (the cost PR 12's
+``ha_ledger_append_seconds`` histogram made visible). This pass makes
+the "never block the event loop" rule mechanical:
+
+- every ``async def`` in the package is a seed (a superset of the
+  master/worker/sched/ha entry points — any coroutine body holds the
+  loop while it runs);
+- blocking primitives are the ones the ledger/flight-recorder/export
+  paths actually use: ``os.fsync``, builtin ``open``, ``time.sleep``,
+  ``subprocess.*``, ``json.dump``, and the ``pathlib`` file-IO methods
+  (``read_text``/``write_text``/``read_bytes``/``write_bytes``);
+- a call routed through ``asyncio.to_thread(...)`` or
+  ``run_in_executor(...)`` is a legal hop and is not traversed;
+- reachability follows *statically resolvable* sync calls: module-local
+  functions, ``from x import y`` targets, ``self.method``, and
+  attribute calls whose method name is defined exactly once in the
+  package (common container/file method names are never resolved this
+  way — see ``_AMBIGUOUS_NAMES``). Dynamically assigned callbacks are
+  invisible to the walk, which is why the ledger sinks must be
+  non-blocking BY CONSTRUCTION (``ha.ledger.AsyncLedgerAppender``)
+  rather than merely unflagged.
+
+Findings anchor at the call site inside the coroutine (the edge where
+the event loop enters the blocking path) with the full chain in the
+message — that is where a ``to_thread`` hop belongs.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tpu_render_cluster.lint.core import Finding, LintContext, SourceModule
+
+PASS_ID = "loop-blocking"
+
+# (module alias target, attribute) -> human description.
+_BLOCKING_MODULE_CALLS = {
+    ("os", "fsync"): "os.fsync()",
+    ("time", "sleep"): "time.sleep()",
+    ("json", "dump"): "json.dump() to a file object",
+}
+# Any call into these modules blocks (process spawn + pipe IO).
+_BLOCKING_MODULES = {"subprocess"}
+# File-IO method names (pathlib and friends) — receiver-independent.
+_BLOCKING_METHODS = {"read_text", "write_text", "read_bytes", "write_bytes"}
+# Offload seams: a call whose callee is one of these is a legal hop and
+# its arguments are not walked (the wrapped callable runs OFF the loop).
+_OFFLOAD_ATTRS = {"to_thread", "run_in_executor"}
+
+# Method names too generic to resolve by package-wide uniqueness: lists,
+# dicts, files, sockets, futures, and loggers own these. ``self.<name>``
+# still resolves (the enclosing class is known).
+_AMBIGUOUS_NAMES = {
+    "append", "add", "get", "put", "pop", "close", "open", "write", "read",
+    "send", "recv", "update", "extend", "remove", "discard", "clear", "set",
+    "start", "stop", "run", "join", "cancel", "result", "items", "keys",
+    "values", "copy", "encode", "decode", "strip", "split", "format", "info",
+    "debug", "warning", "error", "exception", "observe", "inc", "submit",
+    "connect", "load", "dump", "dumps", "loads", "wait", "acquire", "release",
+}
+
+_MAX_DEPTH = 8
+
+
+@dataclass
+class _Func:
+    qualname: str
+    module: SourceModule
+    node: ast.AST
+    is_async: bool
+    class_name: str | None
+    blocking: list[tuple[int, str]] = field(default_factory=list)
+    # (call line, resolution key) — resolved lazily against the index.
+    calls: list[tuple[int, "str | tuple[str, str]"]] = field(default_factory=list)
+
+
+class _BodyScanner(ast.NodeVisitor):
+    """Collect blocking primitives + resolvable call edges in ONE function
+    body (nested function/class definitions are separate analysis units)."""
+
+    def __init__(self, func: _Func, module_aliases, from_imports):
+        self.func = func
+        self.module_aliases = module_aliases
+        self.from_imports = from_imports
+        self._top = True
+
+    def visit_FunctionDef(self, node):  # noqa: N802 - ast API
+        if self._top:
+            self._top = False
+            self.generic_visit(node)
+        # nested defs: do not descend
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):  # noqa: N802
+        pass
+
+    def visit_ClassDef(self, node):  # noqa: N802
+        pass
+
+    def visit_Call(self, node: ast.Call):  # noqa: N802
+        callee = node.func
+        # Offload hop: asyncio.to_thread(fn, ...) / loop.run_in_executor —
+        # nothing inside its argument list runs on the loop.
+        if isinstance(callee, ast.Attribute) and callee.attr in _OFFLOAD_ATTRS:
+            self.visit(callee.value)
+            return
+        line = node.lineno
+        if isinstance(callee, ast.Name):
+            if callee.id == "open":
+                self.func.blocking.append((line, "builtin open() file IO"))
+            else:
+                target = self.from_imports.get(callee.id)
+                if target is not None:
+                    self.func.calls.append((line, target))
+                else:
+                    self.func.calls.append((line, ("", callee.id)))
+        elif isinstance(callee, ast.Attribute):
+            attr = callee.attr
+            base = callee.value
+            if isinstance(base, ast.Name):
+                target_module = self.module_aliases.get(base.id)
+                if target_module in _BLOCKING_MODULES:
+                    self.func.blocking.append(
+                        (line, f"{target_module}.{attr}()")
+                    )
+                elif (target_module, attr) in _BLOCKING_MODULE_CALLS:
+                    self.func.blocking.append(
+                        (line, _BLOCKING_MODULE_CALLS[(target_module, attr)])
+                    )
+                elif target_module is not None:
+                    self.func.calls.append((line, (target_module, attr)))
+                elif base.id == "self":
+                    self.func.calls.append((line, ("self", attr)))
+                elif attr in _BLOCKING_METHODS:
+                    self.func.blocking.append((line, f".{attr}() file IO"))
+                else:
+                    self.func.calls.append((line, ("", attr)))
+            elif attr in _BLOCKING_METHODS:
+                self.func.blocking.append((line, f".{attr}() file IO"))
+            else:
+                self.func.calls.append((line, ("", attr)))
+        self.generic_visit(node)
+
+
+def _import_maps(module: SourceModule):
+    """(module aliases, from-imports) visible at module level."""
+    aliases: dict[str, str] = {}
+    from_imports: dict[str, tuple[str, str]] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                aliases[alias.asname or alias.name.split(".")[0]] = alias.name
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for alias in node.names:
+                from_imports[alias.asname or alias.name] = (
+                    node.module,
+                    alias.name,
+                )
+    return aliases, from_imports
+
+
+def _collect_functions(ctx: LintContext) -> list[_Func]:
+    functions: list[_Func] = []
+    for module in ctx.modules:
+        aliases, from_imports = _import_maps(module)
+
+        def walk(node, class_name, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    qual = f"{prefix}{child.name}"
+                    func = _Func(
+                        qualname=f"{module.module_name}.{qual}",
+                        module=module,
+                        node=child,
+                        is_async=isinstance(child, ast.AsyncFunctionDef),
+                        class_name=class_name,
+                    )
+                    scanner = _BodyScanner(func, aliases, from_imports)
+                    scanner.visit(child)
+                    functions.append(func)
+                    walk(child, class_name, f"{qual}.<locals>.")
+                elif isinstance(child, ast.ClassDef):
+                    walk(child, child.name, f"{child.name}.")
+                else:
+                    walk(child, class_name, prefix)
+
+        walk(module.tree, None, "")
+    return functions
+
+
+class _Index:
+    def __init__(self, ctx: LintContext, functions: list[_Func]):
+        self.package = ctx.package_root.name
+        self.by_name: dict[str, list[_Func]] = {}
+        self.by_module_func: dict[tuple[str, str], _Func] = {}
+        self.by_class_method: dict[tuple[str, str, str], _Func] = {}
+        for func in functions:
+            bare = func.qualname.rsplit(".", 1)[-1]
+            self.by_name.setdefault(bare, []).append(func)
+            if func.class_name is None:
+                self.by_module_func[(func.module.module_name, bare)] = func
+            else:
+                self.by_class_method[
+                    (func.module.module_name, func.class_name, bare)
+                ] = func
+
+    def resolve(self, caller: _Func, key) -> "_Func | None":
+        scope, name = key if isinstance(key, tuple) else ("", key)
+        module_name = caller.module.module_name
+        if scope == "self" and caller.class_name is not None:
+            hit = self.by_class_method.get(
+                (module_name, caller.class_name, name)
+            )
+            if hit is not None:
+                return hit
+            scope = ""  # fall through to uniqueness
+        if scope == "":
+            # Bare name: the caller's own module wins before uniqueness.
+            hit = self.by_module_func.get((module_name, name))
+            if hit is not None:
+                return hit
+        elif scope != "self":
+            # from-import target or module alias: exact module lookup.
+            hit = self.by_module_func.get((scope, name))
+            if hit is not None:
+                return hit
+            if not scope.startswith(self.package):
+                return None  # stdlib/third-party: not ours to walk
+        if name in _AMBIGUOUS_NAMES:
+            return None
+        candidates = self.by_name.get(name, [])
+        return candidates[0] if len(candidates) == 1 else None
+
+
+def run(ctx: LintContext) -> list[Finding]:
+    functions = _collect_functions(ctx)
+    index = _Index(ctx, functions)
+    findings: list[Finding] = []
+    seen: set[tuple[str, int, str, int]] = set()
+
+    def blocking_sites(func: _Func, depth: int, visited: frozenset):
+        """Blocking primitives reachable from ``func`` through sync calls:
+        yields (site func, site line, description, chain of qualnames)."""
+        for line, desc in func.blocking:
+            yield func, line, desc, (func.qualname,)
+        if depth >= _MAX_DEPTH:
+            return
+        for line, key in func.calls:
+            target = index.resolve(func, key)
+            if target is None or target.is_async or id(target) in visited:
+                continue
+            for site, site_line, desc, chain in blocking_sites(
+                target, depth + 1, visited | {id(target)}
+            ):
+                yield site, site_line, desc, (func.qualname,) + chain
+
+    for seed in functions:
+        if not seed.is_async:
+            continue
+        # Direct blocking in the coroutine body.
+        for line, desc in seed.blocking:
+            key = (seed.qualname, line, desc, line)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(
+                Finding(
+                    PASS_ID,
+                    seed.module.relpath,
+                    line,
+                    f"coroutine {seed.qualname!r} performs blocking {desc} "
+                    "on the event loop — route through asyncio.to_thread "
+                    "or an executor",
+                )
+            )
+        # Blocking reached through resolvable sync callees.
+        for call_line, call_key in seed.calls:
+            target = index.resolve(seed, call_key)
+            if target is None or target.is_async:
+                continue
+            for site, site_line, desc, chain in blocking_sites(
+                target, 1, frozenset({id(seed), id(target)})
+            ):
+                key = (seed.qualname, call_line, desc, site_line)
+                if key in seen:
+                    continue
+                seen.add(key)
+                hops = " -> ".join(chain)
+                findings.append(
+                    Finding(
+                        PASS_ID,
+                        seed.module.relpath,
+                        call_line,
+                        f"coroutine {seed.qualname!r} reaches blocking {desc} "
+                        f"at {site.module.relpath}:{site_line} without a "
+                        "to_thread/executor hop",
+                        chain=(f"via {hops}",),
+                        sites=((site.module.relpath, site_line),),
+                    )
+                )
+    return findings

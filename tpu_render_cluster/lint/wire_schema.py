@@ -1,0 +1,353 @@
+"""Pass ``wire-schema``: the optional-key idiom, machine-checked.
+
+The protocol's reference compatibility rests on one rule, held by
+convention since PR 3: every beyond-reference extension rides as an
+OPTIONAL payload key that is **omitted when absent** — never serialized
+as ``null`` or a default — so single-job / untiled / ledger-less traffic
+stays byte-identical to the reference and C++ peers route unmodified.
+
+This pass checks the three artifacts that must agree:
+
+- ``protocol/schema.py`` (:data:`WIRE_SCHEMAS`) — the declared contract:
+  required vs optional keys per wire tag;
+- ``protocol/messages.py`` — every message class's construct/parse site:
+  ``to_payload`` must assign required keys unconditionally and optional
+  keys only under a presence guard, and must not invent undeclared keys;
+  ``from_payload`` must not demand an optional key's presence (subscript
+  read) and must not read undeclared keys; tags must map 1:1 to schemas;
+- PROTOCOL.md — the message table must list exactly the declared tags,
+  and each optional key must be mentioned (backticked) in its tag's row,
+  so the human contract can no longer silently trail the code.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tpu_render_cluster.lint.core import Finding, LintContext, SourceModule
+
+PASS_ID = "wire-schema"
+
+_ROW_RE = re.compile(r"^\|\s*`(?P<tag>[^`]+)`\s*\|")
+
+
+def _helper_key_map(module: SourceModule) -> dict[str, set[str]]:
+    """Module-level ``_x_from_payload(payload)`` helpers -> the payload
+    keys their bodies read (``payload.get("k")`` / ``payload["k"]``)."""
+    helpers: dict[str, set[str]] = {}
+    for node in module.tree.body:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        params = {a.arg for a in node.args.args}
+        if "payload" not in params:
+            continue
+        keys = _payload_reads(node, "payload")
+        if keys["strict"] or keys["lenient"]:
+            helpers[node.name] = keys["strict"] | keys["lenient"]
+    return helpers
+
+
+def _payload_reads(node: ast.AST, param: str) -> dict[str, set[str]]:
+    """Keys read off ``param`` inside ``node``: subscript (strict,
+    presence-demanding) vs ``.get`` (lenient)."""
+    strict: set[str] = set()
+    lenient: set[str] = set()
+    for child in ast.walk(node):
+        if (
+            isinstance(child, ast.Subscript)
+            and isinstance(child.value, ast.Name)
+            and child.value.id == param
+            and isinstance(child.slice, ast.Constant)
+            and isinstance(child.slice.value, str)
+        ):
+            strict.add(child.slice.value)
+        elif (
+            isinstance(child, ast.Call)
+            and isinstance(child.func, ast.Attribute)
+            and child.func.attr == "get"
+            and isinstance(child.func.value, ast.Name)
+            and child.func.value.id == param
+            and child.args
+            and isinstance(child.args[0], ast.Constant)
+            and isinstance(child.args[0].value, str)
+        ):
+            lenient.add(child.args[0].value)
+    return {"strict": strict, "lenient": lenient}
+
+
+def _dict_literal_keys(node: ast.expr) -> set[str]:
+    if not isinstance(node, ast.Dict):
+        return set()
+    return {
+        k.value
+        for k in node.keys
+        if isinstance(k, ast.Constant) and isinstance(k.value, str)
+    }
+
+
+def _to_payload_keys(func: ast.FunctionDef) -> tuple[dict[str, int], dict[str, int]]:
+    """(unconditional keys, conditional keys) -> first line, from one
+    ``to_payload`` body. Unconditional = assigned at statement level
+    (initial dict literal, returned literal, or ``out["k"] = ...``);
+    conditional = the same inside any ``if``."""
+    unconditional: dict[str, int] = {}
+    conditional: dict[str, int] = {}
+
+    def record(keys: set[str], line: int, in_if: bool) -> None:
+        target = conditional if in_if else unconditional
+        for key in keys:
+            target.setdefault(key, line)
+
+    def walk(statements, in_if: bool) -> None:
+        for stmt in statements:
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                value = stmt.value
+                targets = (
+                    stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+                )
+                if value is not None:
+                    record(_dict_literal_keys(value), stmt.lineno, in_if)
+                for target in targets:
+                    if (
+                        isinstance(target, ast.Subscript)
+                        and isinstance(target.slice, ast.Constant)
+                        and isinstance(target.slice.value, str)
+                    ):
+                        record({target.slice.value}, stmt.lineno, in_if)
+            elif isinstance(stmt, ast.Return) and stmt.value is not None:
+                record(_dict_literal_keys(stmt.value), stmt.lineno, in_if)
+            elif isinstance(stmt, ast.If):
+                walk(stmt.body, True)
+                walk(stmt.orelse, True)
+            elif isinstance(stmt, (ast.With, ast.For, ast.While, ast.Try)):
+                for block in (
+                    getattr(stmt, "body", []),
+                    getattr(stmt, "orelse", []),
+                    getattr(stmt, "finalbody", []),
+                ):
+                    walk(block, True)
+
+    walk(func.body, False)
+    return unconditional, conditional
+
+
+def _message_classes(module: SourceModule):
+    """(class node, wire tag) for every class declaring ``type_name``."""
+    for node in module.tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        tag = None
+        for stmt in node.body:
+            value = None
+            name = None
+            if isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name
+            ):
+                name, value = stmt.target.id, stmt.value
+            elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and isinstance(
+                stmt.targets[0], ast.Name
+            ):
+                name, value = stmt.targets[0].id, stmt.value
+            if (
+                name == "type_name"
+                and isinstance(value, ast.Constant)
+                and isinstance(value.value, str)
+            ):
+                tag = value.value
+        if tag is not None:
+            yield node, tag
+
+
+def run(ctx: LintContext) -> list[Finding]:
+    if ctx.wire_registry is not None:
+        registry = dict(ctx.wire_registry)
+    else:
+        from tpu_render_cluster.protocol.schema import WIRE_SCHEMAS
+
+        registry = dict(WIRE_SCHEMAS)
+
+    findings: list[Finding] = []
+    module = ctx.module_by_suffix(ctx.messages_module_suffix)
+    if module is None:
+        return [
+            Finding(
+                PASS_ID,
+                str(ctx.package_root),
+                1,
+                f"no module matching *.{ctx.messages_module_suffix} found",
+            )
+        ]
+    helpers = _helper_key_map(module)
+    seen_tags: set[str] = set()
+
+    for node, tag in _message_classes(module):
+        schema = registry.get(tag)
+        if schema is None:
+            findings.append(
+                Finding(
+                    PASS_ID,
+                    module.relpath,
+                    node.lineno,
+                    f"message class {node.name} declares wire tag {tag!r} "
+                    "with no schema in protocol/schema.py",
+                )
+            )
+            continue
+        seen_tags.add(tag)
+        required = set(schema.required)
+        optional = set(schema.optional)
+        to_payload = next(
+            (
+                s
+                for s in node.body
+                if isinstance(s, ast.FunctionDef) and s.name == "to_payload"
+            ),
+            None,
+        )
+        from_payload = next(
+            (
+                s
+                for s in node.body
+                if isinstance(s, ast.FunctionDef) and s.name == "from_payload"
+            ),
+            None,
+        )
+        if to_payload is not None:
+            unconditional, conditional = _to_payload_keys(to_payload)
+            assigned = set(unconditional) | set(conditional)
+            for key in sorted(required - set(unconditional)):
+                line = conditional.get(key, to_payload.lineno)
+                findings.append(
+                    Finding(
+                        PASS_ID,
+                        module.relpath,
+                        line,
+                        f"{tag}: required key {key!r} is "
+                        + (
+                            "only conditionally serialized"
+                            if key in conditional
+                            else "never serialized"
+                        )
+                        + " by to_payload",
+                    )
+                )
+            for key in sorted(optional & set(unconditional)):
+                findings.append(
+                    Finding(
+                        PASS_ID,
+                        module.relpath,
+                        unconditional[key],
+                        f"{tag}: optional key {key!r} is serialized "
+                        "unconditionally — the optional-key idiom requires "
+                        "omitted-when-absent (guard on presence; never "
+                        "write null/defaults)",
+                    )
+                )
+            for key in sorted(assigned - required - optional):
+                findings.append(
+                    Finding(
+                        PASS_ID,
+                        module.relpath,
+                        unconditional.get(key) or conditional.get(key, 1),
+                        f"{tag}: to_payload writes undeclared key {key!r} — "
+                        "declare it in protocol/schema.py (and PROTOCOL.md)",
+                    )
+                )
+        if from_payload is not None:
+            reads = _payload_reads(from_payload, "payload")
+            # Expand helper calls: _epoch_from_payload(payload) etc.
+            for child in ast.walk(from_payload):
+                if (
+                    isinstance(child, ast.Call)
+                    and isinstance(child.func, ast.Name)
+                    and child.func.id in helpers
+                    and any(
+                        isinstance(a, ast.Name) and a.id == "payload"
+                        for a in child.args
+                    )
+                ):
+                    reads["lenient"] |= helpers[child.func.id]
+            for key in sorted(reads["strict"] & optional):
+                findings.append(
+                    Finding(
+                        PASS_ID,
+                        module.relpath,
+                        from_payload.lineno,
+                        f"{tag}: optional key {key!r} is read with a "
+                        "presence-demanding subscript — use .get()/a "
+                        "helper so reference-shaped frames still parse",
+                    )
+                )
+            for key in sorted(
+                (reads["strict"] | reads["lenient"]) - required - optional
+            ):
+                findings.append(
+                    Finding(
+                        PASS_ID,
+                        module.relpath,
+                        from_payload.lineno,
+                        f"{tag}: from_payload reads undeclared key {key!r}",
+                    )
+                )
+
+    for tag in sorted(set(registry) - seen_tags):
+        findings.append(
+            Finding(
+                PASS_ID,
+                module.relpath,
+                1,
+                f"schema declares wire tag {tag!r} but protocol/messages.py "
+                "defines no class for it",
+            )
+        )
+
+    # -- PROTOCOL.md message table ------------------------------------------
+    doc_rows: dict[str, tuple[int, str]] = {}
+    in_table = False
+    for lineno, line in enumerate(ctx.protocol_md().splitlines(), start=1):
+        if "| Wire tag |" in line:
+            in_table = True
+            continue
+        if in_table:
+            if not line.lstrip().startswith("|"):
+                in_table = False
+                continue
+            match = _ROW_RE.match(line.strip())
+            if match and not set(match.group("tag")) <= {"-"}:
+                doc_rows[match.group("tag")] = (lineno, line)
+    if doc_rows:
+        for tag in sorted(set(registry) - set(doc_rows)):
+            findings.append(
+                Finding(
+                    PASS_ID,
+                    "PROTOCOL.md",
+                    1,
+                    f"message table is missing a row for {tag!r}",
+                )
+            )
+        for tag, (lineno, row) in sorted(doc_rows.items()):
+            schema = registry.get(tag)
+            if schema is None:
+                findings.append(
+                    Finding(
+                        PASS_ID,
+                        "PROTOCOL.md",
+                        lineno,
+                        f"message table lists {tag!r}, which no schema "
+                        "declares",
+                    )
+                )
+                continue
+            for key in schema.optional:
+                if f"`{key}`" not in row:
+                    findings.append(
+                        Finding(
+                            PASS_ID,
+                            "PROTOCOL.md",
+                            lineno,
+                            f"{tag}: optional key `{key}` is not mentioned "
+                            "in the tag's message-table row",
+                        )
+                    )
+    return findings

@@ -24,6 +24,7 @@ import time
 from pathlib import Path
 
 from tpu_render_cluster import PROTOCOL_VERSION
+from tpu_render_cluster.ha.ledger import AsyncLedgerAppender
 from tpu_render_cluster.jobs.models import BlenderJob
 from tpu_render_cluster.master.assembly import FrameAssemblyService
 from tpu_render_cluster.master.speculate import (
@@ -245,6 +246,12 @@ class ClusterManager:
         # once the job starts, from the tile files already on disk.
         self._replay_stitch_frames: list[int] = []
         self.replayed_units = 0
+        # Durable appends from the event loop go through ONE FIFO appender
+        # (ha/ledger.py): the fsync runs on a worker thread, never on the
+        # loop serving heartbeats (the loop-blocking lint enforces this).
+        self.ledger_appender = (
+            AsyncLedgerAppender(self.ledger) if self.ledger is not None else None
+        )
         if self.ledger is not None and self.state is not None:
             from tpu_render_cluster.ha.failover import adopt_ledger
 
@@ -258,6 +265,7 @@ class ClusterManager:
                 metrics=self.metrics,
                 include_closed=ledger_resume,
                 spec=job.to_dict(),
+                appender=self.ledger_appender,
             )
             if self.replayed_units or self._replay_stitch_frames:
                 # This incarnation adopted a predecessor's in-flight job:
@@ -357,9 +365,13 @@ class ClusterManager:
             await asyncio.wait_for(self._server.wait_closed(), 5.0)
         except asyncio.TimeoutError:
             logger.warning("Server close timed out; continuing shutdown.")
+        # Let deferred incident bundles land before the loop goes away.
+        await self.flightrec.drain()
         if self.ledger is not None:
+            if self.ledger_appender is not None:
+                await self.ledger_appender.stop()
             try:
-                self.ledger.close()
+                await asyncio.to_thread(self.ledger.close)
             except OSError as e:
                 logger.warning("Ledger close failed: %s", e)
 
@@ -810,11 +822,14 @@ class ClusterManager:
         finish = time.time()
         if not self.state.all_frames_finished():
             raise RuntimeError("Strategy exited before all frames finished.")
-        if self.ledger is not None:
-            try:
-                self.ledger.append_job_finished(self.job.job_name)
-            except OSError as e:
-                logger.error("Ledger job-finished append failed: %s", e)
+        if self.ledger_appender is not None:
+            # Ordered AFTER every queued unit append; drained so the
+            # journal's lifecycle closure is durable before we report the
+            # job finished (the same point the synchronous append gave).
+            self.ledger_appender.schedule(
+                self.ledger.append_job_finished, self.job.job_name
+            )
+            await self.ledger_appender.drain()
         logger.info("All frames finished in %.2f s.", finish - start)
         return MasterTrace(job_start_time=start, job_finish_time=finish)
 

@@ -131,12 +131,11 @@ def open_ledger(args: argparse.Namespace):
     Opening claims the directory for this incarnation: the epoch is
     bumped + persisted and any torn tail from a previous crash repaired
     before the first append."""
-    import os
-
     from tpu_render_cluster.ha.ledger import JobLedger
     from tpu_render_cluster.obs import get_registry
+    from tpu_render_cluster.utils.env import env_str
 
-    directory = args.ledger_directory or os.environ.get("TRC_HA_LEDGER")
+    directory = args.ledger_directory or env_str("TRC_HA_LEDGER")
     if not directory:
         return None
     # The CLI's managers default to the process-global registry, so the
@@ -158,7 +157,7 @@ async def serve_command(args: argparse.Namespace) -> int:
 
         args.results_directory = str(DEFAULT_RESULTS_DIR)
     results_directory = Path(args.results_directory)
-    ledger = open_ledger(args)
+    ledger = await asyncio.to_thread(open_ledger, args)
     manager = JobManager(
         args.host,
         args.port,
@@ -279,7 +278,7 @@ async def run_job_command(args: argparse.Namespace) -> int:
         args.results_directory = str(DEFAULT_RESULTS_DIR)
     job = BlenderJob.load_from_file(args.job_file_path)
     start_time = datetime.now()
-    ledger = open_ledger(args)
+    ledger = await asyncio.to_thread(open_ledger, args)
     manager = ClusterManager(
         args.host,
         args.port,
@@ -320,19 +319,31 @@ async def run_job_command(args: argparse.Namespace) -> int:
                 # resumed from may have hit between the last unit append
                 # and job_finished — leaving the entry "started" would
                 # make every later replay re-admit a completed job.
+                # Settle anything the manager's construction scheduled
+                # BEFORE reading the lifecycle entry: a fresh generation's
+                # job_started may still sit in the appender queue, and
+                # reading first would skip the close below, leaving the
+                # ledger "started" forever.
+                if manager.ledger_appender is not None:
+                    await manager.ledger_appender.stop()
                 entry = ledger.replay.job(job.job_name)
                 if entry is not None and entry.status == "started":
-                    ledger.append_job_finished(job.job_name)
-                ledger.close()
+                    await asyncio.to_thread(
+                        ledger.append_job_finished, job.job_name
+                    )
+                await asyncio.to_thread(ledger.close)
             print("All frames already rendered; nothing to do.")
             now = time.time()
             trace = MasterTrace(job_start_time=now, job_finish_time=now)
             results_directory = Path(args.results_directory)
-            save_raw_traces(start_time, job, results_directory, trace, [])
+            await asyncio.to_thread(
+                save_raw_traces, start_time, job, results_directory, trace, []
+            )
             # Keep the scheduler section present on every processed-results
             # file (consumers index it unconditionally); a fully-resumed
             # job scheduled nothing, so the count is trivially zero.
-            save_processed_results(
+            await asyncio.to_thread(
+                save_processed_results,
                 start_time, job, results_directory, [],
                 scheduler_stats={"auction_greedy_fallbacks": 0},
             )
@@ -393,11 +404,13 @@ async def run_job_command(args: argparse.Namespace) -> int:
                     file=sys.stderr,
                 )
 
-    save_raw_traces(
-        start_time, job, results_directory, master_trace, worker_traces
+    await asyncio.to_thread(
+        save_raw_traces,
+        start_time, job, results_directory, master_trace, worker_traces,
     )
     performance = parse_worker_traces(worker_traces)
-    save_processed_results(
+    await asyncio.to_thread(
+        save_processed_results,
         start_time,
         job,
         results_directory,

@@ -42,6 +42,7 @@ recorded in ``view()`` but no file is written).
 
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
 import math
@@ -52,7 +53,7 @@ from collections import deque
 from pathlib import Path
 from typing import TYPE_CHECKING, Any
 
-from tpu_render_cluster.utils.env import env_float
+from tpu_render_cluster.utils.env import env_float, env_str
 
 if TYPE_CHECKING:
     from tpu_render_cluster.obs.history import HistoryStore
@@ -89,7 +90,7 @@ def resolve_flight_directory(
     caller's fallback (the metrics snapshot's directory), else None."""
     if explicit is not None:
         return Path(explicit)
-    env = os.environ.get("TRC_OBS_FLIGHT_DIR")
+    env = env_str("TRC_OBS_FLIGHT_DIR")
     if env:
         return Path(env)
     if fallback is not None:
@@ -131,6 +132,9 @@ class FlightRecorder:
         # the lifetime totals.
         self.triggers: dict[str, int] = {}
         self.dumps: deque[dict[str, Any]] = deque(maxlen=256)
+        # Deferred bundle writes in flight (loop contexts only).
+        self._pending: set = set()
+        self._last_write_ok = True
 
     # -- recording -----------------------------------------------------------
 
@@ -165,10 +169,7 @@ class FlightRecorder:
                 self.directory
                 / f"{self.process_name}-{sequence:03d}-{trigger}_blackbox.json"
             )
-            try:
-                self._write_atomic(path, bundle)
-            except OSError as e:
-                logger.error("Flight-recorder dump to %s failed: %s", path, e)
+            if not self._dispatch_write(path, bundle):
                 path = None
         record = {
             "trigger": trigger,
@@ -253,6 +254,60 @@ class FlightRecorder:
             "otherData": {"blackbox_trigger": trigger},
             "blackbox": blackbox,
         }
+
+    def _dispatch_write(self, path: Path, bundle: dict[str, Any]) -> bool:
+        """Write the bundle WITHOUT ever holding an event loop.
+
+        The triggers fire inside the master's async handlers (SLO fires,
+        evictions, epoch-fence refusals), where the serialize+fsync of a
+        multi-megabyte bundle would stall heartbeat service exactly when
+        the cluster is already in trouble. On a running loop the atomic
+        write is deferred to ``asyncio.to_thread`` (tracked; ``drain()``
+        awaits it at shutdown so no bundle is lost to loop teardown).
+        Without a loop the write still runs on a short-lived worker
+        thread — structurally, ``_write_atomic`` cannot execute on a
+        thread that owns a running event loop, which is also what keeps
+        the loop-blocking lint clean without suppressions.
+
+        Returns False only on a synchronous write failure; deferred
+        failures are logged by the writer task (the recorded ``path`` of
+        such a dump may then name a file that never landed — the log
+        line and the bundle's absence are the post-mortem's post-mortem).
+        """
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        if loop is not None:
+            task = loop.create_task(
+                self._write_deferred(path, bundle),
+                name=f"flightrec-dump-{path.name}",
+            )
+            self._pending.add(task)
+            task.add_done_callback(self._pending.discard)
+            return True
+        worker = threading.Thread(
+            target=self._write_checked, args=(path, bundle), daemon=True
+        )
+        worker.start()
+        worker.join()
+        return self._last_write_ok
+
+    async def _write_deferred(self, path: Path, bundle: dict[str, Any]) -> None:
+        await asyncio.to_thread(self._write_checked, path, bundle)
+
+    def _write_checked(self, path: Path, bundle: dict[str, Any]) -> None:
+        try:
+            self._write_atomic(path, bundle)
+            self._last_write_ok = True
+        except OSError as e:
+            self._last_write_ok = False
+            logger.error("Flight-recorder dump to %s failed: %s", path, e)
+
+    async def drain(self) -> None:
+        """Await every deferred bundle write (call before loop teardown)."""
+        while self._pending:
+            await asyncio.gather(*list(self._pending), return_exceptions=True)
 
     @staticmethod
     def _write_atomic(path: Path, bundle: dict[str, Any]) -> None:

@@ -31,10 +31,10 @@ pass-through); measured-time pairing is cheap and always on.
 from __future__ import annotations
 
 import logging
-import os
 import threading
 import time
 from typing import Any, Callable
+from tpu_render_cluster.utils.env import env_str
 
 logger = logging.getLogger(__name__)
 
@@ -73,7 +73,7 @@ _DEFAULT_PEAKS = {
 
 
 def profiling_enabled() -> bool:
-    return os.environ.get("TRC_OBS_PROFILING", "1").strip() not in ("0", "off")
+    return (env_str("TRC_OBS_PROFILING", "1") or "").strip() not in ("0", "off")
 
 
 def device_peaks() -> dict[str, float]:
@@ -87,8 +87,8 @@ def device_peaks() -> dict[str, float]:
     except Exception:  # noqa: BLE001 - peaks must resolve even without jax
         pass
     flops, bandwidth = _DEFAULT_PEAKS.get(backend, _DEFAULT_PEAKS["cpu"])
-    raw_flops = os.environ.get("TRC_PEAK_FLOPS")
-    raw_bw = os.environ.get("TRC_PEAK_BYTES_PER_SECOND")
+    raw_flops = env_str("TRC_PEAK_FLOPS")
+    raw_bw = env_str("TRC_PEAK_BYTES_PER_SECOND")
     try:
         if raw_flops:
             flops = float(raw_flops)

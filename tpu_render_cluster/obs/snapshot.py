@@ -102,6 +102,12 @@ class SnapshotWriter:
             self._task = None
         if final_write:
             try:
-                self.write_once()
+                # Same split as _run: extra_fn reads loop-owned state here,
+                # the serialize + write + fsync go to a thread — the final
+                # snapshot must not stall the rest of shutdown either.
+                extra = self.extra_fn() if self.extra_fn is not None else None
+                await asyncio.to_thread(
+                    write_metrics_snapshot, self.path, self.registry, extra=extra
+                )
             except Exception as e:  # noqa: BLE001
                 logger.warning("Final metrics snapshot failed: %s", e)

@@ -25,8 +25,8 @@ path is exercised by CPU tests.
 from __future__ import annotations
 
 import functools
-import os
 from typing import NamedTuple
+from tpu_render_cluster.utils.env import env_int, env_str
 
 import jax
 import jax.numpy as jnp
@@ -70,7 +70,7 @@ def pallas_enabled() -> bool:
     compiled executable, so flipping the env var mid-process has no effect
     on already-compiled functions (jax.clear_caches() to re-trace).
     """
-    value = os.environ.get("TRC_PALLAS")
+    value = env_str("TRC_PALLAS")
     if value is None:
         return jax.default_backend() == "tpu"
     return value not in ("0", "false", "off")
@@ -101,7 +101,7 @@ def wavefront_mode() -> str:
     Like ``TRC_PALLAS`` this is read when the dispatch decision is made
     (the wavefront driver runs outside jit, so per-frame, not per-trace).
     """
-    value = (os.environ.get("TRC_WAVEFRONT") or "").strip().lower()
+    value = (env_str("TRC_WAVEFRONT") or "").strip().lower()
     if value in ("", "auto"):
         return "auto"
     if value in ("0", "false", "off", "no"):
@@ -135,7 +135,7 @@ def tlas_enabled() -> bool:
     argument so both kernel variants can coexist in one process (the
     interleaved A/B bench relies on that).
     """
-    value = os.environ.get("TRC_TLAS")
+    value = env_str("TRC_TLAS")
     if value is None:
         return True
     return value not in ("0", "false", "off", "no")
@@ -145,10 +145,7 @@ def tlas_leaf_size() -> int:
     """Instances per TLAS leaf (``TRC_TLAS_LEAF``, default 4, clamped to
     [1, 16]). Part of the compiled kernel's identity — a distinct leaf
     size is a distinct trace."""
-    try:
-        leaf = int(os.environ.get("TRC_TLAS_LEAF", "4"))
-    except ValueError:
-        leaf = 4
+    leaf = env_int("TRC_TLAS_LEAF", 4)
     return max(1, min(leaf, 16))
 
 
@@ -169,10 +166,7 @@ def tlas_block_r() -> int:
     width / bucket quanta the drivers round to, and read at trace time
     like the other TLAS knobs (part of each compiled kernel's shape).
     """
-    try:
-        raw = int(os.environ.get("TRC_TLAS_BLOCK", "256"))
-    except ValueError:
-        raw = 256
+    raw = env_int("TRC_TLAS_BLOCK", 256)
     block = 128
     while block * 2 <= min(raw, BVH_BLOCK_R):
         block *= 2

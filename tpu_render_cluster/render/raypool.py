@@ -55,13 +55,12 @@ occupancy/refill args are real device-measured values.
 from __future__ import annotations
 
 import functools
-import os
 import time
 
 import jax
 import jax.numpy as jnp
 
-from tpu_render_cluster.utils.env import env_int
+from tpu_render_cluster.utils.env import env_int, env_str
 from tpu_render_cluster.render import pallas_kernels as pk
 from tpu_render_cluster.render.compaction import (
     ALIVE_FRACTION_BUCKETS,
@@ -91,7 +90,7 @@ def raypool_mode() -> str:
     - anything else truthy: force it for every Pallas-rendered scene,
       single frames and spheres included.
     """
-    value = (os.environ.get("TRC_RAYPOOL") or "").strip().lower()
+    value = (env_str("TRC_RAYPOOL") or "").strip().lower()
     if value in ("", "auto"):
         return "auto"
     if value in ("0", "false", "off", "no"):

@@ -43,7 +43,6 @@ from __future__ import annotations
 import bisect
 import json
 import logging
-import os
 from collections import deque
 from pathlib import Path
 from typing import TYPE_CHECKING, Any, Callable, Iterable, NamedTuple, Sequence
@@ -51,6 +50,7 @@ from typing import TYPE_CHECKING, Any, Callable, Iterable, NamedTuple, Sequence
 import numpy as np
 
 from tpu_render_cluster.jobs.tiles import WorkUnit, unit_pixel_fraction
+from tpu_render_cluster.utils.env import env_str
 
 if TYPE_CHECKING:
     from tpu_render_cluster.jobs.models import BlenderJob
@@ -441,12 +441,12 @@ def explicit_model_configured() -> bool:
     """True when ``TRC_COST_MODEL`` names an explicit startup model — the
     precedence gate snapshot-restore paths (resume, the serve service)
     consult so they never overwrite an operator-chosen model."""
-    return bool(os.environ.get("TRC_COST_MODEL", "").strip())
+    return bool((env_str("TRC_COST_MODEL") or "").strip())
 
 
 def load_cost_model_from_env() -> JointCostModel | None:
     """The ``TRC_COST_MODEL`` startup model, or None (cold start)."""
-    path = os.environ.get("TRC_COST_MODEL", "").strip()
+    path = (env_str("TRC_COST_MODEL") or "").strip()
     if not path:
         return None
     model = load_model_snapshot(path)

@@ -453,6 +453,12 @@ class JobManager(ClusterManager):
             # journal new transitions.
             from tpu_render_cluster.ha.failover import adopt_ledger
 
+            # Settle queued appends first: the replay this admission reads
+            # must include every transition already scheduled (a closed
+            # same-name generation still in the appender queue would
+            # otherwise be re-admitted as open).
+            if self.ledger_appender is not None:
+                await self.ledger_appender.drain()
             _replayed, needs_stitch = adopt_ledger(
                 run.state,
                 self.ledger,
@@ -461,6 +467,7 @@ class JobManager(ClusterManager):
                 job_id=run.job_id,
                 weight=run.spec.weight,
                 priority=run.spec.priority,
+                appender=self.ledger_appender,
             )
             for frame_index in needs_stitch:
                 self.assembly.schedule(run.state, frame_index)
@@ -501,18 +508,21 @@ class JobManager(ClusterManager):
     def _finish_run(self, run: JobRun, status: str, now: float) -> None:
         run.status = status
         run.finished_at = now
-        if self.ledger is not None and run.state is not None:
+        if self.ledger_appender is not None and run.state is not None:
             # Close the job's ledger lifecycle so a restarted service does
             # not re-admit it (and a later same-name submission starts a
             # fresh generation). Never-admitted cancels (state None) were
-            # never journaled, so there is nothing to close.
-            try:
-                if status == JOB_FINISHED:
-                    self.ledger.append_job_finished(run.job_name)
-                else:
-                    self.ledger.append_job_cancelled(run.job_name)
-            except OSError as e:
-                logger.error("Ledger job-close append failed: %s", e)
+            # never journaled, so there is nothing to close. Scheduled
+            # through the FIFO appender: ordered after the job's queued
+            # unit appends, fsync'd off the scheduler loop.
+            if status == JOB_FINISHED:
+                self.ledger_appender.schedule(
+                    self.ledger.append_job_finished, run.job_name
+                )
+            else:
+                self.ledger_appender.schedule(
+                    self.ledger.append_job_cancelled, run.job_name
+                )
         # Final SLO verdict (deadline judged at the true end; no-op for
         # jobs without objectives or never admitted).
         self.slo.finish_job(run.job_name)

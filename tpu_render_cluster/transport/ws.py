@@ -18,6 +18,7 @@ import hashlib
 import os
 import secrets
 import struct
+import threading
 
 import numpy as np
 
@@ -43,20 +44,41 @@ class WebSocketClosed(WebSocketError):
 
 
 _native_codec = None
-_native_checked = False
+_native_load_started = False
+_native_load_lock = threading.Lock()
+
+
+def _load_native_codec_blocking() -> None:
+    """Build + load the C++ codec; runs on the loader thread only."""
+    global _native_codec
+    try:
+        from tpu_render_cluster.native import load_codec
+
+        _native_codec = load_codec()
+    except Exception:  # noqa: BLE001 - any failure means Python fallback
+        _native_codec = None
 
 
 def _get_native_codec():
-    """Lazily load the C++ codec (tpu_render_cluster/native); None if absent."""
-    global _native_codec, _native_checked
-    if not _native_checked:
-        _native_checked = True
-        try:
-            from tpu_render_cluster.native import load_codec
+    """The C++ codec (tpu_render_cluster/native) once loaded; None until
+    then (and forever when the toolchain is absent).
 
-            _native_codec = load_codec()
-        except Exception:  # noqa: BLE001 - any failure means Python fallback
-            _native_codec = None
+    The first call is made from inside a coroutine masking its first
+    large frame, and ``load_codec`` may COMPILE the codec (``g++``, a
+    multi-second ``subprocess.run``) — so the load runs on a background
+    thread and callers use the numpy fallback until it lands, instead of
+    parking the event loop behind a compiler on the first send.
+    """
+    global _native_load_started
+    if _native_codec is None and not _native_load_started:
+        with _native_load_lock:
+            if not _native_load_started:
+                _native_load_started = True
+                threading.Thread(
+                    target=_load_native_codec_blocking,
+                    name="wscodec-load",
+                    daemon=True,
+                ).start()
     return _native_codec
 
 

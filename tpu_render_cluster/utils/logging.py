@@ -12,6 +12,7 @@ import logging
 import os
 import sys
 from pathlib import Path
+from tpu_render_cluster.utils.env import env_str
 
 _LEVELS = {
     "trace": logging.DEBUG,  # python has no TRACE; map to DEBUG
@@ -26,7 +27,7 @@ _FORMAT = "%(asctime)s %(levelname)-5s %(name)s: %(message)s"
 
 
 def _env_level(default: str = "info") -> int:
-    raw = os.environ.get("TRC_LOG") or os.environ.get("RUST_LOG") or default
+    raw = env_str("TRC_LOG") or os.environ.get("RUST_LOG") or default
     # The global level is the first directive WITHOUT a module prefix
     # (e.g. "tungstenite=warn,info" -> "info"); per-module filters are ignored.
     level = default

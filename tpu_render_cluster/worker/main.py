@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import argparse
 import asyncio
-import os
 import signal
 import sys
 from pathlib import Path
@@ -23,6 +22,7 @@ from tpu_render_cluster.obs import (
 )
 from tpu_render_cluster.protocol import messages as pm
 from tpu_render_cluster.utils.logging import initialize_console_and_file_logging
+from tpu_render_cluster.utils.env import env_str
 from tpu_render_cluster.worker.backends import create_backend
 from tpu_render_cluster.worker.runtime import Worker
 
@@ -146,7 +146,7 @@ def make_backend(args: argparse.Namespace):
         initialize_multihost(
             args.coordinator_address, args.num_processes, args.process_id
         )
-        cache_dir = os.environ.get("TRC_COMPILE_CACHE")
+        cache_dir = env_str("TRC_COMPILE_CACHE")
         if cache_dir:
             # Persistent XLA compilation cache: the first worker process
             # pays the 20-40 s compile, later ones deserialize in ~1 s.
