@@ -258,6 +258,16 @@ def occupancy_probe(scene_name: str) -> float | None:
     return compaction.wasted_lane_fraction()
 
 
+def _bvh_format_note() -> dict:
+    """The BVH node-format env tiers a record was taken under (method
+    stamp for WAVEFRONT/RAYPOOL/BVH records): resolved exactly as the
+    render drivers resolve them."""
+    from tpu_render_cluster.render.integrator import resolve_bvh_config
+
+    tlas, quant, builder, wide = resolve_bvh_config()
+    return {"tlas": tlas, "quant": quant, "builder": builder, "wide": wide}
+
+
 def wavefront_compare(
     scene_name: str, frames: int = 8, reps: int = 5, bounces: int = BOUNCES
 ) -> dict:
@@ -342,6 +352,7 @@ def wavefront_compare(
             # env tier at record time) — the masked tier is pinned to
             # the Pallas path off-chip so the modes share one suite.
             "tlas_kernels": pk.tlas_enabled(),
+            "bvh_node_format": _bvh_format_note(),
         }
         modes = (("masked", masked_frame), ("wavefront", wavefront_frame))
         for _name, render_one in modes:
@@ -374,6 +385,67 @@ def wavefront_compare(
             fused_frame_renderer.cache_clear()
 
 
+# The node-format variants bvh_compare prices (ISSUE 15): each is a
+# DISTINCT compiled program in one process (the knobs are part of the
+# renderer cache key and every jit identity). "flat"/"tlas" keep the
+# PR-10 hierarchy axis alive; the quant/SAH axis measures the new node
+# formats against the PR-10 config ("tlas": median-split binary BLAS,
+# fp32 nodes).
+BVH_VARIANTS: dict[str, dict] = {
+    "flat": dict(use_tlas=False, quant=0, builder="median", wide=1),
+    "tlas": dict(use_tlas=True, quant=0, builder="median", wide=1),
+    "tlas_sah": dict(use_tlas=True, quant=0, builder="sah", wide=4),
+    "tlas_quant": dict(use_tlas=True, quant=1, builder="median", wide=1),
+    "tlas_quant_sah": dict(use_tlas=True, quant=1, builder="sah", wide=4),
+}
+
+
+def _node_table_footprint(scene_name: str, cfg: dict) -> dict:
+    """Bytes of the node tables a variant's kernels actually LOAD:
+    fp32 nodes cost 36 B (6 f32 slabs + 3 int32 links), quant tier 1
+    16 B (3 packed slab words + 1 meta word), tier 2 12 B. SAH builds
+    ship octant-ordered tables — the SAME tree re-threaded 8x — so
+    their resident table is 8x the canonical node count: the ordering
+    trades table footprint for fewer node VISITS, while quant shrinks
+    the bytes PER node; both are reported so neither win is conflated.
+    """
+    from tpu_render_cluster.render.mesh import (
+        cached_mesh_bvh,
+        cached_tlas_topology,
+    )
+    from tpu_render_cluster.render import pallas_kernels as pk
+    from tpu_render_cluster.render.scene import (
+        build_mesh_instances,
+        mesh_kind_for_scene,
+    )
+
+    kind = mesh_kind_for_scene(scene_name)
+    if kind is None:
+        return {}
+    per_node = {0: 36, 1: 16, 2: 12}[cfg["quant"]]
+    bvh = cached_mesh_bvh(kind, cfg["builder"], cfg["wide"])
+    blas_nodes = int(bvh.skip.shape[0])
+    orders = 8 if bvh.octant is not None else 1
+    out = {
+        "blas_nodes": blas_nodes,
+        "octant_orders": orders,
+        "bytes_per_node": per_node,
+        "blas_bytes": blas_nodes * orders * per_node,
+    }
+    k = int(build_mesh_instances(scene_name, 1).translation.shape[0])
+    if cfg["use_tlas"] and k > pk.tlas_leaf_size():
+        tlas_nodes = int(
+            cached_tlas_topology(k, pk.tlas_leaf_size()).skip.shape[0]
+        )
+        out["tlas_nodes"] = tlas_nodes
+        out["total_bytes"] = (
+            out["blas_bytes"] + tlas_nodes * orders * per_node
+        )
+    else:
+        out["total_bytes"] = out["blas_bytes"]
+    return out
+
+
 def bvh_compare(
     deep_scene: str = "03_physics-2-mesh",
     control_scene: str = "02_physics-mesh",
@@ -381,42 +453,47 @@ def bvh_compare(
     reps: int = 5,
     bounces: int = BOUNCES,
 ) -> dict:
-    """Flat in-kernel instance loop vs two-level TLAS kernels (ISSUE 10).
+    """BVH node-format/build A/B (ISSUE 10 hierarchy axis + ISSUE 15
+    quant/SAH axis) through the masked fused renderer.
 
-    Interleaved median-of-reps A/B through the masked fused renderer —
-    the two variants are DISTINCT compiled programs in one process
-    (``use_tlas`` is part of the renderer cache key and every jit
-    identity), so each rep times (flat window, TLAS window) back to
-    back and the median cancels machine-load drift (per the recorded
-    bench-variance protocol: sequential timings are invalid at this
-    host's ±30%). Two scenes:
+    Interleaved median-of-reps: each rep times every variant's window
+    back to back on the SAME frame range, and the median cancels
+    machine-load drift (per the recorded bench-variance protocol:
+    sequential timings are invalid at this host's ±30%). Variants (see
+    ``BVH_VARIANTS``): flat sweep, PR-10 TLAS baseline, binned-SAH +
+    4-wide BLAS, 16-bit quantized nodes (+ packed carried state), and
+    the combined quant+SAH headline. Two scenes:
 
-    - ``deep_scene`` (03-family: 127-node BLAS x 48 instances) — the
-      deep-scene cliff the TLAS exists for (every bounce kernel used to
-      sweep all 48 instances per ray block);
+    - ``deep_scene`` (03-family: deep BLAS x 48 instances) — the
+      deep-scene cliff where the BLAS walk dominates;
     - ``control_scene`` (shallow megakernel mesh scene) — the
-      no-regression guard: the TLAS walk still runs there (24
-      instances), it just has less to prune.
+      no-regression guard.
 
-    Each scene's section also records the per-kernel roofline placement
-    delta from the PR-9 ``cost_analysis`` capture: the two variants'
-    FLOPs / bytes-accessed / achieved-vs-attainable rows land under
-    separate ``tlas=0|1`` kernel keys, so the record shows WHERE the
-    speedup comes from (fewer instance-sweep FLOPs and one less
-    full-state broadphase pass per bounce), not just that it exists.
+    Each scene's section records per-variant roofline placement from the
+    PR-9 ``cost_analysis`` capture — every variant lands under its own
+    (tlas, quant, bvh) kernel-key dims — plus a computed BYTES-PER-RAY
+    estimate (cost-model bytes accessed / rays per frame): the record
+    shows the bytes the node formats remove, not just the frames/s
+    delta. The masked tier's tonemapped frames are asserted
+    uint8-identical across every variant (conservative quantized cull +
+    order-invariant per-lane results), stamped ``images_identical``.
 
     On non-TPU hosts the masked tier is pinned to the Pallas interpret
-    path for the duration (same rationale as raypool_compare: all
-    variants must run the same kernel suite or the comparison is
-    fiction). The committed record lives at results/BVH_BENCH.json; run
-    with ``python bench.py --bvh-compare`` on the target device class.
+    path for the duration (all variants must run the same kernel suite
+    or the comparison is fiction). The committed record lives at
+    results/BVH_BENCH.json; run with ``python bench.py --bvh-compare``
+    on the target device class.
     """
     import statistics
 
     import jax
     import numpy as np
 
-    from tpu_render_cluster.obs.profiling import get_profiler
+    from tpu_render_cluster.obs.profiling import (
+        bvh_dims,
+        get_profiler,
+        kernel_key,
+    )
     from tpu_render_cluster.render import pallas_kernels as pk
     from tpu_render_cluster.render.integrator import fused_frame_renderer
 
@@ -433,33 +510,58 @@ def bvh_compare(
         # overhead, but interpret mode caps what is affordable.
         width = height = WIDTH if on_tpu else 128
         samples = SAMPLES if on_tpu else 1
+        rays_per_frame = width * height * samples
         record: dict = {
             "metric": (
-                f"flat instance loop vs two-level TLAS kernels "
-                f"({width}x{height}, {samples}spp, {bounces}b, "
+                f"BVH node-format variants (flat / TLAS / SAH+wide / "
+                f"quantized) ({width}x{height}, {samples}spp, {bounces}b, "
                 f"{jax.devices()[0].platform})"
             ),
             "unit": "frames/s/chip",
             "frames": frames,
             "reps": reps,
             "tlas_leaf": pk.tlas_leaf_size(),
+            "variants": {
+                name: dict(cfg) for name, cfg in BVH_VARIANTS.items()
+            },
+            "method_note": (
+                "CPU-interpret proxy: the quant tiers' node/state byte "
+                "compression (node_tables rows; 36 -> 16 B/node, carried "
+                "pool tuple 13 -> 11 words) costs unpack ALU here and "
+                "pays only on HBM-bandwidth-bound hardware — the "
+                "frames/s axis on this host measures the SAH/wide/"
+                "ordered-traversal half (fewer node visits) plus a small "
+                "quant ALU tax; re-record on chip for the byte half. "
+                "images_identical pins the masked tier bit-exact across "
+                "every variant."
+            ),
             "scenes": {},
         }
         profiler = get_profiler()
         for scene_name in (deep_scene, control_scene):
             renderers = {
-                "flat": fused_frame_renderer(
-                    scene_name, width, height, samples, bounces, False
-                ),
-                "tlas": fused_frame_renderer(
-                    scene_name, width, height, samples, bounces, True
-                ),
+                name: fused_frame_renderer(
+                    scene_name, width, height, samples, bounces,
+                    cfg["use_tlas"], cfg["quant"], cfg["builder"],
+                    cfg["wide"],
+                )
+                for name, cfg in BVH_VARIANTS.items()
             }
-            for renderer in renderers.values():
-                np.asarray(renderer(1))  # compile + warm
-            fps: dict[str, list[float]] = {"flat": [], "tlas": []}
+            # Compile + warm, and pin the uint8 acceptance contract:
+            # every node format renders the IDENTICAL tonemapped frame
+            # (conservative quantized cull; per-lane results are
+            # visit-order invariant).
+            warm = {
+                name: np.asarray(renderer(1))
+                for name, renderer in renderers.items()
+            }
+            reference = warm["tlas"]
+            images_identical = all(
+                np.array_equal(img, reference) for img in warm.values()
+            )
+            fps: dict[str, list[float]] = {name: [] for name in renderers}
             for rep in range(reps):
-                # Both variants render the SAME frame window per rep
+                # Every variant renders the SAME frame window per rep
                 # (physics-animated scenes: disjoint ranges would
                 # compare different geometry).
                 rep_frames = range(2 + rep * frames, 2 + (rep + 1) * frames)
@@ -475,30 +577,46 @@ def bvh_compare(
                         # the bench stands in for it here).
                         profiler.record_execute(renderer.kernel_key, elapsed)
                     fps[name].append(frames / window)
-            section: dict = {}
+            section: dict = {"images_identical": bool(images_identical)}
             for name, values in fps.items():
                 section[f"{name}_fps"] = round(statistics.median(values), 3)
             section["tlas_speedup"] = round(
                 section["tlas_fps"] / section["flat_fps"], 3
             )
-            # Roofline placement per variant: the masked-tier kernel
-            # keys differ only in the tlas dim.
+            # The ISSUE-15 acceptance ratio: quant+SAH combined vs the
+            # PR-10 node format, same TLAS hierarchy on both sides.
+            section["quant_sah_speedup"] = round(
+                section["tlas_quant_sah_fps"] / section["tlas_fps"], 3
+            )
+            section["sah_speedup"] = round(
+                section["tlas_sah_fps"] / section["tlas_fps"], 3
+            )
+            # Roofline placement per variant: each masked-tier kernel
+            # key carries its own (tlas, quant, bvh) dims.
             roofline = profiler.view()
             kernels = roofline.get("kernels", {})
             placement: dict = {}
-            for name, flag in (("flat", 0), ("tlas", 1)):
-                from tpu_render_cluster.obs.profiling import kernel_key
-
+            for name, cfg in BVH_VARIANTS.items():
                 entry = kernels.get(
                     kernel_key(
                         "masked", scene_name,
-                        w=width, h=height, s=samples, b=bounces, tlas=flag,
+                        w=width, h=height, s=samples, b=bounces,
+                        **bvh_dims(
+                            tlas=cfg["use_tlas"], quant=cfg["quant"],
+                            builder=cfg["builder"], wide=cfg["wide"],
+                        ),
                     )
                 )
                 if entry and entry.get("captured"):
                     placement[name] = {
                         "flops": entry["flops"],
                         "bytes_accessed": entry["bytes_accessed"],
+                        # The bytes/ray estimate the node formats attack:
+                        # cost-model bytes accessed per compiled frame
+                        # divided by the frame's primary rays.
+                        "bytes_per_ray": round(
+                            entry["bytes_accessed"] / rays_per_frame, 1
+                        ),
                         "bound": entry.get("bound"),
                         "achieved_fraction_of_attainable": round(
                             entry.get(
@@ -507,23 +625,34 @@ def bvh_compare(
                             6,
                         ),
                     }
-            if {"flat", "tlas"} <= placement.keys():
-                flat_p, tlas_p = placement["flat"], placement["tlas"]
+            if {"tlas", "tlas_quant_sah"} <= placement.keys():
+                base_p = placement["tlas"]
+                new_p = placement["tlas_quant_sah"]
                 placement["delta"] = {
                     "flops_ratio": round(
-                        tlas_p["flops"] / flat_p["flops"], 4
-                    ) if flat_p["flops"] else None,
+                        new_p["flops"] / base_p["flops"], 4
+                    ) if base_p["flops"] else None,
                     "bytes_ratio": round(
-                        tlas_p["bytes_accessed"] / flat_p["bytes_accessed"],
+                        new_p["bytes_accessed"] / base_p["bytes_accessed"],
                         4,
-                    ) if flat_p["bytes_accessed"] else None,
+                    ) if base_p["bytes_accessed"] else None,
                     "attainable_fraction_delta": round(
-                        tlas_p["achieved_fraction_of_attainable"]
-                        - flat_p["achieved_fraction_of_attainable"],
+                        new_p["achieved_fraction_of_attainable"]
+                        - base_p["achieved_fraction_of_attainable"],
                         6,
                     ),
                 }
             section["roofline"] = placement
+            # Analytic node-table footprint per variant: the bytes the
+            # quant/SAH/wide formats actually remove. XLA cost analysis
+            # cannot price a data-dependent walk (while-loop bodies are
+            # counted once), so the whole-program bytes_per_ray above
+            # barely moves — this row makes the table compression
+            # visible: nodes x (36 B fp32 | 16 B 16-bit | 12 B 8-bit).
+            section["node_tables"] = {
+                name: _node_table_footprint(scene_name, cfg)
+                for name, cfg in BVH_VARIANTS.items()
+            }
             section["role"] = (
                 "deep" if scene_name == deep_scene else "shallow-control"
             )
@@ -644,6 +773,7 @@ def _raypool_compare_inner(
         # env tier at record time; the masked tier is already pinned to
         # the Pallas path off-chip).
         "tlas_kernels": pk.tlas_enabled(),
+        "bvh_node_format": _bvh_format_note(),
     }
     modes = (
         ("masked", masked_window),

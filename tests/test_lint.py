@@ -425,6 +425,70 @@ def test_env_registry_flags_dead_and_undocumented_declarations(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# env-tiers
+
+
+ENV_TIERS_POSITIVE = """\
+    import functools
+
+    import jax
+
+    from tpu_render_cluster.render.pallas_kernels import bvh_quant_mode
+
+
+    @functools.partial(jax.jit, static_argnames=("width",))
+    def render_batch(frames, *, width):
+        quant = bvh_quant_mode()
+        return frames * quant
+"""
+
+
+def test_env_tiers_fires_inside_traced_function(tmp_path):
+    ctx = make_ctx(tmp_path, {"kern.py": ENV_TIERS_POSITIVE})
+    findings = run_pass(ctx, "env-tiers")
+    assert len(findings) == 1
+    assert (findings[0].path, findings[0].line) == ("fixpkg/kern.py", 10)
+    assert "bvh_quant_mode" in findings[0].message
+    assert "static argument" in findings[0].message
+
+
+def test_env_tiers_threaded_static_arg_is_clean(tmp_path):
+    ctx = make_ctx(
+        tmp_path,
+        {
+            "kern.py": """\
+    import functools
+
+    import jax
+
+    from tpu_render_cluster.render.pallas_kernels import bvh_quant_mode
+
+
+    @functools.partial(jax.jit, static_argnames=("quant",))
+    def render_batch(frames, *, quant):
+        return frames * quant
+
+
+    def driver(frames):
+        # Untraced driver: resolving the tier HERE is the contract.
+        return render_batch(frames, quant=bvh_quant_mode())
+    """
+        },
+    )
+    assert run_pass(ctx, "env-tiers") == []
+
+
+def test_env_tiers_pragma_suppressed_negative(tmp_path):
+    suppressed = ENV_TIERS_POSITIVE.replace(
+        "quant = bvh_quant_mode()",
+        "quant = bvh_quant_mode()  # trc-lint: disable=env-tiers "
+        "(fixture: baking the tier is this test's point)",
+    )
+    ctx = make_ctx(tmp_path, {"kern.py": suppressed})
+    assert run_pass(ctx, "env-tiers") == []
+
+
+# ---------------------------------------------------------------------------
 # pragma meta-pass
 
 

@@ -1,12 +1,14 @@
 """trc-lint: the codebase-native static-analysis suite (ARCHITECTURE §L12).
 
-Four passes enforce the conventions the cluster's correctness rests on —
+Five passes enforce the conventions the cluster's correctness rests on —
 ``loop-blocking`` (never block the asyncio event loop), ``wire-schema``
 (the optional-key omitted-when-absent idiom, checked against
 ``protocol/schema.py`` and PROTOCOL.md), ``jit-purity`` (no host effects
-inside traced render functions), and ``env-registry`` (every ``TRC_*``
-knob declared in ``utils/env.py`` and documented in README) — plus the
-``pragma`` meta-pass that keeps every suppression explained.
+inside traced render functions), ``env-registry`` (every ``TRC_*``
+knob declared in ``utils/env.py`` and documented in README), and
+``env-tiers`` (static jit-arg env tiers — the BVH node-format knobs —
+resolve outside traced functions only) — plus the ``pragma`` meta-pass
+that keeps every suppression explained.
 
 Run it: ``python -m tpu_render_cluster.lint`` (``--json`` for machine
 output; nonzero exit on findings). The whole suite is a tier-1 gate
@@ -17,6 +19,7 @@ from __future__ import annotations
 
 from tpu_render_cluster.lint import (
     env_registry,
+    env_tiers,
     jit_purity,
     loop_blocking,
     wire_schema,
@@ -36,6 +39,7 @@ PASSES = {
     wire_schema.PASS_ID: wire_schema.run,
     jit_purity.PASS_ID: jit_purity.run,
     env_registry.PASS_ID: env_registry.run,
+    env_tiers.PASS_ID: env_tiers.run,
 }
 
 __all__ = [

@@ -40,6 +40,7 @@ logger = logging.getLogger(__name__)
 
 __all__ = [
     "KernelProfiler",
+    "bvh_dims",
     "get_profiler",
     "kernel_key",
     "profiling_enabled",
@@ -58,6 +59,25 @@ def kernel_key(tier: str, scene_name: str | None = None, **dims: Any) -> str:
     if dims:
         key += "@" + ",".join(f"{k}={v}" for k, v in sorted(dims.items()))
     return key
+
+def bvh_dims(
+    *, tlas: int | bool, quant: int, builder: str, wide: int
+) -> dict:
+    """The BVH node-format dims every mesh-kernel key carries.
+
+    One definition site (like ``kernel_key``) so the masked, region,
+    wavefront, and raypool capture sites can never attribute two node
+    formats to one roofline row: a distinct (tlas, quant, builder, wide)
+    is a distinct kernel identity — exactly the set of knobs that change
+    the compiled program (``TRC_TLAS``/``TRC_BVH_QUANT``/
+    ``TRC_BVH_BUILDER``/``TRC_BVH_WIDE``).
+    """
+    return {
+        "tlas": int(tlas),
+        "quant": int(quant),
+        "bvh": f"{builder}{int(wide)}",
+    }
+
 
 # Conservative per-backend peak defaults, overridable via TRC_PEAK_*.
 # TPU: a single modern TPU core's VPU-adjusted vector peak (the renderer

@@ -72,9 +72,13 @@ def render_frame_sharded(
     n = mesh.devices.size
     scene = build_scene(scene_name, frame_index)
     camera = scene_camera(scene_name, frame_index)
+    from tpu_render_cluster.render.integrator import resolve_bvh_config
     from tpu_render_cluster.render.mesh import scene_mesh_set
 
-    mesh_set = scene_mesh_set(scene_name, frame_index)
+    # BVH env tiers resolve HERE (untraced) and ride the traced closures
+    # as captured statics — the env-tiers contract.
+    _tlas, bvh_quant, bvh_builder, bvh_wide = resolve_bvh_config()
+    mesh_set = scene_mesh_set(scene_name, frame_index, bvh_builder, bvh_wide)
     frame = jnp.asarray(frame_index, jnp.float32)
 
     if mode == "tile":
@@ -98,6 +102,7 @@ def render_frame_sharded(
                 samples=samples,
                 max_bounces=max_bounces,
                 mesh=mesh_set,
+                quant=bvh_quant,
             )
 
         sharded = _shard_map(
@@ -130,6 +135,7 @@ def render_frame_sharded(
                 samples=samples_per_device,
                 max_bounces=max_bounces,
                 mesh=mesh_set,
+                quant=bvh_quant,
             )
             return jax.lax.psum(image, "d") / n
 
@@ -166,6 +172,10 @@ def render_frames_batched(
     if frames.shape[0] % n != 0:
         raise ValueError(f"Batch {frames.shape[0]} not divisible by {n} devices.")
 
+    from tpu_render_cluster.render.integrator import resolve_bvh_config
+
+    _tlas, bvh_quant, bvh_builder, bvh_wide = resolve_bvh_config()
+
     def render_one(frame):
         from tpu_render_cluster.render.mesh import scene_mesh_set
 
@@ -183,7 +193,8 @@ def render_frames_batched(
             tile_width=width,
             samples=samples,
             max_bounces=max_bounces,
-            mesh=scene_mesh_set(scene_name, frame),
+            mesh=scene_mesh_set(scene_name, frame, bvh_builder, bvh_wide),
+            quant=bvh_quant,
         )
 
     # shard_map (not jit-level SPMD): the Pallas intersection kernel lowers

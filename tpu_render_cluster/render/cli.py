@@ -107,6 +107,9 @@ def _render_obj_stage(args, bvh):
         scale=jnp.array([1.0], jnp.float32),
     )
     camera = look_at_camera([4.0, 2.8, 4.2], [0.0, 1.0, 0.0])
+    from tpu_render_cluster.render.integrator import resolve_bvh_config
+
+    _tlas, bvh_quant, _builder, _wide = resolve_bvh_config()
     return render_tile(
         obj_stage_scene(args.frame),
         camera,
@@ -120,6 +123,7 @@ def _render_obj_stage(args, bvh):
         samples=args.samples,
         max_bounces=args.bounces,
         mesh=MeshSet(bvh=bvh, instances=instances),
+        quant=bvh_quant,
     )
 
 

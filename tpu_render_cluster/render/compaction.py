@@ -195,7 +195,7 @@ def _compact_sphere(origins, directions, throughput, alive, lane, rng):
     return (
         packed[:, 0:3],
         packed[:, 3:6],
-        packed[:, 6:9],
+        packed[:, 6:],  # width-generic: f32 [R, 3] or bf16-packed [R, 2]
         alive[perm],
         lane[perm],
         rng[perm],
@@ -218,7 +218,7 @@ def _compact_mesh(origins, directions, throughput, alive, lane, rng, mesh):
     return (
         packed[:, 0:3],
         packed[:, 3:6],
-        packed[:, 6:9],
+        packed[:, 6:],
         alive[order],
         lane[order],
         rng[order],
@@ -244,7 +244,7 @@ def _compact_mesh_keyed(origins, directions, throughput, alive, lane, rng,
     return (
         packed[:, 0:3],
         packed[:, 3:6],
-        packed[:, 6:9],
+        packed[:, 6:],
         alive[order],
         lane[order],
         rng[order],
@@ -263,28 +263,42 @@ def _initial_mesh_keys(origins, directions, alive, mesh):
     return pk.initial_mesh_sort_keys(mesh, origins, directions, alive)
 
 
-@functools.partial(jax.jit, static_argnames=("total_bounces",))
+@functools.partial(jax.jit, static_argnames=("total_bounces", "quant"))
 def _sphere_step(
     scene, origins, directions, throughput, alive, lane, rng, live, seed,
-    bounce, radiance_total, *, total_bounces: int,
+    bounce, radiance_total, *, total_bounces: int, quant: int = 0,
 ):
+    # quant >= 1: the carried throughput column is bf16-packed ([R, 2]
+    # f32 words) — the packed-carried-state half of the TRC_BVH_QUANT
+    # tier. The kernel still computes in f32; the pack/unpack round-trip
+    # per bounce is the divergence tests/test_bvhq.py budgets.
+    thr = pk.unpack_throughput_bf16(throughput) if quant else throughput
     contribution, o2, d2, thr2, alive2 = pk.sphere_bounce_pallas(
-        scene, origins, directions, throughput, alive, seed, bounce,
+        scene, origins, directions, thr, alive, seed, bounce,
         total_bounces=total_bounces, lane=rng, live_count=live,
     )
+    if quant:
+        thr2 = pk.pack_throughput_bf16(thr2)
     return o2, d2, thr2, alive2, radiance_total.at[lane].add(contribution)
 
 
-@functools.partial(jax.jit, static_argnames=("total_bounces", "use_tlas"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("total_bounces", "use_tlas", "quant", "tlas_block"),
+)
 def _mesh_step(
     scene, mesh, origins, directions, throughput, alive, lane, rng, live, seed,
     bounce, radiance_total, *, total_bounces: int, use_tlas: bool = False,
+    quant: int = 0, tlas_block: int = 256,
 ):
+    thr = pk.unpack_throughput_bf16(throughput) if quant else throughput
     contribution, o2, d2, thr2, alive2, keys2 = pk.mesh_bounce_pallas(
-        scene, mesh, origins, directions, throughput, alive, seed, bounce,
+        scene, mesh, origins, directions, thr, alive, seed, bounce,
         total_bounces=total_bounces, lane=rng, live_count=live,
-        use_tlas=use_tlas,
+        use_tlas=use_tlas, quant=quant, tlas_block=tlas_block,
     )
+    if quant:
+        thr2 = pk.pack_throughput_bf16(thr2)
     return (
         o2, d2, thr2, alive2, radiance_total.at[lane].add(contribution),
         keys2,
@@ -293,7 +307,7 @@ def _mesh_step(
 
 def trace_paths_wavefront(
     scene, origins, directions, seed, *, max_bounces: int = 4, mesh=None,
-    rng_lanes=None, use_tlas=None,
+    rng_lanes=None, use_tlas=None, quant=None,
 ):
     """Trace one sample per ray, wavefront-style; returns radiance [R, 3].
 
@@ -323,13 +337,19 @@ def trace_paths_wavefront(
         pk.use_tlas_for(mesh.instances.translation.shape[0], use_tlas)
         if mesh is not None else False
     )
+    # Node-format tier (None = TRC_BVH_QUANT): quantized node tables in
+    # the bounce kernels AND the bf16-packed carried throughput the
+    # compaction gathers move — both halves flip together so the A/B
+    # bench's variants stay whole.
+    quant = pk.bvh_quant_mode() if quant is None else max(0, min(int(quant), 2))
     # The bucket quantum is the kernel's ray block: the TLAS kernels
     # packet at the narrower tlas_block_r, which also buys the ladder
     # finer reclaim granularity.
+    tlas_block = pk.tlas_block_r()
     if mesh is None:
         block = pk.SPHERE_BOUNCE_BLOCK_R
     elif tlas:
-        block = pk.tlas_block_r()
+        block = tlas_block
     else:
         block = pk.BVH_BLOCK_R
     tracer = get_tracer()
@@ -339,6 +359,8 @@ def trace_paths_wavefront(
 
     radiance_total = jnp.zeros((n0, 3), jnp.float32)
     throughput = jnp.ones((n0, 3), jnp.float32)
+    if quant:
+        throughput = pk.pack_throughput_bf16(throughput)
     alive = jnp.ones((n0,), bool)
     lane = jnp.arange(n0, dtype=jnp.int32)
     rng = lane if rng_lanes is None else jnp.asarray(rng_lanes, jnp.int32)
@@ -390,18 +412,28 @@ def trace_paths_wavefront(
             rng = rng[:bucket]
         occupancy.set(live / bucket)
         launched.observe(live / bucket)
-        _count_compile(kind, "bounce", bucket, max_bounces, tlas)
+        _count_compile(kind, "bounce", bucket, max_bounces, tlas, quant)
         # Roofline profiling: the bucket program's identity is (kind,
-        # bucket, bounces) — the same identity the bucketed-jit cache
-        # compiles per. The capture args are stashed BEFORE the step
-        # reassigns them, but the lowering itself runs after the bounce's
-        # duration stamp so it never inflates a measured bounce.
-        from tpu_render_cluster.obs.profiling import get_profiler, kernel_key
+        # bucket, bounces, node format) — the same identity the
+        # bucketed-jit cache compiles per. The capture args are stashed
+        # BEFORE the step reassigns them, but the lowering itself runs
+        # after the bounce's duration stamp so it never inflates a
+        # measured bounce. The builder/wide dims tag which BLAS build the
+        # mesh passed in carries (callers building a non-default tree
+        # pass env overrides through scene_mesh_set, so the env tiers
+        # describe it).
+        from tpu_render_cluster.obs.profiling import (
+            bvh_dims,
+            get_profiler,
+            kernel_key,
+        )
+        from tpu_render_cluster.render.mesh import bvh_builder, bvh_wide
 
         profiler = get_profiler()
         step_key = kernel_key(
             f"wavefront_{kind}_bounce", None, bucket=bucket, b=max_bounces,
-            tlas=int(tlas),
+            **bvh_dims(tlas=tlas, quant=quant, builder=bvh_builder(),
+                       wide=bvh_wide()),
         )
         capture_args = None
         if not profiler.captured(step_key):
@@ -417,14 +449,15 @@ def trace_paths_wavefront(
              keys) = _mesh_step(
                 scene, mesh, origins, directions, throughput, alive,
                 lane, rng, live_dev, seed, bounce, radiance_total,
-                total_bounces=max_bounces, use_tlas=tlas,
+                total_bounces=max_bounces, use_tlas=tlas, quant=quant,
+                tlas_block=tlas_block,
             )
         else:
             origins, directions, throughput, alive, radiance_total = (
                 _sphere_step(
                     scene, origins, directions, throughput, alive, lane,
                     rng, live_dev, seed, bounce, radiance_total,
-                    total_bounces=max_bounces,
+                    total_bounces=max_bounces, quant=quant,
                 )
             )
         bounce_seconds = time.perf_counter() - start_mono
@@ -437,12 +470,13 @@ def trace_paths_wavefront(
             if mesh is not None:
                 profiler.capture(
                     step_key, _mesh_step, *capture_args,
-                    total_bounces=max_bounces, use_tlas=tlas,
+                    total_bounces=max_bounces, use_tlas=tlas, quant=quant,
+                    tlas_block=tlas_block,
                 )
             else:
                 profiler.capture(
                     step_key, _sphere_step, *capture_args,
-                    total_bounces=max_bounces,
+                    total_bounces=max_bounces, quant=quant,
                 )
         tracer.complete(
             "wavefront_bounce", cat="render", start_wall=start_wall,
@@ -489,6 +523,7 @@ def render_frame_wavefront(
     samples: int = 8,
     max_bounces: int = 4,
     use_tlas=None,
+    quant=None,
 ):
     """Render one frame through the wavefront driver; [H, W, 3] linear.
 
@@ -511,7 +546,7 @@ def render_frame_wavefront(
     )
     radiance = trace_paths_wavefront(
         scene, origins, directions, seed, max_bounces=max_bounces, mesh=mesh,
-        use_tlas=use_tlas,
+        use_tlas=use_tlas, quant=quant,
     )
     return _finish_frame(
         radiance, samples=samples, height=height, width=width
@@ -547,6 +582,7 @@ def render_region_wavefront(
     samples: int = 8,
     max_bounces: int = 4,
     use_tlas=None,
+    quant=None,
 ):
     """Render one region of a frame through the wavefront driver.
 
@@ -571,7 +607,7 @@ def render_region_wavefront(
     )
     radiance = trace_paths_wavefront(
         scene, origins, directions, seed, max_bounces=max_bounces,
-        mesh=mesh, rng_lanes=lanes, use_tlas=use_tlas,
+        mesh=mesh, rng_lanes=lanes, use_tlas=use_tlas, quant=quant,
     )
     return _finish_frame(
         radiance, samples=samples, height=tile_height, width=tile_width

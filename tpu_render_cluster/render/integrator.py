@@ -394,7 +394,7 @@ def region_rays_and_seed(
 
 def trace_paths(
     scene: Scene, origins, directions, key, *, max_bounces: int = 4, mesh=None,
-    rng_lanes=None, use_tlas=None,
+    rng_lanes=None, use_tlas=None, quant=None,
 ) -> jnp.ndarray:
     """Trace one sample per ray; returns radiance [R, 3].
 
@@ -449,7 +449,7 @@ def trace_paths(
         if rng_lanes is None and pallas_kernels.mesh_megakernel_eligible(mesh):
             return pallas_kernels.trace_paths_fused_mesh(
                 scene, mesh, origins, directions, seed,
-                max_bounces=max_bounces, use_tlas=use_tlas,
+                max_bounces=max_bounces, use_tlas=use_tlas, quant=quant,
             )
         # Deep scenes: the megakernel's bounce_step as ONE fused launch
         # per bounce (sphere/plane/mesh nearest, NEE with both any-hits,
@@ -474,6 +474,9 @@ def trace_paths(
         rng = lane if rng_lanes is None else jnp.asarray(rng_lanes, jnp.int32)
         tlas = pallas_kernels.use_tlas_for(
             mesh.instances.translation.shape[0], use_tlas
+        )
+        quant = (
+            pallas_kernels.bvh_quant_mode() if quant is None else int(quant)
         )
         keys = None
         if tlas:
@@ -515,7 +518,7 @@ def trace_paths(
                 pallas_kernels.mesh_bounce_pallas(
                     scene, mesh, origins, directions, throughput, alive,
                     seed, bounce, total_bounces=max_bounces,
-                    lane=rng, live_count=live, use_tlas=tlas,
+                    lane=rng, live_count=live, use_tlas=tlas, quant=quant,
                 )
             )
             radiance = radiance + contribution
@@ -543,7 +546,7 @@ def trace_paths(
     jax.jit,
     static_argnames=(
         "width", "height", "tile_height", "tile_width", "samples",
-        "max_bounces", "use_tlas",
+        "max_bounces", "use_tlas", "quant",
     ),
 )
 def render_tile(
@@ -561,6 +564,7 @@ def render_tile(
     max_bounces: int = 4,
     mesh=None,
     use_tlas=None,
+    quant=None,
 ) -> jnp.ndarray:
     """Render a tile; returns [tile_height, tile_width, 3] linear radiance.
 
@@ -603,6 +607,7 @@ def render_tile(
             max_bounces=max_bounces,
             mesh=mesh,
             use_tlas=use_tlas,
+            quant=quant,
         )
         image = radiance.reshape(samples, n, 3).mean(axis=0)
     else:
@@ -648,7 +653,9 @@ def render_frame(
 
     scene = build_scene(scene_name, frame_index)
     camera = scene_camera(scene_name, frame_index)
-    mesh = scene_mesh_set(scene_name, frame_index)
+    # BVH env tiers resolve HERE, outside the jitted tile renders.
+    _tlas, bvh_quant, bvh_builder, bvh_wide = resolve_bvh_config()
+    mesh = scene_mesh_set(scene_name, frame_index, bvh_builder, bvh_wide)
     frame = jnp.asarray(frame_index, jnp.float32)
     if tile_size is None:
         return render_tile(
@@ -664,6 +671,7 @@ def render_frame(
             samples=samples,
             max_bounces=max_bounces,
             mesh=mesh,
+            quant=bvh_quant,
         )
     rows = []
     for y0 in range(0, height, tile_size):
@@ -683,6 +691,7 @@ def render_frame(
                     samples=samples,
                     max_bounces=max_bounces,
                     mesh=mesh,
+                    quant=bvh_quant,
                 )
             )
         rows.append(jnp.concatenate(row, axis=1))
@@ -696,30 +705,39 @@ def tonemap(image: jnp.ndarray) -> jnp.ndarray:
     return (srgb * 255.0 + 0.5).astype(jnp.uint8)
 
 
+def resolve_bvh_config(use_tlas=None, quant=None, builder=None, wide=None):
+    """Resolve the BVH env tiers (``TRC_TLAS``/``TRC_BVH_QUANT``/
+    ``TRC_BVH_BUILDER``/``TRC_BVH_WIDE``) to concrete values — the ONE
+    site the jitted renderer factories resolve them through, OUTSIDE any
+    trace (the ``env-tiers`` lint contract), so a mid-process env toggle
+    resolves to a fresh cache key instead of a stale compiled program or
+    tree."""
+    from tpu_render_cluster.render.mesh import bvh_builder, bvh_wide
+    from tpu_render_cluster.render.pallas_kernels import (
+        bvh_quant_mode,
+        tlas_enabled,
+    )
+
+    return (
+        tlas_enabled() if use_tlas is None else bool(use_tlas),
+        bvh_quant_mode() if quant is None else max(0, min(int(quant), 2)),
+        bvh_builder() if builder is None else str(builder),
+        bvh_wide() if wide is None else max(1, min(int(wide), 8)),
+    )
+
+
 @functools.lru_cache(maxsize=32)
-def fused_frame_renderer(
+def _fused_frame_renderer(
     scene_name: str,
     width: int,
     height: int,
     samples: int,
     max_bounces: int,
-    use_tlas: bool | None = None,
+    use_tlas: bool,
+    quant: int,
+    builder: str,
+    wide: int,
 ):
-    """A jitted ``frame -> uint8 [H, W, 3]`` closure for one scene/config.
-
-    Fuses scene build + camera + path trace + tonemap into a single XLA
-    program, so rendering a frame is ONE device dispatch. The eager
-    alternative (build_scene / scene_camera outside jit, as render_frame
-    does) pays a device round-trip per tiny scene array — tens of
-    dispatches per frame, which dominates wall time when the device sits
-    behind a network tunnel (observed: ~2 s/frame eager vs ~10 ms fused on
-    the same chip).
-
-    ``use_tlas`` (None = env tier, resolved at trace time) is part of
-    the cache key AND the compiled program's identity: the interleaved
-    ``bench.py --bvh-compare`` holds one renderer per variant in the
-    same process.
-    """
     from tpu_render_cluster.render.camera import scene_camera
     from tpu_render_cluster.render.scene import build_scene
 
@@ -729,7 +747,7 @@ def fused_frame_renderer(
 
         scene = build_scene(scene_name, frame)
         camera = scene_camera(scene_name, frame)
-        mesh = scene_mesh_set(scene_name, frame)
+        mesh = scene_mesh_set(scene_name, frame, builder, wide)
         linear = render_tile(
             scene,
             camera,
@@ -744,30 +762,72 @@ def fused_frame_renderer(
             max_bounces=max_bounces,
             mesh=mesh,
             use_tlas=use_tlas,
+            quant=quant,
         )
         return tonemap(linear)
 
     # Roofline profiling (obs/profiling.py): the first call captures the
     # program's XLA cost analysis (FLOPs/bytes) under the masked tier's
     # kernel key; the lru_cache above caches the instrumented wrapper, so
-    # later frames pay one flag check. The tlas dim keys the two kernel
-    # variants to separate roofline rows — the per-kernel placement
-    # delta bench.py --bvh-compare records.
-    from tpu_render_cluster.obs.profiling import get_profiler, kernel_key
-    from tpu_render_cluster.render.pallas_kernels import tlas_enabled
+    # later frames pay one flag check. The tlas/quant/bvh dims key every
+    # node-format variant to its own roofline row — the per-kernel
+    # placement deltas bench.py --bvh-compare records.
+    from tpu_render_cluster.obs.profiling import (
+        bvh_dims,
+        get_profiler,
+        kernel_key,
+    )
 
     return get_profiler().instrument(
         kernel_key(
             "masked", scene_name,
             w=width, h=height, s=samples, b=max_bounces,
-            tlas=int(tlas_enabled() if use_tlas is None else use_tlas),
+            **bvh_dims(tlas=use_tlas, quant=quant, builder=builder,
+                       wide=wide),
         ),
         render,
     )
 
 
+def fused_frame_renderer(
+    scene_name: str,
+    width: int,
+    height: int,
+    samples: int,
+    max_bounces: int,
+    use_tlas: bool | None = None,
+    quant: int | None = None,
+    builder: str | None = None,
+    wide: int | None = None,
+):
+    """A jitted ``frame -> uint8 [H, W, 3]`` closure for one scene/config.
+
+    Fuses scene build + camera + path trace + tonemap into a single XLA
+    program, so rendering a frame is ONE device dispatch. The eager
+    alternative (build_scene / scene_camera outside jit, as render_frame
+    does) pays a device round-trip per tiny scene array — tens of
+    dispatches per frame, which dominates wall time when the device sits
+    behind a network tunnel (observed: ~2 s/frame eager vs ~10 ms fused on
+    the same chip).
+
+    ``use_tlas``/``quant``/``builder``/``wide`` (None = env tiers,
+    resolved HERE — outside the trace) are part of the cache key AND the
+    compiled program's identity: the interleaved ``bench.py
+    --bvh-compare`` holds one renderer per node-format variant in the
+    same process, and an env toggle between calls gets a fresh renderer
+    with a matching tree instead of a stale cache hit.
+    """
+    return _fused_frame_renderer(
+        scene_name, width, height, samples, max_bounces,
+        *resolve_bvh_config(use_tlas, quant, builder, wide),
+    )
+
+
+fused_frame_renderer.cache_clear = _fused_frame_renderer.cache_clear
+
+
 @functools.lru_cache(maxsize=64)
-def fused_region_renderer(
+def _fused_region_renderer(
     scene_name: str,
     width: int,
     height: int,
@@ -775,22 +835,11 @@ def fused_region_renderer(
     tile_width: int,
     samples: int,
     max_bounces: int,
-    use_tlas: bool | None = None,
+    use_tlas: bool,
+    quant: int,
+    builder: str,
+    wide: int,
 ):
-    """A jitted ``(frame, y0, x0) -> [th, tw, 3] LINEAR`` region closure.
-
-    The masked execution tier's cluster-tile path: one compiled program
-    per tile SHAPE (``y0``/``x0`` are traced), so every tile of a grid —
-    and every frame — reuses the same executable. The region traces the
-    full frame's rays-and-RNG restricted to its pixels
-    (``region_rays_and_seed``), so stitching a grid of regions is
-    pixel-identical to the whole-frame render (up to the FP ties of the
-    megakernel-vs-state-io kernel pairing; see ``trace_paths``).
-
-    Returns LINEAR radiance (not tonemapped): callers tonemap after
-    (matching render_frame's contract) so the assembly seam test can
-    compare linear images.
-    """
     from tpu_render_cluster.render.camera import scene_camera
     from tpu_render_cluster.render.scene import build_scene
 
@@ -800,7 +849,7 @@ def fused_region_renderer(
 
         scene = build_scene(scene_name, frame)
         camera = scene_camera(scene_name, frame)
-        mesh = scene_mesh_set(scene_name, frame)
+        mesh = scene_mesh_set(scene_name, frame, builder, wide)
         origins, directions, lanes, seed = region_rays_and_seed(
             camera, jnp.asarray(frame, jnp.float32),
             width=width, height=height, samples=samples,
@@ -814,7 +863,7 @@ def fused_region_renderer(
             radiance = trace_paths(
                 scene, origins, directions, tile_trace_key(base_key),
                 max_bounces=max_bounces, mesh=mesh, rng_lanes=lanes,
-                use_tlas=use_tlas,
+                use_tlas=use_tlas, quant=quant,
             )
         else:
             # XLA fallback: per-lane counters don't exist there, so the
@@ -831,18 +880,59 @@ def fused_region_renderer(
 
     # Roofline profiling: one cost capture per tile SHAPE (matching the
     # one-compile-per-shape contract of this renderer).
-    from tpu_render_cluster.obs.profiling import get_profiler, kernel_key
-    from tpu_render_cluster.render.pallas_kernels import tlas_enabled
+    from tpu_render_cluster.obs.profiling import (
+        bvh_dims,
+        get_profiler,
+        kernel_key,
+    )
 
     return get_profiler().instrument(
         kernel_key(
             "region", scene_name,
             w=width, h=height, th=tile_height, tw=tile_width,
             s=samples, b=max_bounces,
-            tlas=int(tlas_enabled() if use_tlas is None else use_tlas),
+            **bvh_dims(tlas=use_tlas, quant=quant, builder=builder,
+                       wide=wide),
         ),
         render,
     )
+
+
+def fused_region_renderer(
+    scene_name: str,
+    width: int,
+    height: int,
+    tile_height: int,
+    tile_width: int,
+    samples: int,
+    max_bounces: int,
+    use_tlas: bool | None = None,
+    quant: int | None = None,
+    builder: str | None = None,
+    wide: int | None = None,
+):
+    """A jitted ``(frame, y0, x0) -> [th, tw, 3] LINEAR`` region closure.
+
+    The masked execution tier's cluster-tile path: one compiled program
+    per tile SHAPE (``y0``/``x0`` are traced), so every tile of a grid —
+    and every frame — reuses the same executable. The region traces the
+    full frame's rays-and-RNG restricted to its pixels
+    (``region_rays_and_seed``), so stitching a grid of regions is
+    pixel-identical to the whole-frame render (up to the FP ties of the
+    megakernel-vs-state-io kernel pairing; see ``trace_paths``).
+
+    Returns LINEAR radiance (not tonemapped): callers tonemap after
+    (matching render_frame's contract) so the assembly seam test can
+    compare linear images. BVH node-format knobs resolve like
+    ``fused_frame_renderer``'s — outside the trace, into the cache key.
+    """
+    return _fused_region_renderer(
+        scene_name, width, height, tile_height, tile_width, samples,
+        max_bounces, *resolve_bvh_config(use_tlas, quant, builder, wide),
+    )
+
+
+fused_region_renderer.cache_clear = _fused_region_renderer.cache_clear
 
 
 def render_frame_region(

@@ -183,6 +183,56 @@ def use_tlas_for(k_count: int, use_tlas: bool | None = None) -> bool:
     return flag and k_count > tlas_leaf_size()
 
 
+def bvh_quant_mode() -> int:
+    """The ``TRC_BVH_QUANT`` env tier (default 0 = off): quantized node
+    tables + packed carried ray state.
+
+    - 0: fp32 slabs, int32 links, f32 carried state (the exact baseline);
+    - 1: 16-bit fixed-point slabs (two per int32 word) + one packed meta
+      word per node, bf16-packed carried throughput;
+    - 2: 8-bit slabs (six per two words), same meta/state packing.
+
+    Conservative outward rounding keeps every tier's IMAGES bit-identical
+    on the masked tier (the quantized walk visits a superset of nodes;
+    triangle tests stay exact f32 — see mesh.quantize_node_tables);
+    wavefront/raypool additionally carry bf16 throughput, whose
+    divergence budget tests/test_bvhq.py asserts. A static jit arg like
+    ``TRC_TLAS``: read by untraced drivers/factories only (the
+    ``env-tiers`` lint pass pins this) and threaded into every kernel
+    identity, so distinct tiers coexist as distinct compiled programs in
+    one process (the interleaved A/B bench).
+    """
+    return max(0, min(env_int("TRC_BVH_QUANT", 0), 2))
+
+
+def resolve_bvh_quant(quant: int, *tables: tuple[int, int, int]) -> int:
+    """Degrade the quant tier to 0 when any node table outgrows the
+    packed meta word's ranges (``int32 -> int16/byte offsets where index
+    ranges allow`` — ISSUE 15). Each table is (n_nodes, first_units,
+    max_count); all limits are shape-derived, so the decision is static
+    at trace time."""
+    from tpu_render_cluster.render.mesh import (
+        QUANT_MAX_COUNT,
+        QUANT_MAX_FIRST_UNITS,
+        QUANT_MAX_NODES,
+    )
+
+    if not quant:
+        return 0
+    for n_nodes, first_units, max_count in tables:
+        # Skip links range over [0, n_nodes] INCLUSIVE (n_nodes is the
+        # walk terminator), so the node count must stay strictly below
+        # the 16-bit field's modulus or the terminator would wrap to 0
+        # and the threaded walk would never end.
+        if (
+            n_nodes >= QUANT_MAX_NODES
+            or first_units > QUANT_MAX_FIRST_UNITS
+            or max_count > QUANT_MAX_COUNT
+        ):
+            return 0
+    return max(0, min(int(quant), 2))
+
+
 # ---------------------------------------------------------------------------
 # Fused coherence sort key (ISSUE 10): the per-bounce re-sort key is
 # computed in the mesh bounce kernels' EPILOGUE from the post-bounce ray
@@ -305,6 +355,59 @@ def initial_mesh_sort_keys(mesh, origins, directions, alive):
     return mesh_sort_keys(
         origins, directions, alive, key_lo, key_inv,
         candidate=instance_entry_candidates(origins, directions, lo_s, hi_s),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Packed carried ray state (ISSUE 15, quant tiers >= 1): the wavefront
+# driver re-buckets and the ray pool permutes the FULL carried tuple every
+# bounce/iteration — the throughput column is pure shading state with no
+# traversal role, so it rides as bf16 packed two-per-f32-word (12 -> 8
+# carried bytes per lane, one fewer gather column). The pack/unpack pair
+# must be exact inverses; the f32->bf16 round-trip per carry step is the
+# divergence the masked-vs-packed budget in tests/test_bvhq.py bounds.
+
+
+def pack_throughput_bf16(throughput):
+    """[R, 3] f32 -> [R, 2] f32 words carrying 4 bf16 lanes (one pad)."""
+    half = jnp.concatenate(
+        [
+            throughput.astype(jnp.bfloat16),
+            jnp.zeros((throughput.shape[0], 1), jnp.bfloat16),
+        ],
+        axis=1,
+    )
+    return jax.lax.bitcast_convert_type(
+        half.reshape(-1, 2, 2), jnp.float32
+    )
+
+
+def unpack_throughput_bf16(packed):
+    """Inverse of ``pack_throughput_bf16``: [R, 2] f32 -> [R, 3] f32."""
+    half = jax.lax.bitcast_convert_type(packed, jnp.bfloat16)
+    return half.reshape(packed.shape[0], 4)[:, :3].astype(jnp.float32)
+
+
+# Pool meta word (quant tiers >= 1): fid [0:8), bounce [8:16), dead bit 16
+# — one int32 column replacing the pool's separate alive/fid/bounce
+# carried columns (the alive column is DROPPED: it is the meta dead bit).
+POOL_META_DEAD_BIT = 16
+
+
+def pack_pool_meta(fid, bounce, alive):
+    return (
+        fid.astype(jnp.int32)
+        | (bounce.astype(jnp.int32) << 8)
+        | jnp.where(alive, 0, 1 << POOL_META_DEAD_BIT)
+    )
+
+
+def unpack_pool_meta(meta):
+    """(fid, bounce, alive) from the packed pool meta column."""
+    return (
+        meta & 0xFF,
+        (meta >> 8) & 0xFF,
+        (meta >> POOL_META_DEAD_BIT) & 1 == 0,
     )
 
 
@@ -1889,7 +1992,8 @@ def _mesh_trace_kernel_factory(
     max_bounces: int, n_padded: int, n_nodes: int, leaf_size: int,
     k_count: int, state_io: bool = False, pool_io: bool = False,
     k_per_frame: int = 0, use_tlas: bool = False, tlas_nodes: int = 0,
-    tlas_per_frame: int = 0,
+    tlas_per_frame: int = 0, quant: int = 0, ordered: bool = False,
+    tlas_ordered: bool = False,
 ):
     """Mesh path-trace kernel. Three shapes share one bounce_step:
 
@@ -1916,48 +2020,120 @@ def _mesh_trace_kernel_factory(
     contract_first = (((0,), (0,)), ((), ()))
 
     def kernel(*refs):
-        # Fixed-prefix unpacking, then the optional TLAS block (5 SMEM
-        # refs, use_tlas only), the key-bounds scalars + fused sort-key
-        # output (streamed-state TLAS kernels only — flat kernels keep
-        # today's exact signature so the A/B baseline is untouched), and
-        # finally the state outputs.
+        # Fixed-prefix unpacking, then the BLAS node block (fp32: 5 SMEM
+        # refs; quantized: packed bq/meta words + grid scalars), the
+        # optional TLAS node block (same two formats), the key-bounds
+        # scalars + fused sort-key output (streamed-state TLAS kernels
+        # only — flat kernels keep today's signature so the A/B baseline
+        # is untouched), and finally the state outputs.
         refs = list(refs)
+
+        def take(n):
+            out, refs[:n] = tuple(refs[:n]), []
+            return out
+
         if pool_io:
             (live_ref, o_ref, d_ref, thr_ref, alive_ref, lane_ref,
              seed_row_ref, bounce_row_ref, fid_row_ref,
              fid_lo_ref, fid_hi_ref,
              c_ref, r2_ref, csq_ref, rad_ref, albedo_ref, emission_ref,
              dcsun_ref, sfid_ref, params_ref, sunsm_ref, inst_ref,
-             v0_ref, e1_ref, e2_ref, nrm_ref, bmin_ref, bmax_ref,
-             skip_ref, first_ref, count_ref) = refs[:31]
-            rest = refs[31:]
+             v0_ref, e1_ref, e2_ref, nrm_ref) = take(26)
         elif state_io:
             (seed_ref, bounce_ref, live_ref, o_ref, d_ref, thr_ref,
              alive_ref, lane_ref,
              c_ref, r2_ref, csq_ref, rad_ref, albedo_ref, emission_ref,
              dcsun_ref, params_ref, sunsm_ref, inst_ref, v0_ref, e1_ref,
-             e2_ref, nrm_ref, bmin_ref, bmax_ref, skip_ref, first_ref,
-             count_ref) = refs[:27]
-            rest = refs[27:]
+             e2_ref, nrm_ref) = take(22)
         else:
             (seed_ref, o_ref, d_ref, c_ref, r2_ref, csq_ref, rad_ref,
              albedo_ref, emission_ref, dcsun_ref, params_ref, sunsm_ref,
-             inst_ref, v0_ref, e1_ref, e2_ref, nrm_ref, bmin_ref,
-             bmax_ref, skip_ref, first_ref, count_ref) = refs[:22]
-            rest = refs[22:]
+             inst_ref, v0_ref, e1_ref, e2_ref, nrm_ref) = take(17)
+        if quant:
+            (bq_ref, bmeta_ref, bgrid_ref) = take(3)
+        else:
+            (bmin_ref, bmax_ref, skip_ref, first_ref, count_ref) = take(5)
         if use_tlas:
-            (tbmin_ref, tbmax_ref, tskip_ref, tfirst_ref,
-             tcount_ref) = rest[:5]
-            rest = rest[5:]
+            if quant:
+                (tbq_ref, tmeta_ref, tgrid_ref) = take(3)
+            else:
+                (tbmin_ref, tbmax_ref, tskip_ref, tfirst_ref,
+                 tcount_ref) = take(5)
         if (state_io or pool_io) and use_tlas:
-            keysm_ref = rest[0]
+            (keysm_ref,) = take(1)
             (out_ref, o_out_ref, d_out_ref, thr_out_ref, alive_out_ref,
-             key_out_ref) = rest[1:]
+             key_out_ref) = refs
         elif state_io or pool_io:
             (out_ref, o_out_ref, d_out_ref, thr_out_ref,
-             alive_out_ref) = rest
+             alive_out_ref) = refs
         else:
-            (out_ref,) = rest
+            (out_ref,) = refs
+
+        # -- node-table readers -----------------------------------------
+        # ONE reconstruction per format, shared by every walk below. The
+        # quantized form reads 1-2 int32 words per node and reconstructs
+        # slabs as origin + q * cell in f32 — conservatively OUTSIDE the
+        # fp32 box by construction (mesh.quantize_node_tables), so culls
+        # stay exact-superset and results bit-identical. Meta packs
+        # skip | first/unit << 16 | count << 27 into one scalar read.
+
+        def _read_packed_bounds(bqr, gridr, node):
+            if quant == 1:
+                w0, w1, w2 = bqr[node, 0], bqr[node, 1], bqr[node, 2]
+                qlx, qhx = w0 & 0xFFFF, (w0 >> 16) & 0xFFFF
+                qly, qhy = w1 & 0xFFFF, (w1 >> 16) & 0xFFFF
+                qlz, qhz = w2 & 0xFFFF, (w2 >> 16) & 0xFFFF
+            else:
+                w0, w1 = bqr[node, 0], bqr[node, 1]
+                qlx, qly = w0 & 0xFF, (w0 >> 8) & 0xFF
+                qlz, qhx = (w0 >> 16) & 0xFF, (w0 >> 24) & 0xFF
+                qhy, qhz = w1 & 0xFF, (w1 >> 8) & 0xFF
+            gx, gy, gz = gridr[0], gridr[1], gridr[2]
+            cx, cy, cz = gridr[3], gridr[4], gridr[5]
+            return (
+                gx + qlx.astype(jnp.float32) * cx,
+                gy + qly.astype(jnp.float32) * cy,
+                gz + qlz.astype(jnp.float32) * cz,
+                gx + qhx.astype(jnp.float32) * cx,
+                gy + qhy.astype(jnp.float32) * cy,
+                gz + qhz.astype(jnp.float32) * cz,
+            )
+
+        def _read_meta(metar, node, unit):
+            meta = metar[node]
+            return (
+                meta & 0xFFFF,
+                ((meta >> 16) & 0x7FF) * unit,
+                (meta >> 27) & 0x1F,
+            )
+
+        def blas_node(node):
+            """(6 slab scalars, skip, leaf start, leaf count)."""
+            if quant:
+                return (
+                    _read_packed_bounds(bq_ref, bgrid_ref, node),
+                    *_read_meta(bmeta_ref, node, leaf_size),
+                )
+            return (
+                (bmin_ref[node, 0], bmin_ref[node, 1], bmin_ref[node, 2],
+                 bmax_ref[node, 0], bmax_ref[node, 1], bmax_ref[node, 2]),
+                skip_ref[node], first_ref[node], count_ref[node],
+            )
+
+        if use_tlas:
+            def tlas_node(node):
+                if quant:
+                    return (
+                        _read_packed_bounds(tbq_ref, tgrid_ref, node),
+                        *_read_meta(tmeta_ref, node, 1),
+                    )
+                return (
+                    (tbmin_ref[node, 0], tbmin_ref[node, 1],
+                     tbmin_ref[node, 2],
+                     tbmax_ref[node, 0], tbmax_ref[node, 1],
+                     tbmax_ref[node, 2]),
+                    tskip_ref[node], tfirst_ref[node], tcount_ref[node],
+                )
         if use_tlas:
             # THE threaded skip-link walk over TLAS node slabs, shared
             # by the nearest, any-hit, and key-epilogue entry walks
@@ -1967,7 +2143,7 @@ def _mesh_trace_kernel_factory(
             # and the ``leaf_body`` fori callback over a leaf's slot
             # range; ``carry`` is a tuple.
             def tlas_walk(
-                node0, node_end, ox, oy, oz, ix, iy, iz,
+                node0, node_end, tbase, ox, oy, oz, ix, iy, iz,
                 limit_of, leaf_body, carry,
             ):
                 def cond(walk):
@@ -1977,12 +2153,15 @@ def _mesh_trace_kernel_factory(
                     node = walk[0]
                     carry = tuple(walk[1:])
                     limit = limit_of(carry)
-                    lox = (tbmin_ref[node, 0] - ox) * ix
-                    hix = (tbmax_ref[node, 0] - ox) * ix
-                    loy = (tbmin_ref[node, 1] - oy) * iy
-                    hiy = (tbmax_ref[node, 1] - oy) * iy
-                    loz = (tbmin_ref[node, 2] - oz) * iz
-                    hiz = (tbmax_ref[node, 2] - oz) * iz
+                    (nlx, nly, nlz, nhx, nhy, nhz), nskip, start, cnt = (
+                        tlas_node(tbase + node)
+                    )
+                    lox = (nlx - ox) * ix
+                    hix = (nhx - ox) * ix
+                    loy = (nly - oy) * iy
+                    hiy = (nhy - oy) * iy
+                    loz = (nlz - oz) * iz
+                    hiz = (nhz - oz) * iz
                     tnear = jnp.maximum(
                         jnp.maximum(
                             jnp.minimum(lox, hix), jnp.minimum(loy, hiy)
@@ -1999,13 +2178,11 @@ def _mesh_trace_kernel_factory(
                         tfar >= jnp.maximum(tnear, 0.0)
                     ) & (tnear < limit)
                     hit_any = jnp.any(packet_hit)
-                    cnt = tcount_ref[node]
                     is_leaf = cnt > 0
-                    start = tfirst_ref[node]
                     next_node = jnp.where(
                         hit_any,
-                        jnp.where(is_leaf, tskip_ref[node], node + 1),
-                        tskip_ref[node],
+                        jnp.where(is_leaf, nskip, node + 1),
+                        nskip,
                     )
                     carry = jax.lax.cond(
                         is_leaf & hit_any,
@@ -2083,7 +2260,44 @@ def _mesh_trace_kernel_factory(
             small = jnp.abs(v) < 1e-12
             return 1.0 / jnp.where(small, jnp.where(v < 0, -1e-12, 1e-12), v)
 
-        def walk_step(node, ox, oy, oz, dx, dy, dz, invx, invy, invz, limit):
+        def _octant_of(dx, dy, dz):
+            # Majority vote over the packet's lanes (scalar dirs reduce
+            # over one element): any octant's table is exact; matching
+            # just shrinks best-t sooner.
+            def bit(v, shift):
+                positive = jnp.sum(jnp.where(v > 0.0, 1.0, 0.0))
+                return jnp.where(
+                    positive * 2.0 > float(jnp.size(v)),
+                    jnp.int32(1 << shift),
+                    jnp.int32(0),
+                )
+
+            return bit(dx, 0) | bit(dy, 1) | bit(dz, 2)
+
+        if ordered:
+            # Octant-ordered tables (sah builds): the BLAS node block is
+            # EIGHT re-threadings stacked [8N]; each walk picks the table
+            # whose near-first child order matches its (object-space)
+            # direction octant.
+            def blas_base(dx, dy, dz):
+                return _octant_of(dx, dy, dz) * jnp.int32(n_nodes)
+        else:
+            def blas_base(dx, dy, dz):
+                return jnp.int32(0)
+
+        if tlas_ordered:
+            # Same trick one level up: the TLAS node block is stacked
+            # [8M] with the axis-by-depth near-first orders
+            # (mesh.TlasTopology.octant_*); world-space direction octant
+            # picks the table.
+            def tlas_base(dx, dy, dz):
+                return _octant_of(dx, dy, dz) * jnp.int32(tlas_nodes)
+        else:
+            def tlas_base(dx, dy, dz):
+                return jnp.int32(0)
+
+        def walk_step(node, obase, ox, oy, oz, dx, dy, dz, invx, invy,
+                      invz, limit):
             """One threaded-BVH step shared by BOTH in-kernel walks.
 
             Slab-tests the node and advances the skip-link cursor. The
@@ -2092,14 +2306,20 @@ def _mesh_trace_kernel_factory(
             (``do_leaf`` = is_leaf & hit_any — the whole block walks the
             same node, so the predicate is scalar): internal nodes and
             culled subtrees skip the walk's dominant vector work entirely.
-            Returns (next_node, leaf start, leaf count, do_leaf).
+            ``obase`` is the walk's octant-table row offset (0 when the
+            build ships a single canonical order); skip links are local,
+            so only the reads offset. Returns (next_node, leaf start,
+            leaf count, do_leaf).
             """
-            lox = (bmin_ref[node, 0] - ox) * invx
-            hix = (bmax_ref[node, 0] - ox) * invx
-            loy = (bmin_ref[node, 1] - oy) * invy
-            hiy = (bmax_ref[node, 1] - oy) * invy
-            loz = (bmin_ref[node, 2] - oz) * invz
-            hiz = (bmax_ref[node, 2] - oz) * invz
+            (nlx, nly, nlz, nhx, nhy, nhz), nskip, start, count = (
+                blas_node(obase + node)
+            )
+            lox = (nlx - ox) * invx
+            hix = (nhx - ox) * invx
+            loy = (nly - oy) * invy
+            hiy = (nhy - oy) * invy
+            loz = (nlz - oz) * invz
+            hiz = (nhz - oz) * invz
             tnear = jnp.maximum(
                 jnp.maximum(jnp.minimum(lox, hix), jnp.minimum(loy, hiy)),
                 jnp.minimum(loz, hiz),
@@ -2110,13 +2330,11 @@ def _mesh_trace_kernel_factory(
             )
             packet_hit = (tfar >= jnp.maximum(tnear, 0.0)) & (tnear < limit)
             hit_any = jnp.any(packet_hit)
-            count = count_ref[node]
             is_leaf = count > 0
-            start = first_ref[node]
             next_node = jnp.where(
                 hit_any,
-                jnp.where(is_leaf, skip_ref[node], node + 1),
-                skip_ref[node],
+                jnp.where(is_leaf, nskip, node + 1),
+                nskip,
             )
             return next_node, start, count, is_leaf & hit_any
 
@@ -2201,7 +2419,17 @@ def _mesh_trace_kernel_factory(
                 # instance's walk (slab limit -INF, like dead lanes) and
                 # barred from the best-t update.
                 match = (fid_row == inst_ref[k, 22]) if pool_io else None
-                best_t, bnx, bny, bnz, bar, bag, bab = carry
+                best_t, bnx, bny, bnz, bar, bag, bab, bslot = carry
+                # The winning instance's SLOT label (within-frame in pool
+                # mode): the quant tiers' packed-key candidate — a lane
+                # that hit instance X bounces off X's surface, so X IS
+                # the next ray's nearest-entry overlapped instance.
+                if pool_io:
+                    slot_of_k = k.astype(jnp.float32) - fid_row * jnp.float32(
+                        k_per_frame
+                    )
+                else:
+                    slot_of_k = k.astype(jnp.float32)
                 r00, r01, r02 = inst_ref[k, 0], inst_ref[k, 1], inst_ref[k, 2]
                 r10, r11, r12 = inst_ref[k, 3], inst_ref[k, 4], inst_ref[k, 5]
                 r20, r21, r22 = inst_ref[k, 6], inst_ref[k, 7], inst_ref[k, 8]
@@ -2224,19 +2452,21 @@ def _mesh_trace_kernel_factory(
                 dy = (wdx * r01 + wdy * r11 + wdz * r21) * inv_s
                 dz = (wdx * r02 + wdy * r12 + wdz * r22) * inv_s
                 invx, invy, invz = winv(dx), winv(dy), winv(dz)
+                obase = blas_base(dx, dy, dz)
 
                 def cond(walk):
                     return walk[0] < n_nodes
 
                 def body(walk):
-                    node, best_t, bnx, bny, bnz, bar_, bag_, bab_ = walk
+                    (node, best_t, bnx, bny, bnz, bar_, bag_, bab_,
+                     bslot_) = walk
                     walk_limit = (
                         jnp.where(match, best_t, -INF)
                         if match is not None else best_t
                     )
                     next_node, start, count, do_leaf = walk_step(
-                        node, ox, oy, oz, dx, dy, dz, invx, invy, invz,
-                        walk_limit,
+                        node, obase, ox, oy, oz, dx, dy, dz, invx, invy,
+                        invz, walk_limit,
                     )
 
                     def leaf_pass():
@@ -2291,17 +2521,26 @@ def _mesh_trace_kernel_factory(
                     bar_ = jnp.where(closer, ar, bar_)
                     bag_ = jnp.where(closer, ag, bag_)
                     bab_ = jnp.where(closer, ab, bab_)
+                    bslot_ = jnp.where(closer, slot_of_k, bslot_)
                     return (
-                        next_node, best_t, bnx, bny, bnz, bar_, bag_, bab_
+                        next_node, best_t, bnx, bny, bnz, bar_, bag_, bab_,
+                        bslot_,
                     )
 
-                node0 = jnp.where(touch, jnp.int32(0), jnp.int32(n_nodes))
+                enter = 1 if (ordered and n_nodes > 1) else 0
+                node0 = jnp.where(
+                    touch, jnp.int32(enter), jnp.int32(n_nodes)
+                )
                 walked = jax.lax.while_loop(
                     cond, body,
-                    (node0, best_t, bnx, bny, bnz, bar, bag, bab),
+                    (node0, best_t, bnx, bny, bnz, bar, bag, bab, bslot),
                 )
                 return walked[1:]
 
+            # Slot sentinel = "no mesh hit": matches the entry walk's
+            # no-overlap sentinel, and stays put for dead lanes (their
+            # -INF seed admits no update).
+            slot_sentinel = jnp.float32(k_per_frame if pool_io else k_count)
             init = (
                 seed_t,
                 jnp.zeros((1, block), jnp.float32),
@@ -2310,6 +2549,7 @@ def _mesh_trace_kernel_factory(
                 jnp.zeros((1, block), jnp.float32),
                 jnp.zeros((1, block), jnp.float32),
                 jnp.zeros((1, block), jnp.float32),
+                jnp.full((1, block), slot_sentinel, jnp.float32),
             )
             if use_tlas:
                 # Two-level walk: threaded skip-link TLAS over instance
@@ -2329,7 +2569,8 @@ def _mesh_trace_kernel_factory(
                         else (lambda c: c[0])
                     )
                     return tlas_walk(
-                        node0, node_end, wox, woy, woz, wix, wiy, wiz,
+                        node0, node_end, tlas_base(wdx, wdy, wdz),
+                        wox, woy, woz, wix, wiy, wiz,
                         limit_of, per_instance, carry,
                     )
 
@@ -2349,9 +2590,10 @@ def _mesh_trace_kernel_factory(
                     walked = tlas_walk_nearest(
                         jnp.int32(0), jnp.int32(tlas_nodes), None, init
                     )
-                best_t, bnx, bny, bnz, bar, bag, bab = walked
+                best_t, bnx, bny, bnz, bar, bag, bab, bslot = walked
             else:
-                best_t, bnx, bny, bnz, bar, bag, bab = jax.lax.fori_loop(
+                (best_t, bnx, bny, bnz, bar, bag, bab,
+                 bslot) = jax.lax.fori_loop(
                     k_sweep_lo if pool_io else 0,
                     k_sweep_hi if pool_io else k_count,
                     per_instance, init,
@@ -2361,7 +2603,10 @@ def _mesh_trace_kernel_factory(
                 bnx * d[0:1, :] + bny * d[1:2, :] + bnz * d[2:3, :]
             ) < 0.0
             sign = jnp.where(facing, 1.0, -1.0)
-            return best_t, (bnx * sign, bny * sign, bnz * sign), (bar, bag, bab)
+            return (
+                best_t, (bnx * sign, bny * sign, bnz * sign),
+                (bar, bag, bab), bslot,
+            )
 
         def mesh_occluded(o, occluded0):
             """Any-hit toward the (uniform) sun for shadow origins ``o``.
@@ -2416,6 +2661,7 @@ def _mesh_trace_kernel_factory(
                 dy = (sunx * r01 + suny * r11 + sunz * r21) * inv_s
                 dz = (sunx * r02 + suny * r12 + sunz * r22) * inv_s
                 invx, invy, invz = winv(dx), winv(dy), winv(dz)
+                obase = blas_base(dx, dy, dz)
 
                 def cond(walk):
                     return walk[0] < n_nodes
@@ -2430,8 +2676,8 @@ def _mesh_trace_kernel_factory(
                     )
                     limit = jnp.where(walk_blocked > 0.0, -INF, INF)
                     next_node, start, count, do_leaf = walk_step(
-                        node, ox, oy, oz, dx, dy, dz, invx, invy, invz,
-                        limit,
+                        node, obase, ox, oy, oz, dx, dy, dz, invx, invy,
+                        invz, limit,
                     )
                     occ_add = jax.lax.cond(
                         do_leaf,
@@ -2453,7 +2699,10 @@ def _mesh_trace_kernel_factory(
                     occluded = jnp.maximum(occluded, occ_add)
                     return next_node, occluded
 
-                node0 = jnp.where(touch, jnp.int32(0), jnp.int32(n_nodes))
+                enter = 1 if (ordered and n_nodes > 1) else 0
+                node0 = jnp.where(
+                    touch, jnp.int32(enter), jnp.int32(n_nodes)
+                )
                 _, walked_occluded = jax.lax.while_loop(
                     cond, body, (node0, occluded)
                 )
@@ -2473,7 +2722,8 @@ def _mesh_trace_kernel_factory(
                         return jnp.where(blocked > 0.0, -INF, INF)
 
                     return tlas_walk(
-                        node0, node_end, wox, woy, woz, wix, wiy, wiz,
+                        node0, node_end, tlas_base(sunx, suny, sunz),
+                        wox, woy, woz, wix, wiy, wiz,
                         limit_of,
                         lambda k, c: (per_instance(k, c[0]),),
                         (occ0,),
@@ -2553,8 +2803,8 @@ def _mesh_trace_kernel_factory(
             # lanes stays finite and alive-masked).
             t_sp = jnp.minimum(t_sphere, t_plane)
             seed_t = jnp.where(alive > 0.5, t_sp, -INF)
-            t_mesh, (mnx, mny, mnz), (mar, mag, mab) = mesh_nearest(
-                o, d, seed_t
+            t_mesh, (mnx, mny, mnz), (mar, mag, mab), hit_slot = (
+                mesh_nearest(o, d, seed_t)
             )
 
             is_plane = ((t_plane < t_sphere) & (t_mesh >= t_sp)).astype(
@@ -2687,7 +2937,7 @@ def _mesh_trace_kernel_factory(
             live = alive > 0.5
             o = jnp.where(live, new_o, o)
             d = jnp.where(live, new_d, d)
-            return (o, d, throughput, radiance, alive)
+            return (o, d, throughput, radiance, alive, hit_slot)
 
         if state_io or pool_io:
             # ONE bounce with streamed state: overwrite the in-kernel
@@ -2705,12 +2955,16 @@ def _mesh_trace_kernel_factory(
                 bounce_row_ref[:, :] if pool_io else bounce_ref[0, 0]
             )
             block_start = pl.program_id(0) * block
-            o, d, throughput, radiance, alive = jax.lax.cond(
+            slot_sentinel = jnp.float32(k_per_frame if pool_io else k_count)
+            o, d, throughput, radiance, alive, hit_slot = jax.lax.cond(
                 block_start < live_ref[0, 0],
                 lambda: bounce_step(
                     bounce_index, (o, d, throughput, radiance, alive)
                 ),
-                lambda: (o, d, throughput, radiance, alive),
+                lambda: (
+                    o, d, throughput, radiance, alive,
+                    jnp.full((1, block), slot_sentinel, jnp.float32),
+                ),
             )
             out_ref[:, :] = radiance
             o_out_ref[:, :] = o
@@ -2773,17 +3027,25 @@ def _mesh_trace_kernel_factory(
 
                     return leaf_step
 
+                sentinel = jnp.float32(k_per_frame if pool_io else k_count)
+                # Packed-key tier: mesh-hit lanes already carry their
+                # candidate (the nearest walk's winning slot), so they
+                # stop driving the entry walk's packet descents.
+                entry_lane = (
+                    live_lane & (hit_slot >= sentinel) if quant
+                    else live_lane
+                )
+
                 def entry_walk(node0, node_end, slot_offset, match, carry):
                     drive = (
-                        live_lane if match is None else live_lane & match
+                        entry_lane if match is None else entry_lane & match
                     )
                     return tlas_walk(
-                        node0, node_end, eox, eoy, eoz, eix, eiy, eiz,
+                        node0, node_end, tlas_base(edx, edy, edz),
+                        eox, eoy, eoz, eix, eiy, eiz,
                         lambda c: jnp.where(drive, c[0], -INF),
                         entry_leaf(slot_offset), carry,
                     )
-
-                sentinel = jnp.float32(k_per_frame if pool_io else k_count)
                 entry_init = (
                     jnp.full((1, block), INF, jnp.float32),
                     jnp.full((1, block), sentinel, jnp.float32),
@@ -2824,6 +3086,20 @@ def _mesh_trace_kernel_factory(
                     run_entry_walk,
                     lambda: entry_init,
                 )
+                if quant:
+                    # Packed-key tier: lanes that HIT an instance take
+                    # the nearest walk's winning slot as their candidate
+                    # — a lane that hit X bounces off X's surface, so X
+                    # is the new ray's nearest-entry overlap to first
+                    # order — and STOP DRIVING the entry walk (see
+                    # entry_drive below): packets dominated by mesh hits
+                    # prune most of the second TLAS walk while plane/
+                    # sphere-bounce lanes keep their exact candidates.
+                    # Keys only order lanes, so per-lane results stay
+                    # exact either way.
+                    best_slot = jnp.where(
+                        hit_slot < sentinel, hit_slot, best_slot
+                    )
                 key = coherence_key_u32(
                     o[0:1, :] + d[0:1, :],
                     o[1:2, :] + d[1:2, :],
@@ -2838,8 +3114,12 @@ def _mesh_trace_kernel_factory(
                 )
                 key_out_ref[:, :] = key.astype(jnp.int32)
         else:
+            # bounce_step also returns the hit-instance slot (the
+            # streamed-state kernels' packed-key candidate); the
+            # megakernel's loop carry drops it.
             _, _, _, radiance, _ = jax.lax.fori_loop(
-                0, max_bounces, bounce_step,
+                0, max_bounces,
+                lambda b, carry: bounce_step(b, carry)[:5],
                 (o, d, throughput, radiance, alive),
             )
             out_ref[:, :] = radiance
@@ -2847,9 +3127,80 @@ def _mesh_trace_kernel_factory(
     return kernel
 
 
+def _tlas_node_arrays(topology, node_lo, node_hi, ordered: bool):
+    """TLAS node-table arrays: the canonical single order, or the eight
+    axis-by-depth near-first re-threadings (bounds gathered through the
+    static octant_perm) when the walk is octant-ordered."""
+    if not ordered:
+        return (
+            node_lo, node_hi, topology.skip, topology.first,
+            topology.count,
+        )
+    perm = jnp.asarray(topology.octant_perm)
+    return (
+        node_lo[perm], node_hi[perm], topology.octant_skip,
+        topology.octant_first, topology.octant_count,
+    )
+
+
+def _blas_node_arrays(bounds_min, bounds_max, skip, first, count, octant):
+    """(lo, hi, skip, first, count, ordered) for the BLAS node block: the
+    octant-stacked near-first tables when the build ships them
+    (mesh.OctantTables — sah builds), else the canonical single order.
+    ``ordered`` is static (None-ness of the pytree), so each case is its
+    own compiled kernel."""
+    if octant is None:
+        return bounds_min, bounds_max, skip, first, count, False
+    return (
+        octant.bounds_min, octant.bounds_max, octant.skip, octant.first,
+        octant.count, True,
+    )
+
+
+def _node_table_operands(lo, hi, skip, first, count, *, quant: int,
+                         first_unit: int):
+    """(operands, specs) for one node-table block in either format.
+
+    The ONE packing site all three mesh drivers share: fp32 mode ships
+    the five classic SMEM refs; quantized mode ships the packed
+    bq/meta/grid triple from ``mesh.quantize_node_tables`` (static BLAS
+    tables constant-fold under jit; traced TLAS bounds quantize as cheap
+    per-frame arithmetic).
+    """
+    whole = lambda i: (0, 0)  # noqa: E731
+    flat = lambda i: (0,)  # noqa: E731
+    if quant:
+        from tpu_render_cluster.render.mesh import quantize_node_tables
+
+        bq, meta, grid = quantize_node_tables(
+            lo, hi, skip, first, count, quant=quant, first_unit=first_unit
+        )
+        return (bq, meta, grid), [
+            pl.BlockSpec(bq.shape, whole, memory_space=pltpu.SMEM),
+            pl.BlockSpec(meta.shape, flat, memory_space=pltpu.SMEM),
+            pl.BlockSpec((6,), flat, memory_space=pltpu.SMEM),
+        ]
+    lo = jnp.asarray(lo, jnp.float32)
+    hi = jnp.asarray(hi, jnp.float32)
+    skip = jnp.asarray(skip, jnp.int32)
+    first = jnp.asarray(first, jnp.int32)
+    count = jnp.asarray(count, jnp.int32)
+    n = skip.shape[0]
+    return (lo, hi, skip, first, count), [
+        pl.BlockSpec(lo.shape, whole, memory_space=pltpu.SMEM),
+        pl.BlockSpec(hi.shape, whole, memory_space=pltpu.SMEM),
+        pl.BlockSpec((n,), flat, memory_space=pltpu.SMEM),
+        pl.BlockSpec((n,), flat, memory_space=pltpu.SMEM),
+        pl.BlockSpec((n,), flat, memory_space=pltpu.SMEM),
+    ]
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("max_bounces", "interpret", "use_tlas", "tlas_leaf"),
+    static_argnames=(
+        "max_bounces", "interpret", "use_tlas", "tlas_leaf", "tlas_block",
+        "quant",
+    ),
 )
 def _trace_fused_mesh(
     origins, directions, centers, radii, albedo, emission,
@@ -2857,16 +3208,19 @@ def _trace_fused_mesh(
     plane_albedo_a, plane_albedo_b, seed,
     rotation, translation, scale, inst_albedo,
     v0, e1, e2, normal, bounds_min, bounds_max, skip, first, count,
+    octant=None,
     *, max_bounces: int, interpret: bool, use_tlas: bool = False,
-    tlas_leaf: int = 4,
+    tlas_leaf: int = 4, tlas_block: int = 256, quant: int = 0,
 ):
     from tpu_render_cluster.render.mesh import LEAF_SIZE
 
     # Pad lanes must provably MISS (far origin, perpendicular unit dir):
     # zero-padded directions would degenerate the slab tests and strip the
     # packet culling from the final block (see _pad_rays_to_miss). The
-    # TLAS variant blocks rays at its own (narrower) packet width.
-    block = tlas_block_r() if use_tlas else BVH_BLOCK_R
+    # TLAS variant blocks rays at its own (narrower) packet width —
+    # threaded in as a static arg (env tiers are read OUTSIDE traced
+    # functions; the env-tiers lint pass pins this).
+    block = tlas_block if use_tlas else BVH_BLOCK_R
     o_t, d_t, rays, padded_rays = _pad_rays_to_miss(
         origins, directions, block
     )
@@ -2920,37 +3274,42 @@ def _trace_fused_mesh(
         node_lo, node_hi = tlas_node_bounds(
             topology, lo_w[order], hi_w[order]
         )
-        tlas_operands = (
-            node_lo, node_hi, jnp.asarray(topology.skip),
-            jnp.asarray(topology.first), jnp.asarray(topology.count),
-        )
         tlas_nodes = int(topology.skip.shape[0])
+        quant = resolve_bvh_quant(
+            quant,
+            (n_nodes, v0.shape[0] // LEAF_SIZE, LEAF_SIZE),
+            (tlas_nodes, k_count, tlas_leaf),
+        )
+        tlas_operands, tlas_specs = _node_table_operands(
+            *_tlas_node_arrays(topology, node_lo, node_hi, octant is not None),
+            quant=quant, first_unit=1,
+        )
     else:
         inst_table = _instance_table(
             rotation, translation, scale, bounds_min, bounds_max,
             inst_albedo,
         )
-        tlas_operands = ()
+        quant = resolve_bvh_quant(
+            quant, (n_nodes, v0.shape[0] // LEAF_SIZE, LEAF_SIZE)
+        )
+        tlas_operands, tlas_specs = (), []
         tlas_nodes = 0
+    blas_arrays = _blas_node_arrays(
+        bounds_min, bounds_max, skip, first, count, octant
+    )
+    ordered = blas_arrays[5]
+    blas_operands, blas_specs = _node_table_operands(
+        *blas_arrays[:5], quant=quant, first_unit=LEAF_SIZE,
+    )
 
     grid = (padded_rays // block,)
     whole = lambda i: (0, 0)  # noqa: E731
     flat = lambda i: (0,)  # noqa: E731
-    tlas_specs = (
-        [
-            pl.BlockSpec((tlas_nodes, 3), whole, memory_space=pltpu.SMEM),
-            pl.BlockSpec((tlas_nodes, 3), whole, memory_space=pltpu.SMEM),
-            pl.BlockSpec((tlas_nodes,), flat, memory_space=pltpu.SMEM),
-            pl.BlockSpec((tlas_nodes,), flat, memory_space=pltpu.SMEM),
-            pl.BlockSpec((tlas_nodes,), flat, memory_space=pltpu.SMEM),
-        ]
-        if use_tlas
-        else []
-    )
     out = pl.pallas_call(
         _mesh_trace_kernel_factory(
             max_bounces, padded_n, n_nodes, LEAF_SIZE, k_count,
-            use_tlas=use_tlas, tlas_nodes=tlas_nodes,
+            use_tlas=use_tlas, tlas_nodes=tlas_nodes, quant=quant,
+            ordered=ordered, tlas_ordered=use_tlas and ordered,
         ),
         grid=grid,
         in_specs=[
@@ -2971,20 +3330,15 @@ def _trace_fused_mesh(
             pl.BlockSpec(e1.shape, whole, memory_space=pltpu.VMEM),
             pl.BlockSpec(e2.shape, whole, memory_space=pltpu.VMEM),
             pl.BlockSpec(normal.shape, whole, memory_space=pltpu.VMEM),
-            pl.BlockSpec(bounds_min.shape, whole, memory_space=pltpu.SMEM),
-            pl.BlockSpec(bounds_max.shape, whole, memory_space=pltpu.SMEM),
-            pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
-            pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
-            pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
-        ] + tlas_specs,
+        ] + blas_specs + tlas_specs,
         out_specs=[
             pl.BlockSpec((3, block), lambda i: (0, i), memory_space=pltpu.VMEM),
         ],
         out_shape=[jax.ShapeDtypeStruct((3, padded_rays), jnp.float32)],
         interpret=interpret,
     )(seed_arr, o_t, d_t, c_t, r2, csq, rad, albedo_t, emission_t, dc_sun,
-      params, sun_direction, inst_table, v0, e1, e2, normal, bounds_min,
-      bounds_max, skip, first, count, *tlas_operands)[0]
+      params, sun_direction, inst_table, v0, e1, e2, normal,
+      *blas_operands, *tlas_operands)[0]
     return out.T[:rays]
 
 
@@ -2995,14 +3349,15 @@ def _mesh_bounce_io(
     plane_albedo_a, plane_albedo_b,
     rotation, translation, scale, inst_albedo,
     v0, e1, e2, normal, bounds_min, bounds_max, skip, first, count,
+    octant=None,
     *, total_bounces: int, interpret: bool, use_tlas: bool = False,
-    tlas_leaf: int = 4,
+    tlas_leaf: int = 4, tlas_block: int = 256, quant: int = 0,
 ):
     from tpu_render_cluster.render.mesh import LEAF_SIZE
 
-    # The TLAS variant blocks rays at its own narrower packet width
-    # (tlas_block_r) — pruning lives at block granularity.
-    block = tlas_block_r() if use_tlas else BVH_BLOCK_R
+    # The TLAS variant blocks rays at its own narrower packet width —
+    # threaded in by the caller (env tiers resolve outside traces).
+    block = tlas_block if use_tlas else BVH_BLOCK_R
     o_t, d_t, rays, padded_rays = _pad_rays_to_miss(
         origins, directions, block
     )
@@ -3065,12 +3420,19 @@ def _mesh_bounce_io(
             topology, lo_w[order], hi_w[order]
         )
         key_lo, key_inv = mesh_key_bounds(lo_w, hi_w)
-        extra_operands = (
-            node_lo, node_hi, jnp.asarray(topology.skip),
-            jnp.asarray(topology.first), jnp.asarray(topology.count),
-            jnp.concatenate([key_lo, key_inv]),
-        )
         tlas_nodes = int(topology.skip.shape[0])
+        quant = resolve_bvh_quant(
+            quant,
+            (n_nodes, v0.shape[0] // LEAF_SIZE, LEAF_SIZE),
+            (tlas_nodes, k_count, tlas_leaf),
+        )
+        tlas_operands, tlas_specs = _node_table_operands(
+            *_tlas_node_arrays(topology, node_lo, node_hi, octant is not None),
+            quant=quant, first_unit=1,
+        )
+        extra_operands = (
+            *tlas_operands, jnp.concatenate([key_lo, key_inv]),
+        )
     else:
         # Front-to-back instance order (pure data reordering — normals/
         # albedo are tracked in-kernel, so results are order-invariant):
@@ -3089,8 +3451,19 @@ def _mesh_bounce_io(
             scale[near_first],
             bounds_min, bounds_max, inst_albedo[near_first],
         )
+        quant = resolve_bvh_quant(
+            quant, (n_nodes, v0.shape[0] // LEAF_SIZE, LEAF_SIZE)
+        )
+        tlas_specs = []
         extra_operands = ()
         tlas_nodes = 0
+    blas_arrays = _blas_node_arrays(
+        bounds_min, bounds_max, skip, first, count, octant
+    )
+    ordered = blas_arrays[5]
+    blas_operands, blas_specs = _node_table_operands(
+        *blas_arrays[:5], quant=quant, first_unit=LEAF_SIZE,
+    )
 
     grid = (padded_rays // block,)
     whole = lambda i: (0, 0)  # noqa: E731
@@ -3102,14 +3475,7 @@ def _mesh_bounce_io(
         (1, block), lambda i: (0, i), memory_space=pltpu.VMEM
     )
     extra_specs = (
-        [
-            pl.BlockSpec((tlas_nodes, 3), whole, memory_space=pltpu.SMEM),
-            pl.BlockSpec((tlas_nodes, 3), whole, memory_space=pltpu.SMEM),
-            pl.BlockSpec((tlas_nodes,), flat, memory_space=pltpu.SMEM),
-            pl.BlockSpec((tlas_nodes,), flat, memory_space=pltpu.SMEM),
-            pl.BlockSpec((tlas_nodes,), flat, memory_space=pltpu.SMEM),
-            pl.BlockSpec((6,), flat, memory_space=pltpu.SMEM),
-        ]
+        tlas_specs + [pl.BlockSpec((6,), flat, memory_space=pltpu.SMEM)]
         if use_tlas
         else []
     )
@@ -3122,6 +3488,8 @@ def _mesh_bounce_io(
         _mesh_trace_kernel_factory(
             total_bounces, padded_n, n_nodes, LEAF_SIZE, k_count,
             state_io=True, use_tlas=use_tlas, tlas_nodes=tlas_nodes,
+            quant=quant, ordered=ordered,
+            tlas_ordered=use_tlas and ordered,
         ),
         grid=grid,
         in_specs=[
@@ -3147,12 +3515,7 @@ def _mesh_bounce_io(
             pl.BlockSpec(e1.shape, whole, memory_space=pltpu.VMEM),
             pl.BlockSpec(e2.shape, whole, memory_space=pltpu.VMEM),
             pl.BlockSpec(normal.shape, whole, memory_space=pltpu.VMEM),
-            pl.BlockSpec(bounds_min.shape, whole, memory_space=pltpu.SMEM),
-            pl.BlockSpec(bounds_max.shape, whole, memory_space=pltpu.SMEM),
-            pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
-            pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
-            pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
-        ] + extra_specs,
+        ] + blas_specs + extra_specs,
         out_specs=[ray_block, ray_block, ray_block, ray_block, row_block]
         + key_out_specs,
         out_shape=[
@@ -3166,7 +3529,7 @@ def _mesh_bounce_io(
     )(seed_arr, bounce_arr, live_arr, o_t, d_t, thr_t, alive_t, lane_t,
       c_t, r2, csq, rad,
       albedo_t, emission_t, dc_sun, params, sun_direction, inst_table,
-      v0, e1, e2, normal, bounds_min, bounds_max, skip, first, count,
+      v0, e1, e2, normal, *blas_operands,
       *extra_operands)
     contrib, o2, d2, thr2, alive2 = results[:5]
     key2 = results[5][0, :rays] if use_tlas else None
@@ -3183,6 +3546,7 @@ def _mesh_bounce_io(
 def mesh_bounce_pallas(
     scene, mesh, origins, directions, throughput, alive, seed, bounce,
     *, total_bounces: int, lane=None, live_count=None, use_tlas=None,
+    quant: int | None = None, tlas_block: int | None = None,
 ):
     """One fused path-trace bounce for deep-walk mesh scenes.
 
@@ -3219,20 +3583,24 @@ def mesh_bounce_pallas(
         instances.albedo,
         bvh.v0, bvh.e1, bvh.e2, bvh.normal,
         bvh.bounds_min, bvh.bounds_max, bvh.skip, bvh.first, bvh.count,
+        bvh.octant,
         total_bounces=total_bounces, interpret=_interpret(),
         use_tlas=use_tlas_for(instances.translation.shape[0], use_tlas),
         tlas_leaf=tlas_leaf_size(),
+        tlas_block=tlas_block_r() if tlas_block is None else int(tlas_block),
+        quant=bvh_quant_mode() if quant is None else int(quant),
     )
 
 
 def trace_paths_fused_mesh(
     scene, mesh, origins, directions, seed, *, max_bounces: int,
-    use_tlas=None,
+    use_tlas=None, quant: int | None = None,
 ):
     """Fused megakernel path trace for mesh scenes; drop-in for
     integrator.trace_paths with a MeshSet. Same physics as the XLA bounce
     scan + per-pass kernels; different (in-kernel counter PCG) RNG stream.
-    ``use_tlas`` (None = env tier) selects the two-level kernel variant.
+    ``use_tlas`` (None = env tier) selects the two-level kernel variant;
+    ``quant`` (None = the ``TRC_BVH_QUANT`` tier) the node format.
     """
     bvh = mesh.bvh
     instances = mesh.instances
@@ -3246,9 +3614,11 @@ def trace_paths_fused_mesh(
         instances.albedo,
         bvh.v0, bvh.e1, bvh.e2, bvh.normal,
         bvh.bounds_min, bvh.bounds_max, bvh.skip, bvh.first, bvh.count,
+        bvh.octant,
         max_bounces=max_bounces, interpret=_interpret(),
         use_tlas=use_tlas_for(instances.translation.shape[0], use_tlas),
-        tlas_leaf=tlas_leaf_size(),
+        tlas_leaf=tlas_leaf_size(), tlas_block=tlas_block_r(),
+        quant=bvh_quant_mode() if quant is None else int(quant),
     )
 
 
@@ -3398,6 +3768,7 @@ class PoolMeshOperands(NamedTuple):
     skip: jnp.ndarray
     first: jnp.ndarray
     count: jnp.ndarray
+    octant: object = None  # mesh.OctantTables | None (sah builds)
 
 
 def pool_instance_aabbs(ops: PoolMeshOperands):
@@ -3484,6 +3855,7 @@ def pool_mesh_bounce(
     ops: PoolMeshOperands, origins, directions, throughput, alive,
     lane, fid, seed_row, bounce_row, live_count, *, total_bounces: int,
     use_tlas: bool = False, tlas_leaf: int = 4,
+    tlas_block: int | None = None, quant: int = 0,
 ):
     """One pool bounce over a stacked multi-frame mesh scene.
 
@@ -3500,7 +3872,9 @@ def pool_mesh_bounce(
     """
     from tpu_render_cluster.render.mesh import LEAF_SIZE
 
-    block = tlas_block_r() if use_tlas else BVH_BLOCK_R
+    if tlas_block is None:
+        tlas_block = tlas_block_r()  # untraced callers only
+    block = tlas_block if use_tlas else BVH_BLOCK_R
     rays = origins.shape[0]
     if rays % block:
         raise ValueError(
@@ -3561,7 +3935,17 @@ def pool_mesh_bounce(
             jnp.arange(n_frames, dtype=jnp.int32)[:, None] * k_per_frame
         )
         key_lo, key_inv = mesh_key_bounds(lo_w, hi_w)
-        extra_operands = (
+        tlas_nodes = n_frames * m
+        tlas_per_frame = m
+        quant = resolve_bvh_quant(
+            quant,
+            (ops.skip.shape[0], ops.v0.shape[0] // LEAF_SIZE, LEAF_SIZE),
+            (tlas_nodes, ops.rotation.shape[0], tlas_leaf),
+        )
+        # The stacked per-frame node windows quantize against ONE grid
+        # (the union over every frame's instance AABBs): skip/leaf-start
+        # links carry their frame offsets INSIDE the packed meta words.
+        tlas_operands, tlas_specs = _node_table_operands(
             node_lo.reshape(-1, 3),
             node_hi.reshape(-1, 3),
             (jnp.asarray(topology.skip)[None, :] + node_offset).reshape(-1),
@@ -3569,10 +3953,11 @@ def pool_mesh_bounce(
                 -1
             ),
             jnp.tile(jnp.asarray(topology.count), n_frames),
-            jnp.concatenate([key_lo, key_inv]),
+            quant=quant, first_unit=1,
         )
-        tlas_nodes = n_frames * m
-        tlas_per_frame = m
+        extra_operands = (
+            *tlas_operands, jnp.concatenate([key_lo, key_inv]),
+        )
     else:
         # Front-to-back instance order WITHIN each frame's segment, from
         # the mean live origin (dead lanes parked far away must not drag
@@ -3593,6 +3978,11 @@ def pool_mesh_bounce(
                 :, None
             ]
         ).reshape(-1)
+        quant = resolve_bvh_quant(
+            quant,
+            (ops.skip.shape[0], ops.v0.shape[0] // LEAF_SIZE, LEAF_SIZE),
+        )
+        tlas_specs = []
         extra_operands = ()
         tlas_nodes = 0
         tlas_per_frame = 0
@@ -3617,15 +4007,16 @@ def pool_mesh_bounce(
     row_block = pl.BlockSpec(
         (1, block), lambda i: (0, i), memory_space=pltpu.VMEM
     )
+    blas_arrays = _blas_node_arrays(
+        ops.bounds_min, ops.bounds_max, ops.skip, ops.first, ops.count,
+        ops.octant,
+    )
+    ordered = blas_arrays[5]
+    blas_operands, blas_specs = _node_table_operands(
+        *blas_arrays[:5], quant=quant, first_unit=LEAF_SIZE,
+    )
     extra_specs = (
-        [
-            pl.BlockSpec((tlas_nodes, 3), whole, memory_space=pltpu.SMEM),
-            pl.BlockSpec((tlas_nodes, 3), whole, memory_space=pltpu.SMEM),
-            pl.BlockSpec((tlas_nodes,), flat, memory_space=pltpu.SMEM),
-            pl.BlockSpec((tlas_nodes,), flat, memory_space=pltpu.SMEM),
-            pl.BlockSpec((tlas_nodes,), flat, memory_space=pltpu.SMEM),
-            pl.BlockSpec((6,), flat, memory_space=pltpu.SMEM),
-        ]
+        tlas_specs + [pl.BlockSpec((6,), flat, memory_space=pltpu.SMEM)]
         if use_tlas
         else []
     )
@@ -3638,7 +4029,7 @@ def pool_mesh_bounce(
             total_bounces, padded_n, n_nodes, LEAF_SIZE, k_count,
             pool_io=True, k_per_frame=k_per_frame,
             use_tlas=use_tlas, tlas_nodes=tlas_nodes,
-            tlas_per_frame=tlas_per_frame,
+            tlas_per_frame=tlas_per_frame, quant=quant, ordered=ordered,
         ),
         grid=grid,
         in_specs=[
@@ -3668,16 +4059,7 @@ def pool_mesh_bounce(
             pl.BlockSpec(ops.e1.shape, whole, memory_space=pltpu.VMEM),
             pl.BlockSpec(ops.e2.shape, whole, memory_space=pltpu.VMEM),
             pl.BlockSpec(ops.normal.shape, whole, memory_space=pltpu.VMEM),
-            pl.BlockSpec(
-                ops.bounds_min.shape, whole, memory_space=pltpu.SMEM
-            ),
-            pl.BlockSpec(
-                ops.bounds_max.shape, whole, memory_space=pltpu.SMEM
-            ),
-            pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
-            pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
-            pl.BlockSpec((n_nodes,), flat, memory_space=pltpu.SMEM),
-        ] + extra_specs,
+        ] + blas_specs + extra_specs,
         out_specs=[ray_block, ray_block, ray_block, ray_block, row_block]
         + key_out_specs,
         out_shape=[
@@ -3692,8 +4074,8 @@ def pool_mesh_bounce(
       fid_lo, fid_hi,
       sp.c_t, sp.r2, sp.csq, sp.rad, sp.albedo_t, sp.emission_t,
       sp.dc_sun, sp.sfid, sp.params, ops.sun_direction, inst_table,
-      ops.v0, ops.e1, ops.e2, ops.normal, ops.bounds_min, ops.bounds_max,
-      ops.skip, ops.first, ops.count, *extra_operands)
+      ops.v0, ops.e1, ops.e2, ops.normal, *blas_operands,
+      *extra_operands)
     contrib, o2, d2, thr2, alive2 = results[:5]
     key2 = results[5][0] if use_tlas else None
     return contrib.T, o2.T, d2.T, thr2.T, alive2[0] > 0.5, key2

@@ -305,7 +305,8 @@ def _pool_sort_order(origins, directions, alive, fid, lo_w, hi_w):
     jax.jit,
     static_argnames=(
         "scene_name", "width", "height", "samples", "max_bounces",
-        "pool_width", "tile_shape", "use_tlas",
+        "pool_width", "tile_shape", "use_tlas", "tlas_leaf", "tlas_block",
+        "quant", "builder", "wide",
     ),
 )
 def _raypool_batch(
@@ -321,7 +322,12 @@ def _raypool_batch(
     max_bounces: int,
     pool_width: int,
     tile_shape: tuple[int, int] | None = None,
-    use_tlas: bool | None = None,
+    use_tlas: bool = True,
+    tlas_leaf: int = 4,
+    tlas_block: int = 256,
+    quant: int = 0,
+    builder: str = "sah",
+    wide: int = 4,
 ):
     """The whole batch as ONE compiled program; returns
     (linear images [f_cap, H, W, 3], stats tuple).
@@ -330,7 +336,12 @@ def _raypool_batch(
     scene, the pool while-loop, per-frame averaging — lives in one XLA
     program. ``n_frames`` is TRACED, so one compile serves every batch
     size up to the window cap (the recompile bound the fixed pool width
-    exists for).
+    exists for). Every BVH env tier arrives RESOLVED as a static arg
+    (``render_batch_raypool`` reads the env outside the trace — the
+    env-tiers lint contract); ``quant`` >= 1 additionally packs the
+    carried pool state (bf16 throughput words + one fid/bounce/dead meta
+    column replacing three), shrinking the bytes the per-iteration
+    permutation moves.
     """
     from tpu_render_cluster.render.camera import scene_camera
     from tpu_render_cluster.render.integrator import (
@@ -417,7 +428,8 @@ def _raypool_batch(
     mesh_kind = mesh_kind_for_scene(scene_name)
     tlas = False
     if mesh_kind is not None:
-        bvh = cached_mesh_bvh(mesh_kind)  # shared topology, host-cached
+        # Shared topology, host-cached; the build knobs arrive resolved.
+        bvh = cached_mesh_bvh(mesh_kind, builder, wide)
         inst = jax.vmap(lambda f: build_mesh_instances(scene_name, f))(
             frames
         )
@@ -434,17 +446,20 @@ def _raypool_batch(
             v0=bvh.v0, e1=bvh.e1, e2=bvh.e2, normal=bvh.normal,
             bounds_min=bvh.bounds_min, bounds_max=bvh.bounds_max,
             skip=bvh.skip, first=bvh.first, count=bvh.count,
+            octant=bvh.octant,
         )
-        # ``use_tlas`` is a static REQUEST (None = env tier); the actual
-        # decision folds in the per-frame instance count, all concrete
-        # at trace time (small fields degenerate to the flat sweep).
-        tlas = pk.use_tlas_for(k, use_tlas)
+        # ``use_tlas`` is a static resolved REQUEST; the actual decision
+        # folds in the per-frame instance count, all concrete at trace
+        # time (small fields degenerate to the flat sweep — the same
+        # rule as pk.use_tlas_for, inlined so no env tier is read inside
+        # this traced function).
+        tlas = bool(use_tlas) and k > tlas_leaf
         if tlas:
             # The TLAS kernels packet at their own narrower block; it
             # always divides BVH_BLOCK_R, so the BVH_BLOCK_R-rounded
             # pool width stays valid and the launched-lane accounting
             # below matches the kernel's actual skip granularity.
-            block = pk.tlas_block_r()
+            block = tlas_block
         if not tlas:
             # Sort-key broadphase over SLOT-UNION AABBs: slot k's world
             # AABB unioned across the window's frames, so the candidate
@@ -464,16 +479,18 @@ def _raypool_batch(
     # Pool state. Unfilled lanes start dead with guaranteed-miss rays
     # (far origin, unit direction) so they can never degenerate a slab
     # test, and fid/lane 0 so their zero contributions scatter harmlessly.
+    # quant >= 1 carries the PACKED tuple: throughput as bf16 words
+    # ([pool, 2] f32) and ONE meta column (fid | bounce | dead) in place
+    # of the separate alive/fid/bounce columns — the alive column is
+    # dropped outright (it is the meta dead bit), so the per-iteration
+    # permutation gathers 11 words per lane instead of 13 + a bool.
+    packed_state = quant >= 1
     state = dict(
         o=jnp.full((pool, 3), 1e7, jnp.float32),
         d=jnp.broadcast_to(
             jnp.array([0.0, 1.0, 0.0], jnp.float32), (pool, 3)
         ),
-        thr=jnp.ones((pool, 3), jnp.float32),
-        alive=jnp.zeros((pool,), bool),
         lane=jnp.zeros((pool,), jnp.int32),
-        fid=jnp.zeros((pool,), jnp.int32),
-        bounce=jnp.zeros((pool,), jnp.int32),
         served=jnp.int32(0),
         it=jnp.int32(0),
         radiance=jnp.zeros((f_cap * n, 3), jnp.float32),
@@ -483,6 +500,20 @@ def _raypool_batch(
         live_sum=jnp.float32(0.0),
         launched_sum=jnp.float32(0.0),
     )
+    if packed_state:
+        state["thr"] = pk.pack_throughput_bf16(
+            jnp.ones((pool, 3), jnp.float32)
+        )
+        state["meta"] = pk.pack_pool_meta(
+            jnp.zeros((pool,), jnp.int32),
+            jnp.zeros((pool,), jnp.int32),
+            jnp.zeros((pool,), bool),
+        )
+    else:
+        state["thr"] = jnp.ones((pool, 3), jnp.float32)
+        state["alive"] = jnp.zeros((pool,), bool)
+        state["fid"] = jnp.zeros((pool,), jnp.int32)
+        state["bounce"] = jnp.zeros((pool,), jnp.int32)
     if tlas:
         # Carried coherence-key column (the TLAS bounce kernel re-emits
         # it every iteration): every initial lane is dead, so one
@@ -496,12 +527,21 @@ def _raypool_batch(
     # the bounce cap, so this bound is generous.
     iter_cap = (total // pool + 2) * (max_bounces + 1) + 4
 
+    def pool_alive(s):
+        if packed_state:
+            return pk.unpack_pool_meta(s["meta"])[2]
+        return s["alive"]
+
     def cond(s):
         return (s["it"] < iter_cap) & (
-            (s["served"] < total) | jnp.any(s["alive"])
+            (s["served"] < total) | jnp.any(pool_alive(s))
         )
 
     def body(s):
+        if packed_state:
+            s_fid, s_bounce, s_alive = pk.unpack_pool_meta(s["meta"])
+        else:
+            s_fid, s_bounce, s_alive = s["fid"], s["bounce"], s["alive"]
         # 1. One permutation: dead to the tail (+ frame/candidate/Morton
         # coherence for mesh scenes). The TLAS pool sorts by the key
         # column the previous iteration's bounce kernel emitted (dead
@@ -512,16 +552,20 @@ def _raypool_batch(
             perm = jnp.argsort(s["key"])
         elif mesh_ops is not None:
             perm = _pool_sort_order(
-                s["o"], s["d"], s["alive"], s["fid"], inst_lo, inst_hi
+                s["o"], s["d"], s_alive, s_fid, inst_lo, inst_hi
             )
         else:
-            perm, _ = compaction_order(s["alive"])
+            perm, _ = compaction_order(s_alive)
         packed = jnp.concatenate([s["o"], s["d"], s["thr"]], axis=1)[perm]
-        o, d, thr = packed[:, 0:3], packed[:, 3:6], packed[:, 6:9]
-        alive = s["alive"][perm]
+        o, d = packed[:, 0:3], packed[:, 3:6]
+        thr = packed[:, 6:]  # carried form: [P, 3] f32 or [P, 2] packed
         lane = s["lane"][perm]
-        fid = s["fid"][perm]
-        bounce = s["bounce"][perm]
+        if packed_state:
+            fid, bounce, alive = pk.unpack_pool_meta(s["meta"][perm])
+        else:
+            alive = s_alive[perm]
+            fid = s_fid[perm]
+            bounce = s_bounce[perm]
         live = jnp.sum(alive.astype(jnp.int32))
 
         # 2. Refill the freed tail with the next unserved primaries.
@@ -531,7 +575,14 @@ def _raypool_batch(
         is_new = (slot >= live) & (slot < live + take)
         o = jnp.where(is_new[:, None], prim_o[src], o)
         d = jnp.where(is_new[:, None], prim_d[src], d)
-        thr = jnp.where(is_new[:, None], 1.0, thr)
+        if packed_state:
+            thr = jnp.where(
+                is_new[:, None],
+                pk.pack_throughput_bf16(jnp.ones((1, 3), jnp.float32)),
+                thr,
+            )
+        else:
+            thr = jnp.where(is_new[:, None], 1.0, thr)
         alive = alive | is_new
         new_fid = src // n
         fid = jnp.where(is_new, new_fid, fid)
@@ -542,24 +593,29 @@ def _raypool_batch(
         # 3. One fused bounce over the live prefix (per-lane frame seed
         # + bounce depth key the RNG; all-dead tail blocks skip). Under a
         # region the RNG counter is the lane's FULL-frame id, not its
-        # local scatter index.
+        # local scatter index. The kernel computes in f32 either way;
+        # packed mode converts at the launch boundary.
         seed_row = seeds[jnp.clip(fid, 0, f_cap - 1)]
         rng = (
             lane if glane_map is None
             else glane_map[jnp.clip(lane, 0, n - 1)]
         )
+        thr_f32 = pk.unpack_throughput_bf16(thr) if packed_state else thr
         if mesh_ops is not None:
-            contrib, o, d, thr, alive_k, key2 = pk.pool_mesh_bounce(
-                mesh_ops, o, d, thr, alive, rng, fid, seed_row, bounce,
-                live2, total_bounces=max_bounces, use_tlas=tlas,
-                tlas_leaf=pk.tlas_leaf_size(),
+            contrib, o, d, thr_f32, alive_k, key2 = pk.pool_mesh_bounce(
+                mesh_ops, o, d, thr_f32, alive, rng, fid, seed_row,
+                bounce, live2, total_bounces=max_bounces, use_tlas=tlas,
+                tlas_leaf=tlas_leaf, tlas_block=tlas_block, quant=quant,
             )
         else:
-            contrib, o, d, thr, alive_k = pk.pool_sphere_bounce(
-                sphere_ops, o, d, thr, alive, rng, fid, seed_row,
+            contrib, o, d, thr_f32, alive_k = pk.pool_sphere_bounce(
+                sphere_ops, o, d, thr_f32, alive, rng, fid, seed_row,
                 bounce, live2, total_bounces=max_bounces,
             )
             key2 = None
+        thr = (
+            pk.pack_throughput_bf16(thr_f32) if packed_state else thr_f32
+        )
 
         # 4. Scatter-back into each lane's own frame buffer. Dead lanes
         # contribute exact zeros (alive-masked kernel math / skipped
@@ -586,8 +642,7 @@ def _raypool_batch(
         launched = ((live2 + block - 1) // block) * block
         occupancy = live2.astype(jnp.float32) / jnp.maximum(launched, 1)
         next_state = dict(
-            o=o, d=d, thr=thr, alive=alive, lane=lane, fid=fid,
-            bounce=bounce,
+            o=o, d=d, thr=thr, lane=lane,
             served=s["served"] + take,
             it=s["it"] + 1,
             radiance=radiance,
@@ -597,6 +652,12 @@ def _raypool_batch(
             live_sum=s["live_sum"] + live2.astype(jnp.float32),
             launched_sum=s["launched_sum"] + launched.astype(jnp.float32),
         )
+        if packed_state:
+            next_state["meta"] = pk.pack_pool_meta(fid, bounce, alive)
+        else:
+            next_state["alive"] = alive
+            next_state["fid"] = fid
+            next_state["bounce"] = bounce
         if tlas:
             # The kernel keyed lanes by its OWN post-bounce alive; the
             # bounce-cap kill above happens out here, so stamp the dead
@@ -698,6 +759,9 @@ def render_batch_raypool(
     frame_cap: int | None = None,
     region: tuple[int, int, int, int] | None = None,
     use_tlas: bool | None = None,
+    quant: int | None = None,
+    builder: str | None = None,
+    wide: int | None = None,
 ):
     """Render a batch of frames through the device-resident ray pool.
 
@@ -734,10 +798,23 @@ def render_batch_raypool(
     )
     pool = pool_width if pool_width is not None else raypool_width(n, block)
     pool = max(block, -(-pool // block) * block)
-    # The tag mirrors the REQUESTED tier (None = env), like the masked/
-    # region profiler keys — kernel selection still auto-degrades tiny
+    # Resolve every BVH env tier HERE, outside the traced batch program
+    # (the env-tiers lint contract), and thread the concrete values in as
+    # static args — they are part of the pool program's identity, its
+    # compile-count key, and its roofline row. The tlas tag mirrors the
+    # RESOLVED request; kernel selection still auto-degrades tiny
     # instance fields inside the batch program.
-    tlas_tag = int(pk.tlas_enabled() if use_tlas is None else bool(use_tlas))
+    from tpu_render_cluster.obs.profiling import bvh_dims
+    from tpu_render_cluster.render.integrator import resolve_bvh_config
+
+    tlas_resolved, quant, builder, wide = resolve_bvh_config(
+        use_tlas, quant, builder, wide
+    )
+    tlas_leaf = pk.tlas_leaf_size()
+    tlas_block = pk.tlas_block_r()
+    format_dims = bvh_dims(
+        tlas=tlas_resolved, quant=quant, builder=builder, wide=wide
+    )
 
     images: list = []
     for start in range(0, len(frames), f_cap):
@@ -746,7 +823,7 @@ def render_batch_raypool(
         note_compile(
             "raypool", scene_name, width, height, samples, max_bounces,
             pool, f_cap, None if region is None else (region[2], region[3]),
-            tlas_tag,
+            int(tlas_resolved), quant, builder, wide,
         )
         start_wall = time.time()
         start_mono = time.perf_counter()
@@ -759,7 +836,9 @@ def render_batch_raypool(
             width=width, height=height, samples=samples,
             max_bounces=max_bounces, pool_width=pool,
             tile_shape=None if region is None else (region[2], region[3]),
-            use_tlas=use_tlas,
+            use_tlas=tlas_resolved, tlas_leaf=tlas_leaf,
+            tlas_block=tlas_block, quant=quant, builder=builder,
+            wide=wide,
         )
         # THE host sync of the batch: everything before this line is one
         # dispatched XLA program.
@@ -786,7 +865,7 @@ def render_batch_raypool(
             w=width, h=height, s=samples, b=max_bounces,
             pool=pool, frames=f_cap,
             tile="-" if region is None else f"{region[2]}x{region[3]}",
-            tlas=tlas_tag,
+            **format_dims,
         )
         if not profiler.captured(pool_key):
             profiler.capture(
@@ -797,7 +876,9 @@ def render_batch_raypool(
                 width=width, height=height, samples=samples,
                 max_bounces=max_bounces, pool_width=pool,
                 tile_shape=None if region is None else (region[2], region[3]),
-                use_tlas=use_tlas,
+                use_tlas=tlas_resolved, tlas_leaf=tlas_leaf,
+                tlas_block=tlas_block, quant=quant, builder=builder,
+                wide=wide,
             )
         profiler.record_execute(pool_key, duration)
         _emit_batch_obs(

@@ -72,6 +72,9 @@ declare("TRC_RAYPOOL_WIDTH", "int", None, "Ray-pool width (default: one frame, b
 declare("TRC_TLAS", "flag", 1, "Two-level (TLAS) mesh traversal on/off")
 declare("TRC_TLAS_LEAF", "int", 4, "Instances per TLAS leaf (clamped 1..16)")
 declare("TRC_TLAS_BLOCK", "int", 256, "Ray-block width of the TLAS kernel variants")
+declare("TRC_BVH_QUANT", "int", 0, "Quantized BVH/TLAS node tier: 0 off, 1 16-bit, 2 8-bit slabs (+ packed carried ray state)")
+declare("TRC_BVH_BUILDER", "spec", "sah", "BLAS build strategy: sah (binned) | median")
+declare("TRC_BVH_WIDE", "int", 4, "BLAS branching factor after wide collapse (1 = binary, clamped 1..8)")
 declare("TRC_COMPILE_CACHE", "path", None, "Persistent XLA compile cache directory")
 # -- jobs / tiles ------------------------------------------------------------
 declare("TRC_TILE_GRID", "spec", None, "Default RxC tile grid applied at job load time")
