@@ -1008,6 +1008,12 @@ def _ha_shard_process(conn, worker_count: int, render_seconds: float) -> None:
             out["append_buckets"] = list(series.counts) + [series.overflow]
             out["append_count"] = series.count
             out["append_sum"] = series.sum
+        # Raw registry snapshots (shard + every colocated worker) ride
+        # back so the parent can fold one whole-stack attribution report
+        # across the rep — tick phases, loop lag, and wire costs all live
+        # in these per-process registries, not the parent's.
+        out["registry"] = manager.metrics.snapshot()
+        out["worker_registries"] = [w.metrics.snapshot() for w in workers]
         return out
 
     try:
@@ -1102,9 +1108,11 @@ def ha_shard_bench(
         ).to_dict()
 
     append_stats: dict[str, object] = {}
+    attrib_snapshots: list[dict[str, object]] = []
+    attrib_window = 0.0
 
     def run_once(shard_count: int) -> float:
-        nonlocal append_stats
+        nonlocal append_stats, attrib_snapshots, attrib_window
         workers_per_shard = total_workers // shard_count
         saved = {k: os.environ.get(k) for k in sched_env}
         os.environ.update(sched_env)
@@ -1182,6 +1190,16 @@ def ha_shard_bench(
             window = max(r["last_finish"] for r in results) - min(
                 r["first_admit"] for r in results
             )
+            # Keep the LAST rep's registries (shards + colocated workers)
+            # for the record's whole-stack attribution section.
+            attrib_snapshots = [
+                {"metrics": r["registry"]} for r in results if "registry" in r
+            ] + [
+                {"metrics": snap}
+                for r in results
+                for snap in r.get("worker_registries", ())
+            ]
+            attrib_window = window
             if total_units != jobs * frames:
                 raise RuntimeError(
                     f"{shard_count}-shard run finished {total_units} units, "
@@ -1267,6 +1285,24 @@ def ha_shard_bench(
         / max(1e-9, record["assignments_per_s_1_shard"]),
         3,
     )
+    # Whole-stack attribution over the final (2-shard) rep's registries:
+    # where the combined admission->completion window went — control
+    # plane vs wire vs queue wait — with the window x worker-count pool
+    # as the denominator. Accounting must never kill the bench.
+    try:
+        from tpu_render_cluster.analysis.obs_events import (
+            summarize_attribution,
+        )
+
+        if attrib_snapshots and attrib_window > 0:
+            attribution = summarize_attribution(
+                attrib_snapshots,
+                worker_seconds=attrib_window * total_workers,
+            )
+            if attribution:
+                record["attribution"] = attribution
+    except Exception as e:  # noqa: BLE001 - accounting must not kill the bench
+        print(f"warning: attribution accounting failed: {e}", file=sys.stderr)
     return record
 
 
@@ -1803,6 +1839,7 @@ def main() -> int:
 
     import jax
 
+    headline_started = time.perf_counter()
     fps = measure_fps()
     platform = jax.devices()[0].platform
     try:
@@ -1836,6 +1873,24 @@ def main() -> int:
     roofline = get_profiler().view()
     if roofline:
         record["roofline"] = roofline
+    # Whole-stack attribution over the same process-global registry. A
+    # pure-render invocation carries no cluster series and stamps
+    # nothing; a colocated run (harness import, instrumented modes) gets
+    # the same section statistics.json folds from run artifacts.
+    try:
+        from tpu_render_cluster.analysis.obs_events import (
+            summarize_attribution,
+        )
+        from tpu_render_cluster.obs import get_registry
+
+        attribution = summarize_attribution(
+            [{"metrics": get_registry().snapshot()}],
+            worker_seconds=time.perf_counter() - headline_started,
+        )
+        if attribution:
+            record["attribution"] = attribution
+    except Exception as e:  # noqa: BLE001 - accounting must not kill the bench
+        print(f"warning: attribution accounting failed: {e}", file=sys.stderr)
     print(json.dumps(record))
     return 0
 

@@ -1,7 +1,8 @@
 #!/usr/bin/env python3
 """Check exported Chrome trace-event artifacts against the repo's
 trace invariants (balanced/complete events, non-negative monotonic
-per-track timestamps, unique pid/tid metadata, resolvable flow ids).
+per-track timestamps, unique pid/tid metadata, resolvable flow ids,
+self-contained "sched"/"loop" attribution tracks).
 
 Usage:
     python scripts/validate_trace.py <trace.json> [<trace.json> ...]

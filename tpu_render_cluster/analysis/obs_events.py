@@ -839,6 +839,227 @@ def summarize_roofline(metrics: list[dict[str, Any]]) -> dict[str, Any] | None:
     return out
 
 
+def _parse_series_labels(label: str) -> dict[str, str]:
+    """Registry series key (``"tag=ping,direction=send"``) -> labels."""
+    labels: dict[str, str] = {}
+    for part in label.split(","):
+        name, sep, value = part.partition("=")
+        if sep:
+            labels[name] = value
+    return labels
+
+
+def summarize_attribution(
+    metrics: list[dict[str, Any]],
+    critical_sections: dict[str, Any] | None = None,
+    *,
+    worker_seconds: float | None = None,
+) -> dict[str, Any] | None:
+    """Roll the whole-stack time-attribution inputs up and partition them.
+
+    Extracts the tick-phase histogram (``sched_tick_seconds``,
+    sched/tickprof.py), the loop-lag families (``obs_loop_lag_seconds``
+    + ``obs_loop_blocked_episodes_total``, obs/loopmon.py), the wire
+    accounting families (``transport_serialize_seconds`` +
+    ``transport_message_bytes_total``, transport/wirecost.py), and the
+    roofline execute totals, then hands them to
+    ``analysis/attribution.attribution_report`` against the per-worker
+    busy/idle windows in ``critical_sections`` (or the explicit
+    ``worker_seconds`` denominator). Same snapshot-family handling as
+    every other summarize_*: registry forms first, the compact wire form
+    only for files no registry snapshot covered. None when no snapshot
+    carries any attribution series.
+    """
+    found = False
+    tick_phases: dict[str, dict[str, float]] = {}
+    lag_roles: dict[str, dict[str, float]] = {}
+    episode_roles: dict[str, float] = {}
+    talker_rows: dict[str, dict[str, float]] = {}
+    transport_s = 0.0
+
+    def take_tick(phase: str, count: float, total: float) -> None:
+        nonlocal found
+        found = True
+        entry = tick_phases.setdefault(phase, {"count": 0.0, "sum_s": 0.0})
+        entry["count"] += count
+        entry["sum_s"] += total
+
+    def take_lag(role: str, count: float, total: float, peak: float) -> None:
+        nonlocal found
+        found = True
+        entry = lag_roles.setdefault(
+            role, {"samples": 0.0, "sum_s": 0.0, "max_s": 0.0}
+        )
+        entry["samples"] += count
+        entry["sum_s"] += total
+        entry["max_s"] = max(entry["max_s"], peak)
+
+    def take_wire_bytes(labels: dict[str, str], value: float) -> None:
+        nonlocal found
+        found = True
+        tag = labels.get("tag", "?")
+        row = talker_rows.setdefault(
+            tag, {"bytes": 0.0, "send_bytes": 0.0, "recv_bytes": 0.0,
+                  "serialize_s": 0.0}
+        )
+        row["bytes"] += value
+        direction = labels.get("direction")
+        if direction == "send":
+            row["send_bytes"] += value
+        elif direction == "recv":
+            row["recv_bytes"] += value
+
+    def take_serialize(labels: dict[str, str], total: float) -> None:
+        nonlocal found, transport_s
+        found = True
+        transport_s += total
+        tag = labels.get("tag", "?")
+        row = talker_rows.setdefault(
+            tag, {"bytes": 0.0, "send_bytes": 0.0, "recv_bytes": 0.0,
+                  "serialize_s": 0.0}
+        )
+        row["serialize_s"] += total
+
+    def take_registry(names: dict[str, Any]) -> bool:
+        nonlocal found
+        took = False
+        histogram = names.get("sched_tick_seconds")
+        if histogram:
+            took = True
+            for label, series in histogram.get("series", {}).items():
+                take_tick(
+                    label.partition("=")[2] or label,
+                    float(series.get("count", 0)),
+                    float(series.get("sum", 0.0)),
+                )
+        histogram = names.get("obs_loop_lag_seconds")
+        if histogram:
+            took = True
+            for label, series in histogram.get("series", {}).items():
+                take_lag(
+                    label.partition("=")[2] or label,
+                    float(series.get("count", 0)),
+                    float(series.get("sum", 0.0)),
+                    float(series.get("max", 0.0) or 0.0),
+                )
+        counter = names.get("obs_loop_blocked_episodes_total")
+        if counter:
+            found = took = True
+            for label, value in counter.get("series", {}).items():
+                role = label.partition("=")[2] or label
+                episode_roles[role] = episode_roles.get(role, 0.0) + float(value)
+        counter = names.get("transport_message_bytes_total")
+        if counter:
+            took = True
+            for label, value in counter.get("series", {}).items():
+                take_wire_bytes(_parse_series_labels(label), float(value))
+        histogram = names.get("transport_serialize_seconds")
+        if histogram:
+            took = True
+            for label, series in histogram.get("series", {}).items():
+                take_serialize(
+                    _parse_series_labels(label), float(series.get("sum", 0.0))
+                )
+        return took
+
+    def take_wire(wire: dict[str, Any]) -> None:
+        nonlocal found
+        for key, entry in (wire.get("h") or {}).items():
+            name, _, label = key.partition("|")
+            if name == "sched_tick_seconds":
+                take_tick(
+                    label.partition("=")[2] or label,
+                    float(entry.get("n", 0)),
+                    float(entry.get("s", 0.0)),
+                )
+            elif name == "obs_loop_lag_seconds":
+                take_lag(
+                    label.partition("=")[2] or label,
+                    float(entry.get("n", 0)),
+                    float(entry.get("s", 0.0)),
+                    float(entry.get("max", 0.0) or 0.0),
+                )
+            elif name == "transport_serialize_seconds":
+                take_serialize(
+                    _parse_series_labels(label), float(entry.get("s", 0.0))
+                )
+        for key, value in (wire.get("c") or {}).items():
+            name, _, label = key.partition("|")
+            if name == "obs_loop_blocked_episodes_total":
+                found = True
+                role = label.partition("=")[2] or label
+                episode_roles[role] = episode_roles.get(role, 0.0) + float(value)
+            elif name == "transport_message_bytes_total":
+                take_wire_bytes(_parse_series_labels(label), float(value))
+
+    _consume_metric_snapshots(metrics, take_registry, take_wire)
+    if not found:
+        return None
+
+    device_s = 0.0
+    roofline = summarize_roofline(metrics)
+    if roofline:
+        for entry in roofline.get("kernels", {}).values():
+            device_s += float(entry.get("execute_seconds_total", 0.0) or 0.0)
+
+    # The tick's dispatch phase already spans its in-tick RPC awaits; the
+    # off-tick dispatch_rpc_await/dispatch_serialize observations only
+    # price the control plane when no scheduler loop ran (single-job).
+    control_s = tick_phases.get("total", {}).get("sum_s", 0.0)
+    if control_s <= 0.0:
+        control_s = sum(
+            entry["sum_s"]
+            for phase, entry in tick_phases.items()
+            if phase in ("dispatch_rpc_await", "dispatch_serialize")
+        )
+
+    loop_lag: dict[str, Any] = {}
+    for role, entry in sorted(lag_roles.items()):
+        samples = entry["samples"]
+        loop_lag[role] = {
+            "samples": int(samples),
+            "mean_lag_s": (entry["sum_s"] / samples) if samples else 0.0,
+            "max_lag_s": entry["max_s"],
+            "blocked_episodes": int(episode_roles.get(role, 0.0)),
+        }
+    for role, count in sorted(episode_roles.items()):
+        loop_lag.setdefault(
+            role,
+            {"samples": 0, "mean_lag_s": 0.0, "max_lag_s": 0.0,
+             "blocked_episodes": int(count)},
+        )
+
+    top = [
+        {"tag": tag, **{k: row[k] for k in
+                        ("bytes", "send_bytes", "recv_bytes", "serialize_s")}}
+        for tag, row in talker_rows.items()
+    ]
+    top.sort(key=lambda row: row["bytes"], reverse=True)
+
+    from tpu_render_cluster.analysis.attribution import attribution_report
+
+    tick_section: dict[str, Any] | None = None
+    if tick_phases:
+        tick_section = {
+            "ticks": int(tick_phases.get("total", {}).get("count", 0)),
+            "phases": {
+                phase: {"count": int(entry["count"]),
+                        "sum_s": round(entry["sum_s"], 6)}
+                for phase, entry in sorted(tick_phases.items())
+            },
+        }
+    return attribution_report(
+        critical_sections=critical_sections,
+        worker_seconds=worker_seconds,
+        device_seconds=device_s,
+        transport_seconds=transport_s,
+        control_seconds=control_s,
+        tick=tick_section,
+        loop_lag=loop_lag or None,
+        top_talkers=top[:8] or None,
+    )
+
+
 _CHAOS_LEDGER_COUNTERS = (
     "master_frame_results_total",
     "master_duplicate_results_total",
@@ -975,4 +1196,9 @@ def summarize_obs(
                 sections[trace.path.stem] = section
         if sections:
             out["critical_path"] = sections
+    attribution = summarize_attribution(
+        metrics, out.get("critical_path")
+    )
+    if attribution is not None:
+        out["attribution"] = attribution
     return out

@@ -57,7 +57,7 @@ import urllib.request
 import zlib
 from typing import Any
 
-from tpu_render_cluster.obs import MetricsRegistry, get_registry
+from tpu_render_cluster.obs import LoopLagMonitor, MetricsRegistry, get_registry
 from tpu_render_cluster.obs.prometheus import (
     CONTENT_TYPE,
     parse_prometheus,
@@ -459,6 +459,10 @@ async def serve(args: argparse.Namespace) -> int:
     )
     server = ShardRouterServer(router, args.host, args.control_port)
     await server.start()
+    # The router is one asyncio loop fronting every shard: a stall here
+    # delays ALL shards' control traffic, so its lag is worth a series.
+    loopmon = LoopLagMonitor(router.metrics, role="router")
+    loopmon.start()
     telemetry = None
     telemetry_port = resolve_telemetry_port(
         args.telemetry_port, "TRC_OBS_ROUTER_PORT"
@@ -504,6 +508,7 @@ async def serve(args: argparse.Namespace) -> int:
     try:
         await asyncio.Event().wait()  # serve until interrupted
     finally:
+        await loopmon.stop()
         if telemetry is not None:
             await telemetry.stop()
         await server.stop()

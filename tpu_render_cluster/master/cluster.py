@@ -38,6 +38,7 @@ from tpu_render_cluster.obs import (
     FlightRecorder,
     HistorySampler,
     HistoryStore,
+    LoopLagMonitor,
     MetricsRegistry,
     SnapshotWriter,
     TimelineProcess,
@@ -63,6 +64,7 @@ from tpu_render_cluster.transport.reconnect import (
     ReconnectableServerConnection,
     TransportMetrics,
 )
+from tpu_render_cluster.transport.wirecost import WireAccounting
 from tpu_render_cluster.transport.ws import (
     WebSocketClosed,
     WebSocketConnection,
@@ -204,6 +206,19 @@ class ClusterManager:
                 else None,
             ),
         )
+        # Event-loop lag probe (obs/loopmon.py): started at bind, stopped
+        # at shutdown; a sample over TRC_OBS_LOOPMON_THRESHOLD counts a
+        # blocked episode and flight-records the window.
+        self.loopmon = LoopLagMonitor(
+            self.metrics,
+            role="master",
+            span_tracer=self.span_tracer,
+            flightrec=self.flightrec,
+        )
+        # Handshake-path wire accounting (transport/wirecost.py); the
+        # per-worker handles carry their own instance over the same
+        # registry, so all master-side series land in one family.
+        self._wire = WireAccounting(self.metrics)
         # Per-job SLO engine (obs/slo.py): fed by every winning result's
         # dispatch-to-result latency, ticked by a sidecar (single-job) or
         # the scheduler loop (service mode). Inert for jobs without an
@@ -319,6 +334,7 @@ class ClusterManager:
         if self._snapshot_writer is not None:
             self._snapshot_writer.start()
         self._history_sampler.start()
+        self.loopmon.start()
         if self.telemetry is not None:
             await self.telemetry.start()
 
@@ -352,6 +368,7 @@ class ClusterManager:
         """Stop the writer, cancel, close worker sockets, close the server."""
         if self.telemetry is not None:
             await self.telemetry.stop()
+        await self.loopmon.stop()
         await self._history_sampler.stop()
         if self._snapshot_writer is not None:
             await self._snapshot_writer.stop()
@@ -558,23 +575,23 @@ class ClusterManager:
         # the incarnation it lost (resume the session) or a successor
         # (re-announce fresh); epoch-less masters stay byte-identical.
         await ws.send_text(
-            pm.encode_message(
+            self._wire.encode(
                 pm.MasterHandshakeRequest(PROTOCOL_VERSION, epoch=self.epoch)
             )
         )
-        response = pm.decode_message(await ws.receive_text())
+        response = self._wire.decode(await ws.receive_text())
         if not isinstance(response, pm.WorkerHandshakeResponse):
             raise WebSocketClosed(f"Expected handshake response, got {type(response)}")
 
         if response.handshake_type == pm.HANDSHAKE_TYPE_FIRST_CONNECTION:
             await ws.send_text(
-                pm.encode_message(pm.MasterHandshakeAcknowledgement(True))
+                self._wire.encode(pm.MasterHandshakeAcknowledgement(True))
             )
             await self._register_new_worker(response.worker_id, ws)
         elif response.handshake_type == pm.HANDSHAKE_TYPE_RECONNECTING:
             known = response.worker_id in self.workers
             await ws.send_text(
-                pm.encode_message(pm.MasterHandshakeAcknowledgement(known))
+                self._wire.encode(pm.MasterHandshakeAcknowledgement(known))
             )
             if not known:
                 # Reference: reconnect from an unknown worker is refused

@@ -47,6 +47,7 @@ from tpu_render_cluster.transport.actors import (
     request_response,
 )
 from tpu_render_cluster.transport.reconnect import ReconnectableServerConnection
+from tpu_render_cluster.transport.wirecost import WireAccounting
 from tpu_render_cluster.utils.env import env_float, env_int
 from tpu_render_cluster.utils.logging import WorkerLogger
 
@@ -144,6 +145,10 @@ class WorkerHandle:
         self._dispatch_delay_fn = dispatch_delay_fn
         self.metrics = metrics
         self.span_tracer = span_tracer
+        # Wire-cost accounting around the codec (transport/wirecost.py):
+        # per-tag byte counters + serialize-time histograms on this end
+        # of the socket (passthrough when no registry is wired).
+        self._wire = WireAccounting(metrics)
         # Most recent compact metrics payload this worker piggybacked on a
         # heartbeat pong (None until the first instrumented pong arrives).
         self.latest_worker_metrics: dict | None = None
@@ -186,17 +191,31 @@ class WorkerHandle:
     # -- transport adapters -------------------------------------------------
 
     async def _send_message(self, message: pm.Message) -> None:
+        serialize_started = time.perf_counter()
+        text = self._wire.encode(message)
+        if isinstance(message, pm.MasterFrameQueueAddRequest):
+            # The per-dispatch JSON cost ROADMAP item 3 wants to
+            # preserialize, attributed as a tick phase. Import is lazy:
+            # sched/__init__ imports the manager which imports this
+            # module, so a top-level sched import here would be circular.
+            from tpu_render_cluster.sched.tickprof import observe_dispatch_phase
+
+            observe_dispatch_phase(
+                self.metrics,
+                "dispatch_serialize",
+                time.perf_counter() - serialize_started,
+            )
         # Send-side deadline: a socket that accepts writes but never
         # drains (or a reconnect window that never closes) must surface as
         # a failure here instead of parking the sender actor — and with it
         # every RPC on this worker — forever.
         await asyncio.wait_for(
-            self.connection.send_text(pm.encode_message(message)),
+            self.connection.send_text(text),
             send_deadline_seconds(),
         )
 
     async def _receive_message(self) -> pm.Message:
-        return pm.decode_message(await self.connection.receive_text())
+        return self._wire.decode(await self.connection.receive_text())
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -442,6 +461,11 @@ class WorkerHandle:
                 "queue-add RPC round-trip (request sent to ack received)",
                 labels=("strategy",),
             ).observe(rpc_seconds, strategy=strategy)
+            # Attribution phase: dispatch send->ack (lazy import, see
+            # _send_message for the sched<->master cycle note).
+            from tpu_render_cluster.sched.tickprof import observe_dispatch_phase
+
+            observe_dispatch_phase(self.metrics, "dispatch_rpc_await", rpc_seconds)
         if self.span_tracer is not None:
             # Constant span name (frame index in args) so viewers and the
             # analysis roll-up aggregate all assignments into one stat.

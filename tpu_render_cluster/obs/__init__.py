@@ -26,6 +26,7 @@ from tpu_render_cluster.obs.flightrec import (
     resolve_flight_directory,
 )
 from tpu_render_cluster.obs.history import HistorySampler, HistoryStore
+from tpu_render_cluster.obs.loopmon import LoopLagMonitor
 from tpu_render_cluster.obs.registry import (
     DEFAULT_BUCKETS,
     Counter,
@@ -57,6 +58,7 @@ __all__ = [
     "Histogram",
     "HistorySampler",
     "HistoryStore",
+    "LoopLagMonitor",
     "MetricsRegistry",
     "SnapshotWriter",
     "TimelineProcess",

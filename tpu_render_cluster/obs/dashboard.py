@@ -14,6 +14,10 @@ polls a master's ``/metrics`` (Prometheus text exposition, parsed with
 - sparkline columns over the embedded metrics history (``/history``,
   obs/history.py): per-interval unit-completion rate and queue depth,
   so a stall or burst is visible as a *shape*, not one number;
+- a "where did the time go" panel from the attribution families:
+  sched-tick phase cost (``sched_tick_seconds{phase}``), event-loop lag
+  per role (``obs_loop_lag_seconds``), and the wire's top talkers by
+  ``transport_message_bytes_total{tag,direction}``;
 - an HA section when the endpoint is the shard router's federated view
   (ha/shards.py): per-shard routed requests, ledger append p99
   (``ha_ledger_append_seconds``), and last-failover MTTR.
@@ -29,6 +33,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import sys
 import time
 import urllib.error
@@ -179,8 +184,14 @@ def histogram_quantiles(
                 break
             previous_bound, previous_count = bound, count
         else:
-            out[q] = bounds[-2] if len(bounds) > 1 else bounds[-1]
-    return out
+            # Rank past every bucket (float noise in the cumulative sums):
+            # clamp to the largest FINITE bound. A degenerate histogram
+            # whose only bucket is +Inf yields no estimate for this
+            # quantile rather than an "inf" row.
+            finite = [b for b in bounds if b != float("inf")]
+            if finite:
+                out[q] = finite[-1]
+    return out or None
 
 
 def _sample_value(
@@ -193,7 +204,7 @@ def _sample_value(
 
 
 def _fmt_seconds(value: float | None) -> str:
-    if value is None:
+    if value is None or not math.isfinite(value):
         return "-"
     if value < 1e-3:
         return f"{value * 1e6:.0f}us"
@@ -226,6 +237,120 @@ def _history_sparkline_rows(history: dict[str, Any]) -> list[str]:
             label = f"{name}{{{label_str}}}" if label_str else name
             rows.append(f"{label:<44.44} {sparkline(values):<32} {suffix}")
     return rows
+
+
+def _fmt_bytes(value: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if value < 1024 or unit == "GiB":
+            return f"{value:.0f}{unit}" if unit == "B" else f"{value:.1f}{unit}"
+        value /= 1024
+    return f"{value:.1f}GiB"  # unreachable; keeps the signature total
+
+
+def _render_time_section(samples: Samples) -> list[str]:
+    """The "where did the time go" panel: sched-tick phase costs, event
+    loop lag per role, and the wire's top talkers — all reconstructed
+    from the attribution metric families, all optional (a pre-PR-16
+    endpoint or an idle cluster just renders nothing here)."""
+    lines: list[str] = []
+
+    phases = sorted(
+        {
+            labels.get("phase", "")
+            for labels, _value in samples.get("sched_tick_seconds_count", ())
+        }
+        - {""}
+    )
+    phase_rows: list[str] = []
+    for phase in phases:
+        count = sum(
+            value
+            for labels, value in samples.get("sched_tick_seconds_count", ())
+            if labels.get("phase") == phase
+        )
+        if count <= 0:
+            continue
+        total = sum(
+            value
+            for labels, value in samples.get("sched_tick_seconds_sum", ())
+            if labels.get("phase") == phase
+        )
+        quantiles = histogram_quantiles(
+            samples, "sched_tick_seconds", (0.5, 0.99), where={"phase": phase}
+        ) or {}
+        phase_rows.append(
+            f"{phase:<20} {count:>7.0f} {_fmt_seconds(total / count):>9} "
+            f"{_fmt_seconds(quantiles.get(0.5)):>9} "
+            f"{_fmt_seconds(quantiles.get(0.99)):>9}"
+        )
+    if phase_rows:
+        lines.append("")
+        lines.append(
+            f"{'sched tick phase':<20} {'ticks':>7} {'mean':>9} "
+            f"{'p50':>9} {'p99':>9}"
+        )
+        lines.extend(phase_rows)
+        budget = _sample_value(samples, "sched_tick_budget_ratio")
+        if budget is not None and math.isfinite(budget):
+            lines.append(f"tick budget used: {budget:.2f}x")
+
+    roles = sorted(
+        {
+            labels.get("role", "")
+            for labels, _value in samples.get("obs_loop_lag_seconds_count", ())
+        }
+        - {""}
+    )
+    lag_rows: list[str] = []
+    for role in roles:
+        count = sum(
+            value
+            for labels, value in samples.get("obs_loop_lag_seconds_count", ())
+            if labels.get("role") == role
+        )
+        if count <= 0:
+            continue
+        quantiles = histogram_quantiles(
+            samples, "obs_loop_lag_seconds", (0.99,), where={"role": role}
+        ) or {}
+        episodes = sum(
+            value
+            for labels, value in samples.get(
+                "obs_loop_blocked_episodes_total", ()
+            )
+            if labels.get("role") == role
+        )
+        lag_rows.append(
+            f"{role:<12} {count:>7.0f} {_fmt_seconds(quantiles.get(0.99)):>9} "
+            f"{episodes:>8.0f}"
+        )
+    if lag_rows:
+        lines.append("")
+        lines.append(
+            f"{'loop lag':<12} {'samples':>7} {'p99':>9} {'blocked':>8}"
+        )
+        lines.extend(lag_rows)
+
+    by_tag: dict[str, dict[str, float]] = {}
+    for labels, value in samples.get("transport_message_bytes_total", ()):
+        tag = labels.get("tag", "?")
+        entry = by_tag.setdefault(tag, {"send": 0.0, "recv": 0.0})
+        direction = labels.get("direction", "send")
+        entry[direction if direction in entry else "send"] += value
+    talkers = sorted(
+        by_tag.items(), key=lambda kv: -(kv[1]["send"] + kv[1]["recv"])
+    )[:5]
+    if talkers:
+        lines.append("")
+        lines.append(
+            f"{'wire top talkers':<36} {'send':>10} {'recv':>10}"
+        )
+        for tag, entry in talkers:
+            lines.append(
+                f"{tag:<36.36} {_fmt_bytes(entry['send']):>10} "
+                f"{_fmt_bytes(entry['recv']):>10}"
+            )
+    return lines
 
 
 def _ha_shard_ids(samples: Samples) -> list[str]:
@@ -388,6 +513,7 @@ def render_dashboard(
             f"{str(alert.get('transition', '')).upper()}"
         )
 
+    lines.extend(_render_time_section(samples))
     lines.extend(_render_ha_section(samples))
 
     if history:

@@ -24,6 +24,10 @@ strings (empty = valid):
     (``t``) chains are legal: a per-process fragment (a worker daemon's
     own export) routes flows whose start and terminal live on the
     master's timeline; the merged cluster file carries all three.
+6.  Attribution tracks are self-contained: the ``sched`` (tick profiler)
+    and ``loop`` (loop-lag monitor) rows carry only complete (``X``) and
+    instant (``i``) events — a ``B``/``E`` or flow event landing there
+    means a merge folded another track onto an attribution row.
 
 ``scripts/validate_trace.py`` is the CLI wrapper; tests call these
 functions directly on every artifact they export.
@@ -69,6 +73,7 @@ def validate_trace_events(events: Iterable[Any]) -> list[str]:
     process_names: dict[Any, str] = {}
     thread_names: dict[tuple[Any, Any], str] = {}
     flow_events: list[dict[str, Any]] = []
+    phases_by_track: dict[tuple[Any, Any], set[str]] = {}
 
     for i, event in enumerate(events):
         if not isinstance(event, dict) or "ph" not in event:
@@ -94,6 +99,7 @@ def validate_trace_events(events: Iterable[Any]) -> list[str]:
                         f"({previous!r} vs {claimed!r})"
                     )
             continue
+        phases_by_track.setdefault(track, set()).add(str(ph))
         if not _finite_nonneg(event.get("ts")):
             problems.append(
                 f"event #{i} ({event.get('name')!r}, ph={ph!r}): "
@@ -126,6 +132,17 @@ def validate_trace_events(events: Iterable[Any]) -> list[str]:
         if stack:
             problems.append(
                 f"track {track}: {len(stack)} unclosed 'B' event(s): {stack}"
+            )
+
+    # Invariant 6: attribution tracks carry only self-contained events.
+    for track, name in thread_names.items():
+        if name not in ("sched", "loop"):
+            continue
+        stray = phases_by_track.get(track, set()) - {"X", "i"}
+        if stray:
+            problems.append(
+                f"track {track} ({name!r}): event phase(s) {sorted(stray)} "
+                f"on an attribution track (only 'X' and 'i' belong there)"
             )
 
     # Per-track monotonic end times (completion order is append order).

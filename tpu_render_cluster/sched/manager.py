@@ -46,6 +46,7 @@ from tpu_render_cluster.master.strategies import (
 from tpu_render_cluster.master.worker_handle import WorkerHandle
 from tpu_render_cluster.obs import MetricsRegistry, Tracer
 from tpu_render_cluster.sched import fair_share
+from tpu_render_cluster.sched.tickprof import TickProfiler
 from tpu_render_cluster.sched.models import (
     JOB_CANCELLED,
     JOB_FINISHED,
@@ -131,6 +132,11 @@ class JobManager(ClusterManager):
             ledger=ledger,
         )
         self.config = config if config is not None else SchedulerConfig.from_env()
+        self.tickprof = TickProfiler(
+            self.metrics,
+            self.span_tracer,
+            tick_budget_seconds=self.config.tick_seconds,
+        )
         self._runs: dict[str, JobRun] = {}  # job_id -> run, submit order
         self._admission: list[str] = []  # queued job_ids, submit order
         self._running: list[str] = []  # running job_ids, admission order
@@ -375,30 +381,43 @@ class JobManager(ClusterManager):
             if self._draining and not self._admission and not self._running:
                 return
             if self._running:
+                self.tickprof.begin_tick()
                 # Fold fresh completion observations into the shared cost
                 # model first: this tick's WFQ pick and speculation
                 # decisions price off the newest evidence.
-                self.cost_service.ingest(self.live_workers(), self._job_for_name)
-                inputs = self._share_inputs()
-                targets = self._compute_targets(inputs)
-                self._account_shares(dt, targets, inputs)
-                await self._dispatch_tick(inputs)
+                with self.tickprof.phase("pricing"):
+                    self.cost_service.ingest(
+                        self.live_workers(), self._job_for_name
+                    )
+                with self.tickprof.phase("share_scan"):
+                    inputs = self._share_inputs()
+                with self.tickprof.phase("fair_share"):
+                    targets = self._compute_targets(inputs)
+                    self._account_shares(dt, targets, inputs)
+                with self.tickprof.phase("dispatch"):
+                    await self._dispatch_tick(inputs)
                 if self.config.preemption:
-                    await self._preempt_tick()
+                    with self.tickprof.phase("preempt"):
+                        await self._preempt_tick()
                 if self.speculation.config.enabled:
                     # Tail hedging per running job AFTER dispatch: an idle
                     # worker only receives a speculative twin when no
                     # pending work exists for it (maybe_launch gates on
                     # the job's own pool; the dispatch pass above already
                     # consumed every globally-runnable frame this tick).
-                    workers = self.live_workers()
-                    for job_id in list(self._running):
-                        run = self._runs[job_id]
-                        if run.state is not None:
-                            await self.speculation.tick(
-                                run.spec.job, run.state, workers, job_id=job_id
-                            )
+                    with self.tickprof.phase("speculation"):
+                        workers = self.live_workers()
+                        for job_id in list(self._running):
+                            run = self._runs[job_id]
+                            if run.state is not None:
+                                await self.speculation.tick(
+                                    run.spec.job,
+                                    run.state,
+                                    workers,
+                                    job_id=job_id,
+                                )
                 self._finalize_finished_jobs(time.time())
+                self.tickprof.end_tick()
             await asyncio.sleep(self.config.tick_seconds)
 
     def _cancel_unadmittable_queued_jobs(self, now: float) -> None:

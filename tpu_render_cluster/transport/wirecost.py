@@ -1,0 +1,129 @@
+"""Per-message-tag wire-cost accounting around the protocol codec.
+
+Every control-plane byte crosses exactly one seam: ``encode_message`` on
+the way out and ``decode_message`` on the way in (protocol/messages.py).
+``WireAccounting`` wraps that seam with a metrics registry so both ends
+of a socket price their traffic per message tag —
+
+- ``transport_message_bytes_total{tag,direction}``: exact UTF-8 wire
+  payload bytes. ``encode_message`` emits ASCII-escaped JSON
+  (``json.dumps`` default ``ensure_ascii=True``), so ``len(text)`` IS
+  the byte count the WebSocket layer frames; the sender's ``send``
+  series and the receiver's ``recv`` series for a tag count the same
+  bytes and must agree exactly.
+- ``transport_serialize_seconds{tag,direction}``: time spent in
+  ``json.dumps``/``json.loads`` per message — the host-glue cost the
+  attribution report charges to transport, and the number ROADMAP
+  item 3's preserialized-dispatch idea has to beat.
+
+The accounting observes the text the codec already produces — it adds
+ZERO bytes on the wire (PROTOCOL.md notes this) and, with
+``metrics=None``, compiles down to the bare codec calls so call sites
+can wrap unconditionally.
+"""
+
+from __future__ import annotations
+
+import time
+
+from tpu_render_cluster.protocol import messages as pm
+
+__all__ = ["WireAccounting", "top_talkers"]
+
+BYTES_METRIC = "transport_message_bytes_total"
+SERIALIZE_METRIC = "transport_serialize_seconds"
+
+_BYTES_HELP = "Wire payload bytes by message tag and direction"
+_SERIALIZE_HELP = "Message JSON serialize/parse seconds by tag and direction"
+_LABELS = ("tag", "direction")
+
+
+class WireAccounting:
+    """Codec wrapper recording per-tag byte and serialize-time series.
+
+    One instance per connection endpoint (master handle, worker runtime,
+    handshake site); instances sharing a registry share series. With
+    ``metrics=None`` both methods are passthroughs to the codec.
+    """
+
+    def __init__(self, metrics=None) -> None:
+        self.metrics = metrics
+        if metrics is not None:
+            self._bytes = metrics.counter(BYTES_METRIC, _BYTES_HELP, labels=_LABELS)
+            self._seconds = metrics.histogram(
+                SERIALIZE_METRIC, _SERIALIZE_HELP, labels=_LABELS
+            )
+
+    def encode(self, message: pm.Message) -> str:
+        if self.metrics is None:
+            return pm.encode_message(message)
+        started = time.perf_counter()
+        text = pm.encode_message(message)
+        elapsed = time.perf_counter() - started
+        tag = message.type_name
+        self._seconds.observe(elapsed, tag=tag, direction="send")
+        self._bytes.inc(len(text), tag=tag, direction="send")
+        return text
+
+    def decode(self, text: str | bytes) -> pm.Message:
+        if self.metrics is None:
+            return pm.decode_message(text)
+        started = time.perf_counter()
+        message = pm.decode_message(text)
+        elapsed = time.perf_counter() - started
+        tag = message.type_name
+        self._seconds.observe(elapsed, tag=tag, direction="recv")
+        self._bytes.inc(len(text), tag=tag, direction="recv")
+        return message
+
+
+def top_talkers(snapshot: dict, *, limit: int = 5) -> list[dict]:
+    """Per-tag wire totals from a registry ``snapshot()``, biggest first.
+
+    Folds both directions per tag (on a single endpoint, send and recv
+    cover disjoint traffic, so the sum is that endpoint's total bytes
+    touching the wire). Returns ``[{tag, bytes, send_bytes, recv_bytes,
+    serialize_s}, ...]`` — the dashboard's top-talkers table and the
+    attribution report's transport detail both read off this.
+    """
+    by_tag: dict[str, dict] = {}
+    counter = snapshot.get(BYTES_METRIC)
+    if counter:
+        for key, value in counter.get("series", {}).items():
+            labels = _parse_label_key(key)
+            tag = labels.get("tag", "?")
+            row = by_tag.setdefault(
+                tag,
+                {"tag": tag, "bytes": 0.0, "send_bytes": 0.0, "recv_bytes": 0.0,
+                 "serialize_s": 0.0},
+            )
+            row["bytes"] += value
+            if labels.get("direction") == "send":
+                row["send_bytes"] += value
+            elif labels.get("direction") == "recv":
+                row["recv_bytes"] += value
+    histogram = snapshot.get(SERIALIZE_METRIC)
+    if histogram:
+        for key, series in histogram.get("series", {}).items():
+            labels = _parse_label_key(key)
+            tag = labels.get("tag", "?")
+            row = by_tag.setdefault(
+                tag,
+                {"tag": tag, "bytes": 0.0, "send_bytes": 0.0, "recv_bytes": 0.0,
+                 "serialize_s": 0.0},
+            )
+            row["serialize_s"] += float(series.get("sum", 0.0))
+    rows = sorted(by_tag.values(), key=lambda r: r["bytes"], reverse=True)
+    return rows[: max(0, limit)] if limit else rows
+
+
+def _parse_label_key(key: str) -> dict[str, str]:
+    """``"tag=ping,direction=send"`` -> labels dict (registry key form)."""
+    labels: dict[str, str] = {}
+    if not key:
+        return labels
+    for part in key.split(","):
+        name, sep, value = part.partition("=")
+        if sep:
+            labels[name] = value
+    return labels

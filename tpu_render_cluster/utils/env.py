@@ -119,6 +119,9 @@ declare("TRC_OBS_FLIGHT_SECONDS", "float", 60.0, "Flight-recorder bundle window"
 declare("TRC_OBS_FLIGHT_DEBOUNCE", "float", 5.0, "Min spacing between dumps per trigger kind")
 declare("TRC_OBS_FLIGHT_EVENTS", "int", 4096, "Flight-recorder protocol-digest ring size")
 declare("TRC_OBS_FLIGHT_DIR", "path", None, "Blackbox bundle directory")
+declare("TRC_OBS_LOOPMON_INTERVAL", "float", 0.25, "Event-loop lag probe interval")
+declare("TRC_OBS_LOOPMON_THRESHOLD", "float", 0.1, "Loop lag that counts as a blocked episode")
+declare("TRC_SCHED_PROFILE", "flag", 1, "Scheduler tick phase profiling on/off")
 # -- replicated control plane ------------------------------------------------
 declare("TRC_HA_LEDGER", "path", None, "Write-ahead job ledger directory (master --ledger default)")
 declare("TRC_HA_FSYNC", "flag", 1, "fsync after every ledger append")

@@ -17,7 +17,12 @@ import time
 from typing import Callable
 
 from tpu_render_cluster import PROTOCOL_VERSION
-from tpu_render_cluster.obs import MetricsRegistry, Tracer, get_registry
+from tpu_render_cluster.obs import (
+    LoopLagMonitor,
+    MetricsRegistry,
+    Tracer,
+    get_registry,
+)
 from tpu_render_cluster.protocol import messages as pm
 from tpu_render_cluster.traces.worker_trace import WorkerTrace, WorkerTraceBuilder
 from tpu_render_cluster.transport.actors import MessageRouter, SenderHandle
@@ -27,6 +32,7 @@ from tpu_render_cluster.transport.reconnect import (
     connect_with_exponential_backoff,
 )
 from tpu_render_cluster.transport.ws import WebSocketClosed, WebSocketConnection
+from tpu_render_cluster.transport.wirecost import WireAccounting
 from tpu_render_cluster.utils.cancellation import CancellationToken
 from tpu_render_cluster.worker.backends.base import RenderBackend
 from tpu_render_cluster.worker.queue import WorkerAutomaticQueue
@@ -50,6 +56,7 @@ async def _perform_handshake(
     *,
     is_reconnect: bool,
     last_epoch: int | None = None,
+    wire: WireAccounting | None = None,
 ) -> tuple[int | None, bool]:
     """Client side of the 3-step handshake; returns ``(epoch, fresh)``.
 
@@ -62,7 +69,9 @@ async def _perform_handshake(
     session to resume. ``fresh`` is True when a first-connection announce
     was sent.
     """
-    request = pm.decode_message(await ws.receive_text())
+    if wire is None:
+        wire = WireAccounting(None)  # bare-codec passthrough
+    request = wire.decode(await ws.receive_text())
     if not isinstance(request, pm.MasterHandshakeRequest):
         raise WebSocketClosed(f"Expected handshake request, got {type(request)}")
     announce_fresh = not is_reconnect or request.epoch != last_epoch
@@ -78,11 +87,11 @@ async def _perform_handshake(
         else pm.HANDSHAKE_TYPE_RECONNECTING
     )
     await ws.send_text(
-        pm.encode_message(
+        wire.encode(
             pm.WorkerHandshakeResponse(handshake_type, PROTOCOL_VERSION, worker_id)
         )
     )
-    ack = pm.decode_message(await ws.receive_text())
+    ack = wire.decode(await ws.receive_text())
     if not isinstance(ack, pm.MasterHandshakeAcknowledgement) or not ack.ok:
         if handshake_type == pm.HANDSHAKE_TYPE_RECONNECTING:
             # An epoch-less restarted master refuses reconnects from
@@ -123,6 +132,13 @@ class Worker:
         self.metrics = metrics if metrics is not None else get_registry()
         self.span_tracer = span_tracer or Tracer(
             f"worker-{pm.worker_id_to_string(self.worker_id)}"
+        )
+        # Worker-end wire accounting + event-loop lag probe: the same
+        # transport_*/obs_loop_* families the master exports, so both
+        # ends of every exchange (and both loops) are priced.
+        self._wire = WireAccounting(self.metrics)
+        self.loopmon = LoopLagMonitor(
+            self.metrics, role="worker", span_tracer=self.span_tracer
         )
         self.cancellation = CancellationToken()
         # Fault-injection seam: wraps every freshly-upgraded socket
@@ -195,6 +211,7 @@ class Worker:
                             self.worker_id,
                             is_reconnect=announce_reconnect,
                             last_epoch=self._master_epoch,
+                            wire=self._wire,
                         ),
                         HANDSHAKE_TIMEOUT,
                     )
@@ -226,11 +243,12 @@ class Worker:
             self.master_port,
         )
 
-        sender = SenderHandle(lambda m: client.send_text(pm.encode_message(m)))
+        sender = SenderHandle(lambda m: client.send_text(self._wire.encode(m)))
         sender.start()
+        self.loopmon.start()
 
         async def receive() -> pm.Message:
-            return pm.decode_message(await client.receive_text())
+            return self._wire.decode(await client.receive_text())
 
         router = MessageRouter(receive)
         # Subscribe BEFORE the receive loop can dispatch: the master pings
@@ -260,6 +278,7 @@ class Worker:
         finally:
             self.cancellation.cancel()
             heartbeat_task.cancel()
+            await self.loopmon.stop()
             await frame_queue.join()
             await router.stop()
             await sender.stop()
