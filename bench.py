@@ -16,6 +16,7 @@ trivially-lit scenes rendered at JPEG-preview quality in the reference runs.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import os
 import subprocess
@@ -926,6 +927,235 @@ def multi_job_bench(
     return record
 
 
+def _sched_env(overrides: dict) -> dict:
+    """Apply env overrides, returning the saved values for restore."""
+    saved = {}
+    for key, value in overrides.items():
+        saved[key] = os.environ.get(key)
+        if value is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = str(value)
+    return saved
+
+
+def sched_bench(
+    jobs: int = 64,
+    frames: int = 600,
+    workers: int = 2,
+    reps: int = 3,
+    queue_size: int = 4,
+    tick_seconds: float = 0.002,
+    scale_jobs: int = 16,
+    window_seconds: float = 3.0,
+    warmup_seconds: float = 0.5,
+) -> dict:
+    """Control-plane hot path A/B: incremental heap WFQ + preserialized
+    dispatch frames vs the legacy full-rescan tick + per-send JSON.
+
+    The SAME workload — ``jobs`` concurrent mock-render jobs, each with
+    a ``frames``-frame backlog deep enough that NO job finishes inside
+    the measurement window, over ``workers`` in-process workers with
+    instant renders — runs once per rep under each stack:
+    ``TRC_SCHED_TICK=scan + TRC_DISPATCH_FRAMES=encode`` (the pre-PR-17
+    baseline) and ``TRC_SCHED_TICK=heap + TRC_DISPATCH_FRAMES=cached``.
+    A driver waits until all ``jobs`` jobs are running, warms up for
+    ``warmup_seconds``, then measures **assignments per second** over a
+    fixed ``window_seconds`` window: queue-add messages actually sent
+    (the ``transport_serialize_seconds{tag,direction=send}`` count
+    delta), after which every job is cancelled and the service drains.
+    The fixed window is the point: at steady state the legacy tick pays
+    Θ(jobs × frames) per 2 ms cadence to re-derive what changed, so the
+    dispatch rate collapses as the concurrent backlog grows, while the
+    heap tick's O(dirty · log jobs) resync holds the line. Interleaved
+    reps, median per mode (the bench-variance protocol).
+
+    Also recorded: the ``share_scan`` tick-phase p99 per mode and, for
+    the heap stack, at ``scale_jobs`` vs ``jobs`` concurrent jobs — the
+    incremental tick's resync must grow SUBLINEARLY in job count where
+    the legacy scan is Θ(jobs × frames). Every run additionally asserts
+    exact both-ends wire accounting: the master's send bytes for
+    ``request_frame-queue_add`` must equal the workers' summed recv
+    bytes (the preserialized splice adds zero bytes and books the true
+    wire text).
+    """
+    import statistics
+
+    from tpu_render_cluster.harness.local import run_local_multi_job
+    from tpu_render_cluster.jobs.models import BlenderJob, DistributionStrategy
+    from tpu_render_cluster.obs.history import quantile_from_bucket_counts
+    from tpu_render_cluster.sched.models import JobSpec
+    from tpu_render_cluster.sched.tickprof import TICK_METRIC
+    from tpu_render_cluster.transport.wirecost import (
+        BYTES_METRIC,
+        SERIALIZE_METRIC,
+    )
+    from tpu_render_cluster.worker.backends.mock import MockBackend
+
+    TAG = "request_frame-queue_add"
+
+    def make_spec(index: int) -> JobSpec:
+        job = BlenderJob(
+            job_name=f"bench-sched-{index:03d}",
+            job_description="control-plane hot-path bench",
+            project_file_path="%BASE%/p.blend",
+            render_script_path="%BASE%/s.py",
+            frame_range_from=1,
+            frame_range_to=frames,
+            wait_for_number_of_workers=workers,
+            frame_distribution_strategy=DistributionStrategy.naive_fine(),
+            output_directory_path="%BASE%/out",
+            output_file_name_format="rendered-#####",
+            output_file_format="PNG",
+        )
+        return JobSpec(job=job, weight=1.0 + (index % 3))
+
+    def tag_series_total(snapshot: dict, name: str, direction: str) -> float:
+        total = 0.0
+        for key, value in snapshot.get(name, {}).get("series", {}).items():
+            if f"tag={TAG}" in key and f"direction={direction}" in key:
+                total += value["count"] if isinstance(value, dict) else value
+        return total
+
+    def run_once(mode: str, job_count: int) -> dict:
+        window: dict = {}
+
+        async def burst_driver(manager, _workers) -> None:
+            job_ids = list(manager._runs.keys())
+            while (
+                sum(
+                    1
+                    for job_id in job_ids
+                    if manager.job_status(job_id)["status"] == "running"
+                )
+                < job_count
+            ):
+                await asyncio.sleep(0.01)
+            await asyncio.sleep(warmup_seconds)
+            sends_0 = tag_series_total(
+                manager.metrics.snapshot(), SERIALIZE_METRIC, "send"
+            )
+            t0 = time.perf_counter()
+            await asyncio.sleep(window_seconds)
+            sends_1 = tag_series_total(
+                manager.metrics.snapshot(), SERIALIZE_METRIC, "send"
+            )
+            window["assignments"] = sends_1 - sends_0
+            window["seconds"] = time.perf_counter() - t0
+            for job_id in job_ids:
+                await manager.cancel_job(job_id)
+
+        saved = _sched_env(
+            {
+                "TRC_SCHED_TICK": mode,
+                "TRC_DISPATCH_FRAMES": "cached" if mode == "heap" else "encode",
+                "TRC_SCHED_MAX_ACTIVE_JOBS": job_count,
+                "TRC_SCHED_TICK_SECONDS": tick_seconds,
+                "TRC_SCHED_TARGET_QUEUE_SIZE": queue_size,
+            }
+        )
+        try:
+            specs = [make_spec(i) for i in range(job_count)]
+            backends = [MockBackend(render_seconds=0.0) for _ in range(workers)]
+            _traces, _job_ids, manager, worker_list = run_local_multi_job(
+                specs, backends, timeout=600.0, driver=burst_driver
+            )
+        finally:
+            _sched_env(saved)
+        snapshot = manager.metrics.snapshot()
+        assignments = window["assignments"]
+        sent_bytes = tag_series_total(snapshot, BYTES_METRIC, "send")
+        recv_bytes = sum(
+            tag_series_total(w.metrics.snapshot(), BYTES_METRIC, "recv")
+            for w in worker_list
+        )
+        # Exact both-ends agreement: the splice path books the true wire
+        # text, never a re-encode — a single byte of drift fails the run.
+        assert sent_bytes == recv_bytes, (
+            f"wirecost disagreement ({mode}): master sent {sent_bytes} "
+            f"bytes, workers received {recv_bytes}"
+        )
+        hist = manager.metrics.histogram(TICK_METRIC, labels=("phase",))
+        series = hist.series(phase="share_scan")
+        p99 = (
+            quantile_from_bucket_counts(
+                list(hist.buckets),
+                list(series.counts) + [series.overflow],
+                0.99,
+            )
+            if series is not None
+            else None
+        )
+        return {
+            "window_s": window["seconds"],
+            "assignments": assignments,
+            "assignments_per_s": assignments / window["seconds"],
+            "share_scan_p99_s": p99,
+            "wire_send_bytes": sent_bytes,
+            "wire_recv_bytes": recv_bytes,
+        }
+
+    per_mode: dict[str, list[dict]] = {"scan": [], "heap": []}
+    for _rep in range(reps):
+        # Interleaved A/B: machine-load drift cancels across modes.
+        per_mode["scan"].append(run_once("scan", jobs))
+        per_mode["heap"].append(run_once("heap", jobs))
+    # One heap run at the smaller job count for the sublinearity check
+    # (same stack, only the concurrency changes).
+    scale_run = run_once("heap", scale_jobs)
+
+    def median_of(mode: str, field: str) -> float:
+        return statistics.median(r[field] for r in per_mode[mode])
+
+    record = {
+        "metric": (
+            f"sched control-plane A/B: {jobs} concurrent jobs x {frames}-"
+            f"frame backlogs, {workers} workers, instant mock render, "
+            f"tick {tick_seconds}s, queue {queue_size}, "
+            f"{window_seconds}s steady-state window"
+        ),
+        "unit": "assignments/s (median of interleaved reps)",
+        "jobs": jobs,
+        "frames_per_job": frames,
+        "workers": workers,
+        "reps": reps,
+        "tick_seconds": tick_seconds,
+        "target_queue_size": queue_size,
+        "window_seconds": window_seconds,
+        "scan": {
+            "tick_mode": "scan + per-send encode",
+            "assignments_per_s": round(median_of("scan", "assignments_per_s"), 1),
+            "share_scan_p99_s": median_of("scan", "share_scan_p99_s"),
+        },
+        "heap": {
+            "tick_mode": "heap + preserialized frames",
+            "assignments_per_s": round(median_of("heap", "assignments_per_s"), 1),
+            "share_scan_p99_s": median_of("heap", "share_scan_p99_s"),
+        },
+        "wirecost_exact_agreement": True,  # asserted per run above
+    }
+    record["speedup_assignments_per_s"] = round(
+        record["heap"]["assignments_per_s"]
+        / max(1e-9, record["scan"]["assignments_per_s"]),
+        3,
+    )
+    # Sublinearity: heap share_scan p99 at `jobs` vs `scale_jobs`
+    # concurrent jobs must grow slower than the job-count ratio.
+    p99_small = scale_run["share_scan_p99_s"]
+    p99_large = record["heap"]["share_scan_p99_s"]
+    record["share_scan_scaling"] = {
+        "jobs_small": scale_jobs,
+        "p99_small_s": p99_small,
+        "jobs_large": jobs,
+        "p99_large_s": p99_large,
+        "p99_growth": (
+            round(p99_large / p99_small, 3) if p99_small else None
+        ),
+        "job_count_ratio": round(jobs / scale_jobs, 3),
+    }
+    return record
+
+
 def _ha_shard_process(conn, worker_count: int, render_seconds: float) -> None:
     """One master SHARD as its own OS process (multiprocessing spawn
     target; must stay module-level picklable).
@@ -1680,6 +1910,27 @@ def main() -> int:
         record = multi_job_bench(jobs=jobs, frames=frames, workers=workers, reps=reps)
         record["command"] = (
             f"python bench.py --multi-job --jobs {jobs} --frames {frames} "
+            f"--workers {workers} --reps {reps}"
+        )
+        print(json.dumps(record))
+        out_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "results",
+            "MULTIJOB_BENCH.json",
+        )
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
+        return 0
+
+    if "--sched" in sys.argv:
+        jobs = _int_flag("--jobs", 64)
+        frames = _int_flag("--frames", 600)
+        workers = _int_flag("--workers", 2)
+        reps = _int_flag("--reps", 3)
+        record = sched_bench(jobs=jobs, frames=frames, workers=workers, reps=reps)
+        record["command"] = (
+            f"python bench.py --sched --jobs {jobs} --frames {frames} "
             f"--workers {workers} --reps {reps}"
         )
         print(json.dumps(record))

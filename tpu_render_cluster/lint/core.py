@@ -160,6 +160,7 @@ class LintContext:
     # Overrides for tests (None -> the real registry / document).
     env_registry: dict[str, Any] | None = None
     wire_registry: dict[str, Any] | None = None
+    frame_segments: dict[str, Any] | None = None
     readme_text: str | None = None
     protocol_text: str | None = None
     # Dotted-name suffixes locating the codebase-native anchor modules.

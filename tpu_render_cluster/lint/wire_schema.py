@@ -159,15 +159,128 @@ def _message_classes(module: SourceModule):
             yield node, tag
 
 
+def _check_frame_segments(
+    ctx: LintContext, registry: dict, segments: dict
+) -> list[Finding]:
+    """Preserialized-frame contract (PR 17): every declared segment split
+    must exactly partition its tag's payload keys, the splice codec must
+    cover every key it is responsible for, and PROTOCOL.md must carry the
+    split's documentation (the no-bytes-added guarantee)."""
+    findings: list[Finding] = []
+    schema_path = "protocol/schema.py"
+    for tag, seg in sorted(segments.items()):
+        schema = registry.get(tag)
+        if schema is None:
+            findings.append(
+                Finding(
+                    PASS_ID,
+                    schema_path,
+                    1,
+                    f"FRAME_SEGMENTS declares segments for {tag!r}, which "
+                    "no wire schema declares",
+                )
+            )
+            continue
+        constant, varying = set(seg.constant), set(seg.varying)
+        overlap = constant & varying
+        if overlap:
+            findings.append(
+                Finding(
+                    PASS_ID,
+                    schema_path,
+                    1,
+                    f"{tag}: segment keys {sorted(overlap)} are declared "
+                    "both constant and varying",
+                )
+            )
+        declared = set(schema.required) | set(schema.optional)
+        if constant | varying != declared:
+            missing = sorted(declared - constant - varying)
+            extra = sorted((constant | varying) - declared)
+            findings.append(
+                Finding(
+                    PASS_ID,
+                    schema_path,
+                    1,
+                    f"{tag}: segment split must exactly partition the "
+                    f"declared payload keys (missing {missing}, "
+                    f"undeclared {extra})",
+                )
+            )
+        # The splice codec must mention every key as a JSON splice point:
+        # a key it cannot emit would silently vanish from the wire.
+        frames_module = ctx.module_by_suffix("protocol.frames")
+        if frames_module is not None:
+            literals = "".join(
+                node.value
+                for node in ast.walk(frames_module.tree)
+                if isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+            )
+            for key in sorted((constant | varying) & declared):
+                if f'"{key}":' not in literals:
+                    findings.append(
+                        Finding(
+                            PASS_ID,
+                            frames_module.relpath,
+                            1,
+                            f"{tag}: segment key {key!r} has no splice "
+                            "point in the frame codec",
+                        )
+                    )
+        # PROTOCOL.md: the split is a documented contract, like the
+        # optional-key rows in the message table.
+        doc = ctx.protocol_md()
+        if doc and "Preserialized dispatch frames" not in doc:
+            findings.append(
+                Finding(
+                    PASS_ID,
+                    "PROTOCOL.md",
+                    1,
+                    f"{tag}: declares a preserialized segment split but "
+                    'PROTOCOL.md has no "Preserialized dispatch frames" '
+                    "section",
+                )
+            )
+        elif doc:
+            section = doc.split("Preserialized dispatch frames", 1)[1]
+            for key in sorted(constant):
+                if f"`{key}`" not in section:
+                    findings.append(
+                        Finding(
+                            PASS_ID,
+                            "PROTOCOL.md",
+                            1,
+                            f"{tag}: constant segment key `{key}` is not "
+                            'mentioned in the "Preserialized dispatch '
+                            'frames" section',
+                        )
+                    )
+    return findings
+
+
 def run(ctx: LintContext) -> list[Finding]:
     if ctx.wire_registry is not None:
         registry = dict(ctx.wire_registry)
+        # Fixture mode: segment checks only run when the fixture supplies
+        # segments too (tests exercising the classic key checks must not
+        # trip on the real package's segment registry).
+        segments = dict(ctx.frame_segments or {})
     else:
-        from tpu_render_cluster.protocol.schema import WIRE_SCHEMAS
+        from tpu_render_cluster.protocol.schema import (
+            FRAME_SEGMENTS,
+            WIRE_SCHEMAS,
+        )
 
         registry = dict(WIRE_SCHEMAS)
+        segments = (
+            dict(ctx.frame_segments)
+            if ctx.frame_segments is not None
+            else dict(FRAME_SEGMENTS)
+        )
 
     findings: list[Finding] = []
+    findings.extend(_check_frame_segments(ctx, registry, segments))
     module = ctx.module_by_suffix(ctx.messages_module_suffix)
     if module is None:
         return [

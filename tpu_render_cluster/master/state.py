@@ -112,6 +112,23 @@ class ClusterManagerState:
         }
         self._pending: deque[WorkUnit] = deque(job.work_units())
         self._finished_count = 0
+        # Mutation counter, bumped by every frame transition (status OR
+        # worker reassignment). The incremental WFQ (sched/wfq.py) keys
+        # its per-job resync off this: a job whose version is unchanged
+        # since the last tick cannot have changed demand, load, or the
+        # worker placement its cost prediction depends on, so the tick
+        # skips it entirely. Evictions, goodbyes, steals, late results,
+        # and ledger replay all funnel through these transitions, so no
+        # event source needs separate instrumentation.
+        self.version: int = 0
+        # O(1) mirrors of the status population. ``_pending_live`` counts
+        # frames whose STATUS is PENDING (the deque may briefly hold
+        # stale or duplicate entries; status is the truth);
+        # ``_in_flight_units`` maps each QUEUED/RENDERING unit to the
+        # worker currently holding it — exactly the set the cost model
+        # prices for a job's in-flight load, without an O(frames) scan.
+        self._pending_live: int = len(self.frames)
+        self._in_flight_units: dict[WorkUnit, int] = {}
         # Per-job exactly-once ledger, updated by WorkerHandle at the same
         # points as the global ``master_*_results_total`` counters so the
         # PR-4 chaos invariant (ok - duplicates == units_total) can be
@@ -167,19 +184,18 @@ class ClusterManagerState:
         return self._finished_count
 
     def pending_count(self) -> int:
-        return sum(
-            1 for u in self._pending if self.frames[u].status is FrameStatus.PENDING
-        )
+        """Frames whose status is PENDING (O(1): maintained counter)."""
+        return self._pending_live
 
     def in_flight_count(self) -> int:
         """Units currently queued-on or rendering-on some worker — the
-        quantity the fair-share scheduler meters per job."""
-        return sum(
-            1
-            for record in self.frames.values()
-            if record.status
-            in (FrameStatus.QUEUED_ON_WORKER, FrameStatus.RENDERING_ON_WORKER)
-        )
+        quantity the fair-share scheduler meters per job (O(1))."""
+        return len(self._in_flight_units)
+
+    def in_flight_units(self) -> dict[WorkUnit, int]:
+        """Live view of queued/rendering units -> holding worker id.
+        Callers must not mutate it; the transitions below own it."""
+        return self._in_flight_units
 
     def pending_units(self, limit: int | None = None) -> list[WorkUnit]:
         out = []
@@ -234,6 +250,30 @@ class ClusterManagerState:
     def _as_unit(unit: "WorkUnit | int") -> WorkUnit:
         return WorkUnit(unit) if isinstance(unit, int) else unit
 
+    def _retrack(self, record: FrameRecord, old: FrameStatus) -> None:
+        """Fold one applied transition into the O(1) mirrors + version.
+
+        Called AFTER the record's status/worker fields are updated. Every
+        transition must come through here — the scheduler's incremental
+        structures trust ``version`` to cover all demand/load/placement
+        changes, including worker reassignments that keep the status.
+        """
+        new = record.status
+        if old is FrameStatus.PENDING:
+            if new is not FrameStatus.PENDING:
+                self._pending_live -= 1
+        elif new is FrameStatus.PENDING:
+            self._pending_live += 1
+        if (
+            new
+            in (FrameStatus.QUEUED_ON_WORKER, FrameStatus.RENDERING_ON_WORKER)
+            and record.worker_id is not None
+        ):
+            self._in_flight_units[record.unit] = record.worker_id
+        else:
+            self._in_flight_units.pop(record.unit, None)
+        self.version += 1
+
     def mark_frame_as_queued(
         self,
         unit: "WorkUnit | int",
@@ -247,6 +287,7 @@ class ClusterManagerState:
         record = self.frames[unit]
         if record.status is FrameStatus.FINISHED:
             raise ValueError(f"BUG: unit {unit.label} is already finished.")
+        old = record.status
         record.status = FrameStatus.QUEUED_ON_WORKER
         record.worker_id = worker_id
         record.queued_at = queued_at
@@ -255,6 +296,7 @@ class ClusterManagerState:
             record.stolen_at = stolen_at
         if self._pending and self._pending[0] == unit:
             self._pending.popleft()
+        self._retrack(record, old)
 
     def mark_frame_as_rendering(
         self, unit: "WorkUnit | int", worker_id: int
@@ -263,8 +305,10 @@ class ClusterManagerState:
         record = self.frames[unit]
         if record.status is FrameStatus.FINISHED:
             return  # late event after a race; harmless
+        old = record.status
         record.status = FrameStatus.RENDERING_ON_WORKER
         record.worker_id = worker_id
+        self._retrack(record, old)
 
     def mark_frame_as_finished(self, unit: "WorkUnit | int") -> bool:
         """Transition a unit to FINISHED; returns True when this call
@@ -275,7 +319,9 @@ class ClusterManagerState:
         record = self.frames[unit]
         if record.status is FrameStatus.FINISHED:
             return False
+        old = record.status
         record.status = FrameStatus.FINISHED
+        self._retrack(record, old)
         self._finished_count += 1
         if self.on_unit_finished is not None:
             self.on_unit_finished(unit)
@@ -304,7 +350,9 @@ class ClusterManagerState:
         record = self.frames[unit]
         if record.status in (FrameStatus.FINISHED, FrameStatus.PENDING):
             return
+        old = record.status
         record.status = FrameStatus.PENDING
         record.worker_id = None
         record.queued_at = None
         self._pending.append(unit)
+        self._retrack(record, old)

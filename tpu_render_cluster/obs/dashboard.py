@@ -34,6 +34,7 @@ from __future__ import annotations
 import argparse
 import json
 import math
+import os
 import sys
 import time
 import urllib.error
@@ -247,11 +248,35 @@ def _fmt_bytes(value: float) -> str:
     return f"{value:.1f}GiB"  # unreachable; keeps the signature total
 
 
-def _render_time_section(samples: Samples) -> list[str]:
+def load_sched_bench(path: str | None = None) -> dict[str, Any] | None:
+    """The committed control-plane A/B record (``bench.py --sched`` →
+    ``results/SCHED_BENCH.json``), or None when absent/unreadable — the
+    dashboard must render fine on a checkout that never ran the bench."""
+    if path is None:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)
+            ))),
+            "results",
+            "SCHED_BENCH.json",
+        )
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            record = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return record if isinstance(record, dict) else None
+
+
+def _render_time_section(
+    samples: Samples, sched_bench: dict[str, Any] | None = None
+) -> list[str]:
     """The "where did the time go" panel: sched-tick phase costs, event
-    loop lag per role, and the wire's top talkers — all reconstructed
-    from the attribution metric families, all optional (a pre-PR-16
-    endpoint or an idle cluster just renders nothing here)."""
+    loop lag per role, the wire's top talkers — all reconstructed from
+    the attribution metric families, all optional (a pre-PR-16 endpoint
+    or an idle cluster just renders nothing here) — plus, when a
+    committed ``results/SCHED_BENCH.json`` exists, the before/after
+    control-plane A/B (assignments/s and share_scan p99 per tick mode)."""
     lines: list[str] = []
 
     phases = sorted(
@@ -350,6 +375,33 @@ def _render_time_section(samples: Samples) -> list[str]:
                 f"{tag:<36.36} {_fmt_bytes(entry['send']):>10} "
                 f"{_fmt_bytes(entry['recv']):>10}"
             )
+
+    if sched_bench:
+        rows: list[str] = []
+        for mode in ("scan", "heap"):
+            entry = sched_bench.get(mode)
+            if not isinstance(entry, dict):
+                continue
+            rate = entry.get("assignments_per_s")
+            p99 = entry.get("share_scan_p99_s")
+            rows.append(
+                f"{str(entry.get('tick_mode', mode)):<32.32} "
+                f"{rate if rate is not None else '-':>9} "
+                f"{_fmt_seconds(p99):>9}"
+            )
+        if rows:
+            lines.append("")
+            lines.append(
+                f"{'sched A/B (SCHED_BENCH.json)':<32} {'assign/s':>9} "
+                f"{'scan p99':>9}"
+            )
+            lines.extend(rows)
+            speedup = sched_bench.get("speedup_assignments_per_s")
+            if isinstance(speedup, (int, float)):
+                lines.append(
+                    f"speedup {speedup:.2f}x @ "
+                    f"{sched_bench.get('jobs', '?')} concurrent jobs"
+                )
     return lines
 
 
@@ -411,6 +463,7 @@ def render_dashboard(
     *,
     history: dict[str, Any] | None = None,
     now: float | None = None,
+    sched_bench: dict[str, Any] | None = None,
 ) -> str:
     """One dashboard frame as plain text (pure: canned payloads in, text
     out — the tests and --once path share it with the live loop)."""
@@ -513,7 +566,7 @@ def render_dashboard(
             f"{str(alert.get('transition', '')).upper()}"
         )
 
-    lines.extend(_render_time_section(samples))
+    lines.extend(_render_time_section(samples, sched_bench=sched_bench))
     lines.extend(_render_ha_section(samples))
 
     if history:
@@ -552,6 +605,7 @@ def main(argv: list[str] | None = None) -> int:
         help="Print one frame and exit (scripts, smoke tests)",
     )
     args = parser.parse_args(argv)
+    sched_bench = load_sched_bench()  # static artifact: load once, not per frame
     while True:
         try:
             samples, clusterz = fetch_endpoints(args.host, args.port)
@@ -562,7 +616,9 @@ def main(argv: list[str] | None = None) -> int:
         except (OSError, urllib.error.URLError, ValueError) as e:
             frame = f"telemetry endpoint unreachable: {e}\n"
         else:
-            frame = render_dashboard(samples, clusterz, history=history)
+            frame = render_dashboard(
+                samples, clusterz, history=history, sched_bench=sched_bench
+            )
         if args.once:
             sys.stdout.write(frame)
             return 0

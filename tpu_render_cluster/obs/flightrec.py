@@ -70,6 +70,7 @@ TRIGGER_JOB_FAILURE = "job_failure"
 TRIGGER_EPOCH_FENCE = "epoch_fence"
 TRIGGER_MASTER_FAILOVER = "master_failover"
 TRIGGER_LOOP_LAG = "loop_lag"
+TRIGGER_TICK_BUDGET = "tick_budget"
 
 
 def flight_window_seconds() -> float:

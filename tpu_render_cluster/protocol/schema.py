@@ -42,6 +42,44 @@ class WireSchema:
         return frozenset(self.required) | frozenset(self.optional)
 
 
+@dataclass(frozen=True)
+class FrameSegments:
+    """Constant/varying payload split for a preserialized wire tag.
+
+    Tags listed in :data:`FRAME_SEGMENTS` may be encoded by a splice
+    codec (``protocol/frames.py``) that serializes the CONSTANT keys
+    once per cache generation and splices the VARYING keys per message.
+    The split is a pure encoding strategy — the wire bytes must remain
+    identical to ``encode_message``'s — but it is still contract: the
+    two sets must exactly partition the tag's declared keys (required +
+    optional), which the ``wire-schema`` lint enforces along with a
+    PROTOCOL.md section documenting the split.
+    """
+
+    tag: str
+    constant: tuple[str, ...]
+    varying: tuple[str, ...]
+
+
+FRAME_SEGMENTS: dict[str, FrameSegments] = {
+    segments.tag: segments
+    for segments in (
+        FrameSegments(
+            "request_frame-queue_add",
+            constant=("job",),
+            varying=(
+                "message_request_id",
+                "frame_index",
+                "trace",
+                "job_id",
+                "tile",
+                "epoch",
+            ),
+        ),
+    )
+}
+
+
 WIRE_SCHEMAS: dict[str, WireSchema] = {
     schema.tag: schema
     for schema in (

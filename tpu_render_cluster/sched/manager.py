@@ -55,8 +55,9 @@ from tpu_render_cluster.sched.models import (
     JobRun,
     JobSpec,
 )
+from tpu_render_cluster.sched.wfq import IncrementalWFQ
 from tpu_render_cluster.traces.worker_trace import WorkerTrace
-from tpu_render_cluster.utils.env import env_float, env_int
+from tpu_render_cluster.utils.env import env_float, env_int, env_str
 
 logger = logging.getLogger(__name__)
 
@@ -81,9 +82,24 @@ class SchedulerConfig:
     # worker connects get that long to satisfy the barrier); without it a
     # drained service would park forever on an unadmittable job.
     drain_barrier_grace_seconds: float = 10.0
+    # Tick pick structure (sched/wfq.py): "heap" keeps per-job WFQ keys
+    # in an incrementally synced priority queue (dispatch pick = heap
+    # peek, share resync only for jobs whose state changed); "scan" is
+    # the legacy full-rescan path kept as fallback and A/B baseline;
+    # "verify" runs both and asserts every pick agrees (debug — it also
+    # pins load metering to unit counts, the regime where heap-vs-scan
+    # equivalence is exact rather than within the scan's tie tolerance).
+    tick_mode: str = "heap"
 
     @classmethod
     def from_env(cls) -> "SchedulerConfig":
+        tick_mode = (env_str("TRC_SCHED_TICK", cls.tick_mode) or "").strip()
+        if tick_mode not in ("heap", "scan", "verify"):
+            logger.warning(
+                "Ignoring unknown TRC_SCHED_TICK=%r; using %r",
+                tick_mode, cls.tick_mode,
+            )
+            tick_mode = cls.tick_mode
         return cls(
             tick_seconds=env_float("TRC_SCHED_TICK_SECONDS", cls.tick_seconds),
             target_queue_size=env_int(
@@ -99,6 +115,7 @@ class SchedulerConfig:
             drain_barrier_grace_seconds=env_float(
                 "TRC_SCHED_DRAIN_GRACE_SECONDS", cls.drain_barrier_grace_seconds
             ),
+            tick_mode=tick_mode,
         )
 
 
@@ -136,7 +153,12 @@ class JobManager(ClusterManager):
             self.metrics,
             self.span_tracer,
             tick_budget_seconds=self.config.tick_seconds,
+            flightrec=self.flightrec,
         )
+        # Incremental WFQ pick structure (heap/verify tick modes): synced
+        # per tick for DIRTY jobs only (state.version mismatch), so the
+        # share_scan phase is O(changed jobs), not O(jobs x frames).
+        self._wfq = IncrementalWFQ()
         self._runs: dict[str, JobRun] = {}  # job_id -> run, submit order
         self._admission: list[str] = []  # queued job_ids, submit order
         self._running: list[str] = []  # running job_ids, admission order
@@ -279,6 +301,7 @@ class JobManager(ClusterManager):
             # Deactivate so in-flight events/dispatches resolve to
             # "defunct job" instead of mutating the frozen frame table.
             self._running.remove(job_id)
+            self._wfq.remove(job_id)
             self._active_by_name.pop(run.job_name, None)
             self._finish_run(run, JOB_CANCELLED, now)
             for worker in self.live_workers():
@@ -390,7 +413,7 @@ class JobManager(ClusterManager):
                         self.live_workers(), self._job_for_name
                     )
                 with self.tickprof.phase("share_scan"):
-                    inputs = self._share_inputs()
+                    inputs = self._tick_inputs()
                 with self.tickprof.phase("fair_share"):
                     targets = self._compute_targets(inputs)
                     self._account_shares(dt, targets, inputs)
@@ -618,6 +641,7 @@ class JobManager(ClusterManager):
                         lambda name: state if name == job_name else None
                     )
                 self._running.remove(job_id)
+                self._wfq.remove(job_id)
                 self._active_by_name.pop(run.job_name, None)
                 self._finish_run(run, JOB_FINISHED, now)
 
@@ -645,7 +669,17 @@ class JobManager(ClusterManager):
             )
         return total
 
-    def _share_inputs(self) -> list[fair_share.JobShareInput]:
+    def _share_inputs(
+        self, include_cost: bool | None = None
+    ) -> list[fair_share.JobShareInput]:
+        """Full rescan of every running job's share inputs (the legacy
+        ``scan`` tick path, and the oracle ``verify`` mode checks the
+        heap against). ``include_cost=False`` pins load metering to unit
+        counts — verify mode does this on BOTH sides, because heap-vs-
+        scan equivalence is exact there while cost predictions refresh
+        on different schedules (per tick vs per dirty job)."""
+        if include_cost is None:
+            include_cost = self.config.tick_mode != "verify"
         out = []
         for job_id in self._running:
             run = self._runs[job_id]
@@ -657,10 +691,113 @@ class JobManager(ClusterManager):
                     priority=run.spec.priority,
                     in_flight=run.state.in_flight_count(),
                     pending=run.state.pending_count(),
-                    in_flight_cost=self._in_flight_cost(run),
+                    in_flight_cost=(
+                        self._in_flight_cost(run) if include_cost else None
+                    ),
                 )
             )
         return out
+
+    # -- incremental WFQ (heap/verify tick modes) -----------------------------
+
+    def _cost_metered(self) -> bool:
+        return (
+            self.config.tick_mode != "verify"
+            and self.cost_service.model.has_history()
+        )
+
+    def _sync_wfq(self) -> None:
+        """Resync the WFQ entries of jobs whose state CHANGED since their
+        last sync (the dirty set — state.version covers every transition,
+        including evictions and steals that only move a unit between
+        workers), drop departed jobs, and admit new ones. Pricing a dirty
+        job walks its in-flight units (bounded by the pool's slots), not
+        its whole frame table."""
+        running = set(self._running)
+        for job_id in self._wfq.job_ids():
+            if job_id not in running:
+                self._wfq.remove(job_id)
+        cost_on = self._cost_metered()
+        for job_id in self._running:
+            run = self._runs[job_id]
+            state = run.state
+            assert state is not None
+            if not self._wfq.needs_sync(job_id, state.version, cost_on):
+                continue
+            cost = None
+            if cost_on:
+                cost = 0.0
+                for unit, worker_id in state.in_flight_units().items():
+                    cost += self.cost_service.predict_unit_seconds(
+                        worker_id, unit, run.spec.job
+                    )
+            self._wfq.sync(
+                job_id,
+                weight=run.spec.weight,
+                priority=run.spec.priority,
+                in_flight=state.in_flight_count(),
+                pending=state.pending_count(),
+                cost=cost,
+                state_version=state.version,
+            )
+
+    def _tick_inputs(self) -> list[fair_share.JobShareInput]:
+        """This tick's share inputs: a full rescan in ``scan`` mode, a
+        dirty-jobs-only resync + O(jobs) entry read otherwise."""
+        if self.config.tick_mode == "scan":
+            return self._share_inputs()
+        self._sync_wfq()
+        return self._wfq.inputs()
+
+    def _verify_pick(
+        self,
+        heap_pick: str | None,
+        scan_inputs: list[fair_share.JobShareInput],
+    ) -> None:
+        """``verify`` tick mode: assert the heap's dispatch pick matches
+        the legacy scan's over the same mid-tick information (the local
+        dispatch counters — both sides see dispatches they made, neither
+        sees events that landed during awaits). Picks inside the scan's
+        ``_EPS`` tie tolerance (same priority, keys within 1e-9) may
+        legitimately resolve either way; anything wider is a sync bug."""
+        scan_pick = fair_share.pick_job_to_dispatch(scan_inputs)
+        if heap_pick == scan_pick:
+            return
+        by_id = {job.job_id: job for job in scan_inputs}
+        a = by_id.get(heap_pick) if heap_pick is not None else None
+        b = by_id.get(scan_pick) if scan_pick is not None else None
+        if (
+            a is not None
+            and b is not None
+            and a.priority == b.priority
+            and abs(a.load / a.weight - b.load / b.weight) <= 1e-9
+        ):
+            return
+        raise AssertionError(
+            f"WFQ heap/scan dispatch pick divergence: heap={heap_pick!r} "
+            f"(key={self._wfq.key_of(heap_pick) if heap_pick else None}) "
+            f"scan={scan_pick!r} over {scan_inputs!r}"
+        )
+
+    def _verify_preemption(
+        self, wfq_inputs: list[fair_share.JobShareInput]
+    ) -> None:
+        """``verify`` tick mode: the preemption decision derived from the
+        synced entries must equal the one from a fresh state rescan (both
+        count-metered, so equality is exact)."""
+        scan_inputs = self._share_inputs(include_cost=False)
+        total = self._total_slots()
+        scan_decision = fair_share.pick_preemption(
+            scan_inputs, fair_share.compute_slot_targets(scan_inputs, total)
+        )
+        wfq_decision = fair_share.pick_preemption(
+            wfq_inputs, fair_share.compute_slot_targets(wfq_inputs, total)
+        )
+        if scan_decision != wfq_decision:
+            raise AssertionError(
+                f"WFQ heap/scan preemption divergence: heap={wfq_decision!r} "
+                f"scan={scan_decision!r} over {scan_inputs!r}"
+            )
 
     def _compute_targets(
         self, inputs: list[fair_share.JobShareInput] | None = None
@@ -716,7 +853,18 @@ class JobManager(ClusterManager):
     async def _dispatch_tick(
         self, inputs: list[fair_share.JobShareInput] | None = None
     ) -> None:
-        """Fill every under-target worker with the fairest job's frames."""
+        """Fill every under-target worker with the fairest job's frames.
+
+        ``heap`` mode picks each slot's job with an O(log n) heap peek
+        and folds the dispatch into the entry; ``scan`` keeps the legacy
+        per-slot O(jobs) input rebuild over local counters; ``verify``
+        runs both and asserts every pick agrees (dispatch decisions
+        follow the scan so a tolerated near-tie divergence cannot
+        compound).
+        """
+        mode = self.config.tick_mode
+        use_heap = mode in ("heap", "verify")
+        track_counts = mode in ("scan", "verify")
         # Local counters adjusted as dispatches land, so one tick's fills
         # interleave jobs fairly instead of recounting O(frames) per slot.
         # The third element is the job's predicted in-flight seconds
@@ -724,8 +872,11 @@ class JobManager(ClusterManager):
         # it, and each dispatch folds its unit's prediction in so one
         # tick's fills stay cost-fair too.
         counts: dict[str, list] = {}
-        for job in inputs if inputs is not None else self._share_inputs():
-            counts[job.job_id] = [job.in_flight, job.pending, job.in_flight_cost]
+        if track_counts:
+            for job in inputs if inputs is not None else self._share_inputs():
+                counts[job.job_id] = [
+                    job.in_flight, job.pending, job.in_flight_cost
+                ]
 
         def inputs_now() -> list[fair_share.JobShareInput]:
             out = []
@@ -752,7 +903,12 @@ class JobManager(ClusterManager):
                 not worker.is_dead
                 and len(worker.queue) < self.config.target_queue_size
             ):
-                job_id = fair_share.pick_job_to_dispatch(inputs_now())
+                if mode == "heap":
+                    job_id = self._wfq.pick_dispatch()
+                else:
+                    if mode == "verify":
+                        self._verify_pick(self._wfq.pick_dispatch(), inputs_now())
+                    job_id = fair_share.pick_job_to_dispatch(inputs_now())
                 if job_id is None:
                     return  # nothing pending anywhere
                 run = self._runs[job_id]
@@ -771,15 +927,21 @@ class JobManager(ClusterManager):
                 if await dispatch_one_pending(
                     worker, run.spec.job, run.state, job_id=job_id
                 ):
-                    counts[job_id][0] += 1
-                    counts[job_id][1] -= 1
-                    if counts[job_id][2] is not None:
-                        counts[job_id][2] += predicted
+                    if track_counts:
+                        counts[job_id][0] += 1
+                        counts[job_id][1] -= 1
+                        if counts[job_id][2] is not None:
+                            counts[job_id][2] += predicted
+                    if use_heap:
+                        self._wfq.on_dispatched(job_id, predicted)
                 else:
                     # Dispatch failed (worker died mid-RPC, cancel raced,
                     # or the pending pool emptied under us): stop filling
                     # this worker; the pending count is refreshed next tick.
-                    counts[job_id][1] = max(0, counts[job_id][1] - 1)
+                    if track_counts:
+                        counts[job_id][1] = max(0, counts[job_id][1] - 1)
+                    if use_heap:
+                        self._wfq.on_dispatch_failed(job_id)
                     break
 
     async def _preempt_tick(self) -> None:
@@ -788,8 +950,13 @@ class JobManager(ClusterManager):
         for _ in range(max(0, self.config.max_preemptions_per_tick)):
             # Recomputed per iteration on purpose (dispatch and any prior
             # preemption changed the in-flight picture) — but ONCE per
-            # iteration, shared by targets and the preemption pick.
-            inputs = self._share_inputs()
+            # iteration, shared by targets and the preemption pick. The
+            # heap path's recompute is a dirty-jobs resync + O(jobs)
+            # entry read (the transitions dispatch just made making those
+            # jobs dirty), no frame scans.
+            inputs = self._tick_inputs()
+            if self.config.tick_mode == "verify":
+                self._verify_preemption(inputs)
             targets = self._compute_targets(inputs)
             decision = fair_share.pick_preemption(inputs, targets)
             if decision is None:

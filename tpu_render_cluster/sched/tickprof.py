@@ -73,6 +73,7 @@ class TickProfiler:
         span_tracer=None,
         *,
         tick_budget_seconds: float = 0.05,
+        flightrec=None,
     ) -> None:
         self.metrics = metrics
         self.span_tracer = span_tracer
@@ -84,6 +85,13 @@ class TickProfiler:
         self._tick_active = False
         self._tick_start_wall = 0.0
         self._tick_start = 0.0
+        # Flight-recorder seam (obs/flightrec.py): a rolling budget ratio
+        # crossing 1.0 dumps a black box, like loop_lag does. Edge-
+        # triggered here (fire on the below->above crossing, re-arm on
+        # dropping back under) on top of the recorder's own per-kind
+        # debounce, so a sustained overrun is one dump, not one per tick.
+        self.flightrec = flightrec
+        self._over_budget = False
 
     def begin_tick(self) -> None:
         self._tick_active = profiling_enabled()
@@ -121,9 +129,27 @@ class TickProfiler:
         self.ticks += 1
         self._hist.observe(total, phase="total")
         self._totals.append(total)
-        self._budget.set(
-            sum(self._totals) / len(self._totals) / self.tick_budget_seconds
-        )
+        ratio = sum(self._totals) / len(self._totals) / self.tick_budget_seconds
+        self._budget.set(ratio)
+        if self.flightrec is not None:
+            if ratio > 1.0:
+                if not self._over_budget:
+                    self._over_budget = True
+                    from tpu_render_cluster.obs.flightrec import (
+                        TRIGGER_TICK_BUDGET,
+                    )
+
+                    self.flightrec.trigger(
+                        TRIGGER_TICK_BUDGET,
+                        {
+                            "budget_ratio": round(ratio, 4),
+                            "tick_budget_seconds": self.tick_budget_seconds,
+                            "last_tick_seconds": round(total, 6),
+                            "ticks": self.ticks,
+                        },
+                    )
+            else:
+                self._over_budget = False
         if self.span_tracer is not None:
             self.span_tracer.complete(
                 "sched tick",

@@ -1,0 +1,218 @@
+"""Incrementally maintained weighted-fair-queueing pick structure.
+
+The legacy tick (``TRC_SCHED_TICK=scan``) rebuilds every job's share
+inputs from scratch each tick: an O(frames) status scan per job for the
+in-flight set, an O(in-flight) x cost-model predict per job for the load,
+and an O(jobs) list rebuild per dispatch slot. This module replaces that
+with the structure ROADMAP item 3 calls for: one entry per running job
+holding its current WFQ key (``load / weight`` within a strict priority
+class), kept in a lazy min-heap so the dispatch pick is a heap peek.
+
+Entries change only when the underlying job state changes, and every
+such event — unit queued/completed/evicted, steal, worker death
+returning units, ledger replay — funnels through a
+``ClusterManagerState`` transition, which bumps the state's ``version``
+counter (master/state.py). The manager therefore resyncs exactly the
+DIRTY jobs each tick (version mismatch), reading the O(1) maintained
+counters and pricing only the job's in-flight units; a quiet job costs
+nothing. Weight/priority are re-read on every resync, so a weight change
+is just another dirty entry.
+
+Heap discipline: entries are immutable once pushed; updating a job bumps
+its entry version and pushes a fresh tuple, and stale tuples (version
+mismatch, departed job, or no pending work) are popped lazily at peek
+time — the classic indexed-priority-queue-by-invalidation, O(log n)
+amortized per update.
+
+Ordering matches ``fair_share.pick_job_to_dispatch`` exactly in exact
+arithmetic: highest priority class first, smallest ``load/weight``
+within it, ties broken by admission sequence (the scan breaks ties by
+input order, which the manager feeds in admission order). The scan's
+``_EPS`` tolerance means near-ties (keys differing by less than 1e-9)
+may legitimately resolve to either job; the ``verify`` tick mode treats
+exactly that window as an acceptable divergence and anything wider as a
+bug.
+"""
+
+from __future__ import annotations
+
+import heapq
+
+from tpu_render_cluster.sched.fair_share import JobShareInput
+
+__all__ = ["IncrementalWFQ"]
+
+
+class _Entry:
+    __slots__ = (
+        "job_id",
+        "weight",
+        "priority",
+        "seq",
+        "in_flight",
+        "pending",
+        "cost",
+        "entry_version",
+        "state_version",
+    )
+
+    def __init__(self, job_id: str, seq: int) -> None:
+        self.job_id = job_id
+        self.seq = seq
+        self.weight = 1.0
+        self.priority = 0
+        self.in_flight = 0
+        self.pending = 0
+        self.cost: float | None = None
+        self.entry_version = 0
+        self.state_version = -1
+
+    @property
+    def load(self) -> float:
+        return self.cost if self.cost is not None else float(self.in_flight)
+
+    @property
+    def key(self) -> float:
+        return self.load / self.weight
+
+
+class IncrementalWFQ:
+    """Per-job WFQ entries + a lazy min-heap over the runnable ones."""
+
+    def __init__(self) -> None:
+        # Insertion order == first-sync order == admission order: the
+        # manager first syncs a job the tick after it is admitted, so
+        # inputs() reproduces the scan path's input order without a sort.
+        self._entries: dict[str, _Entry] = {}
+        # (-priority, key, seq, job_id, entry_version)
+        self._heap: list[tuple[float, float, int, str, int]] = []
+        self._next_seq = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, job_id: str) -> bool:
+        return job_id in self._entries
+
+    def job_ids(self) -> list[str]:
+        return list(self._entries)
+
+    def needs_sync(self, job_id: str, state_version: int, cost_on: bool) -> bool:
+        """True when the job's entry is absent, behind the state's
+        mutation counter, or metered in the wrong unit (the cost model
+        just gained its first history, or metering was toggled)."""
+        entry = self._entries.get(job_id)
+        if entry is None or entry.state_version != state_version:
+            return True
+        return (entry.cost is not None) != cost_on
+
+    def sync(
+        self,
+        job_id: str,
+        *,
+        weight: float,
+        priority: int,
+        in_flight: int,
+        pending: int,
+        cost: float | None,
+        state_version: int,
+    ) -> None:
+        """Install/refresh one job's entry from its state of truth."""
+        entry = self._entries.get(job_id)
+        if entry is None:
+            entry = _Entry(job_id, self._next_seq)
+            self._next_seq += 1
+            self._entries[job_id] = entry
+        entry.weight = weight
+        entry.priority = priority
+        entry.in_flight = in_flight
+        entry.pending = pending
+        entry.cost = cost
+        entry.state_version = state_version
+        self._reindex(entry)
+
+    def remove(self, job_id: str) -> None:
+        # Its heap tuples die lazily at peek time.
+        self._entries.pop(job_id, None)
+
+    def _reindex(self, entry: _Entry) -> None:
+        entry.entry_version += 1
+        if entry.pending > 0:
+            heapq.heappush(
+                self._heap,
+                (
+                    -entry.priority,
+                    entry.key,
+                    entry.seq,
+                    entry.job_id,
+                    entry.entry_version,
+                ),
+            )
+
+    # -- event updates (within one tick's dispatch loop) --------------------
+
+    def on_dispatched(self, job_id: str, predicted_cost: float) -> None:
+        """One unit of this job just left pending for a worker's queue.
+
+        Keeps the entry pick-accurate between full resyncs: the state's
+        own transition already bumped its version, so the next tick's
+        sync re-reads the truth and absorbs any prediction drift.
+        """
+        entry = self._entries.get(job_id)
+        if entry is None:
+            return
+        entry.in_flight += 1
+        entry.pending = max(0, entry.pending - 1)
+        if entry.cost is not None:
+            entry.cost += predicted_cost
+        self._reindex(entry)
+
+    def on_dispatch_failed(self, job_id: str) -> None:
+        """Mirror of the scan path's failure bookkeeping: the claimed
+        unit did not land (worker died mid-RPC, cancel raced, or the
+        pending pool emptied under us) — stop offering it this tick; the
+        next sync restores the true count."""
+        entry = self._entries.get(job_id)
+        if entry is None:
+            return
+        entry.pending = max(0, entry.pending - 1)
+        self._reindex(entry)
+
+    # -- picks ---------------------------------------------------------------
+
+    def pick_dispatch(self) -> str | None:
+        """The job the next free slot should serve — a lazy heap peek."""
+        while self._heap:
+            neg_priority, key, seq, job_id, entry_version = self._heap[0]
+            entry = self._entries.get(job_id)
+            if (
+                entry is None
+                or entry.entry_version != entry_version
+                or entry.pending <= 0
+            ):
+                heapq.heappop(self._heap)
+                continue
+            return job_id
+        return None
+
+    def key_of(self, job_id: str) -> tuple[int, float] | None:
+        """(priority, load/weight) of one entry — verify-mode forensics."""
+        entry = self._entries.get(job_id)
+        if entry is None:
+            return None
+        return entry.priority, entry.key
+
+    def inputs(self) -> list[JobShareInput]:
+        """Share inputs for targets/accounting/preemption, admission
+        order, O(jobs) with no frame scans or predict calls."""
+        return [
+            JobShareInput(
+                job_id=entry.job_id,
+                weight=entry.weight,
+                priority=entry.priority,
+                in_flight=entry.in_flight,
+                pending=entry.pending,
+                in_flight_cost=entry.cost,
+            )
+            for entry in self._entries.values()
+        ]

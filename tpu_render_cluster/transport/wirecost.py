@@ -65,6 +65,23 @@ class WireAccounting:
         self._bytes.inc(len(text), tag=tag, direction="send")
         return text
 
+    def record_send(self, tag: str, text: str, seconds: float) -> None:
+        """Account an outbound frame the send site ALREADY encoded.
+
+        The preserialized dispatch path (protocol/frames.py) produces
+        its text outside the codec; accounting must observe that text
+        as-is — re-running ``encode_message`` just to measure would
+        double the very cost being eliminated. One serialize per message
+        end-to-end is the contract (the call-count test pins it).
+        ``seconds`` is the send site's measured encode time (a splice,
+        not a ``json.dumps``, but charged to the same series so the A/B
+        comparison reads off one metric).
+        """
+        if self.metrics is None:
+            return
+        self._seconds.observe(seconds, tag=tag, direction="send")
+        self._bytes.inc(len(text), tag=tag, direction="send")
+
     def decode(self, text: str | bytes) -> pm.Message:
         if self.metrics is None:
             return pm.decode_message(text)

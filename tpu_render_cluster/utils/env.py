@@ -94,6 +94,8 @@ declare("TRC_SCHED_MAX_ACTIVE_JOBS", "int", 4, "Concurrently running jobs")
 declare("TRC_SCHED_PREEMPTION", "flag", 1, "Preemption of over-share jobs on/off")
 declare("TRC_SCHED_MAX_PREEMPTIONS_PER_TICK", "int", 1, "Preemptions per scheduler tick")
 declare("TRC_SCHED_DRAIN_GRACE_SECONDS", "float", 10.0, "Drain grace before cancelling barrier-unadmittable jobs")
+declare("TRC_SCHED_TICK", "spec", "heap", "Tick pick structure: heap | scan (legacy full rescan) | verify (heap + scan cross-check)")
+declare("TRC_DISPATCH_FRAMES", "spec", "cached", "Dispatch frame encoding: cached (preserialized splice) | encode (per-send JSON)")
 # -- cost model / speculation ------------------------------------------------
 declare("TRC_COST_MODEL", "path", None, "Trace-trained cost model loaded at master start")
 declare("TRC_SPECULATION", "flag", 0, "Straggler-aware speculative re-execution on/off")
