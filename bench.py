@@ -1156,7 +1156,9 @@ def sched_bench(
     return record
 
 
-def _ha_shard_process(conn, worker_count: int, render_seconds: float) -> None:
+def _ha_shard_process(
+    conn, worker_count: int, render_seconds: float, replicate: bool = False
+) -> None:
     """One master SHARD as its own OS process (multiprocessing spawn
     target; must stay module-level picklable).
 
@@ -1168,6 +1170,12 @@ def _ha_shard_process(conn, worker_count: int, render_seconds: float) -> None:
     port back over the pipe, serves until the router's drain lands, then
     reports how many units finished and the admission->completion wall
     window.
+
+    With ``replicate`` the shard also streams its ledger to one attached
+    ``LedgerFollower`` over TCP (ha/replicate.py, a DISJOINT replica
+    directory — the cross-host deployment shape, colocated only for the
+    bench), and reports the follower's apply-lag sample distribution so
+    the A/B prices what the durability upgrade costs the hot path.
     """
     import asyncio
     import tempfile
@@ -1190,6 +1198,24 @@ def _ha_shard_process(conn, worker_count: int, render_seconds: float) -> None:
         manager = JobManager(
             "127.0.0.1", 0, metrics=registry, ledger=ledger
         )
+        replication = None
+        follower = None
+        if replicate:
+            from tpu_render_cluster.ha.replicate import (
+                LedgerFollower,
+                ReplicationServer,
+            )
+
+            replication = ReplicationServer(ledger, metrics=registry)
+            await replication.start()
+            follower = LedgerFollower(
+                tempfile.mkdtemp(prefix="trc-ha-bench-replica-"),
+                "127.0.0.1",
+                replication.port,
+                metrics=MetricsRegistry(),
+                follower_id="bench-follower",
+            )
+            follower.start()
         serve_task = asyncio.create_task(manager.serve())
         while manager._server is None:
             if serve_task.done():
@@ -1244,6 +1270,26 @@ def _ha_shard_process(conn, worker_count: int, render_seconds: float) -> None:
         # in these per-process registries, not the parent's.
         out["registry"] = manager.metrics.snapshot()
         out["worker_registries"] = [w.metrics.snapshot() for w in workers]
+        if follower is not None:
+            # Let the tail drain before the lag readout: the stream is
+            # asynchronous by design, so the final few records may still
+            # be in flight when the last unit finishes.
+            head = ledger.replay.last_seq
+            deadline = asyncio.get_running_loop().time() + 10.0
+            while (
+                follower.last_seq < head
+                and asyncio.get_running_loop().time() < deadline
+            ):
+                await asyncio.sleep(0.02)
+            from tpu_render_cluster.chaos.runner import unit_latency_stats
+
+            out["replication"] = {
+                "records_applied": follower.records_applied,
+                "behind_units": max(0, head - follower.last_seq),
+                "lag": unit_latency_stats(list(follower.lag_samples)),
+            }
+            await follower.stop()
+            await replication.stop()
         return out
 
     try:
@@ -1340,8 +1386,9 @@ def ha_shard_bench(
     append_stats: dict[str, object] = {}
     attrib_snapshots: list[dict[str, object]] = []
     attrib_window = 0.0
+    repl_sections: list[dict] = []
 
-    def run_once(shard_count: int) -> float:
+    def run_once(shard_count: int, replicate: bool = False) -> float:
         nonlocal append_stats, attrib_snapshots, attrib_window
         workers_per_shard = total_workers // shard_count
         saved = {k: os.environ.get(k) for k in sched_env}
@@ -1352,7 +1399,12 @@ def ha_shard_bench(
                 parent_conn, child_conn = ctx.Pipe()
                 proc = ctx.Process(
                     target=_ha_shard_process,
-                    args=(child_conn, workers_per_shard, render_seconds),
+                    args=(
+                        child_conn,
+                        workers_per_shard,
+                        render_seconds,
+                        replicate,
+                    ),
                 )
                 proc.start()
                 child_conn.close()
@@ -1388,6 +1440,8 @@ def ha_shard_bench(
             for result in results:
                 if "error" in result:
                     raise RuntimeError(f"shard failed: {result['error']}")
+                if "replication" in result:
+                    repl_sections.append(result["replication"])
             total_units = sum(r["units"] for r in results)
             # Fold every shard's ledger-append histogram into one
             # distribution (shared DEFAULT_BUCKETS bounds): the fsync
@@ -1447,14 +1501,20 @@ def ha_shard_bench(
                 else:
                     os.environ[name] = value
 
-    rates: dict[int, list[float]] = {1: [], 2: []}
+    rates: dict[str, list[float]] = {"1": [], "1r": [], "2": []}
     for _rep in range(reps):
-        # Interleaved A/B: machine-load drift cancels across modes.
-        rates[1].append(run_once(1))
-        rates[2].append(run_once(2))
+        # Interleaved A/B: machine-load drift cancels across modes. The
+        # "1r" leg is the replication A/B — one shard streaming its
+        # ledger to an attached follower over TCP, same workload.
+        rates["1"].append(run_once(1))
+        rates["1r"].append(run_once(1, replicate=True))
+        rates["2"].append(run_once(2))
 
     from tpu_render_cluster.chaos.plan import FaultPlan
-    from tpu_render_cluster.ha.chaos import run_chaos_failover_job
+    from tpu_render_cluster.ha.chaos import (
+        run_chaos_failover_job,
+        run_chaos_replicated_failover,
+    )
 
     mttrs = []
     for rep in range(failover_reps):
@@ -1468,6 +1528,28 @@ def ha_shard_bench(
         if mttr is not None:
             mttrs.append(mttr)
 
+    # The 1-follower MTTR: the ledger reaches the standby by streaming
+    # replication ONLY (no shared filesystem), and the promotion is the
+    # router's — detection + promote + epoch-fenced adoption all priced.
+    replicated_mttrs = []
+    for rep in range(failover_reps):
+        plan = FaultPlan.generate_replicated_failover(failover_seed + rep, 3)
+        report = run_chaos_replicated_failover(plan, frames=48, timeout=180.0)
+        if not report.ok:
+            raise RuntimeError(
+                f"replicated failover rep {rep} violated invariants: "
+                f"{report.violations}"
+            )
+        mttr = report.stats["failover"].get("mttr_seconds")
+        if mttr is not None:
+            replicated_mttrs.append(mttr)
+
+    lag_p50s = [
+        s["lag"]["p50_s"] for s in repl_sections if s["lag"].get("count")
+    ]
+    lag_p99s = [
+        s["lag"]["p99_s"] for s in repl_sections if s["lag"].get("count")
+    ]
     record = {
         "metric": (
             f"control-plane shard scaling: {jobs} jobs x {frames} units over "
@@ -1485,18 +1567,22 @@ def ha_shard_bench(
             "keep the master process CPU-saturated (cpu/wall ~1.0) so the "
             "event loop's dispatch/RPC work, not tick idling or render "
             "time, is the measured bottleneck; interleaved "
-            "median-of-reps per the bench-variance protocol. MTTR from "
-            "seeded ha/chaos master-kill runs (kill -> first standby "
-            "dispatch), every run's invariant audit green."
+            "median-of-reps per the bench-variance protocol. The "
+            "replication A/B re-runs the 1-shard leg with a TCP-attached "
+            "ledger follower (ha/replicate.py) and reports the apply-lag "
+            "percentiles. MTTR from seeded ha/chaos master-kill runs "
+            "(kill -> first standby dispatch), shared-directory standby "
+            "vs streamed-replica router promotion, every run's invariant "
+            "audit green."
         ),
         "total_workers": total_workers,
         "jobs": jobs,
         "frames_per_job": frames,
         "reps": reps,
-        "assignments_per_s_1_shard": round(statistics.median(rates[1]), 1),
-        "assignments_per_s_2_shards": round(statistics.median(rates[2]), 1),
-        "all_reps_1_shard": [round(r, 1) for r in rates[1]],
-        "all_reps_2_shards": [round(r, 1) for r in rates[2]],
+        "assignments_per_s_1_shard": round(statistics.median(rates["1"]), 1),
+        "assignments_per_s_2_shards": round(statistics.median(rates["2"]), 1),
+        "all_reps_1_shard": [round(r, 1) for r in rates["1"]],
+        "all_reps_2_shards": [round(r, 1) for r in rates["2"]],
         "failover": {
             "reps": failover_reps,
             "seed_base": failover_seed,
@@ -1504,6 +1590,49 @@ def ha_shard_bench(
                 round(statistics.median(mttrs), 3) if mttrs else None
             ),
             "mttr_seconds_all": [round(m, 3) for m in mttrs],
+        },
+        # The replication A/B: the same 1-shard workload with a follower
+        # attached (streaming every committed record over TCP) vs none,
+        # plus the MTTR when failover rides the stream instead of a
+        # shared directory (seeded router-promotion chaos runs).
+        "replication": {
+            "assignments_per_s_no_follower": round(
+                statistics.median(rates["1"]), 1
+            ),
+            "assignments_per_s_1_follower": round(
+                statistics.median(rates["1r"]), 1
+            ),
+            "all_reps_1_follower": [round(r, 1) for r in rates["1r"]],
+            "follower_overhead_pct": round(
+                100.0
+                * (
+                    1.0
+                    - statistics.median(rates["1r"])
+                    / max(1e-9, statistics.median(rates["1"]))
+                ),
+                1,
+            ),
+            "lag_p50_s": (
+                statistics.median(lag_p50s) if lag_p50s else None
+            ),
+            "lag_p99_s": (
+                statistics.median(lag_p99s) if lag_p99s else None
+            ),
+            "behind_units_at_drain": (
+                max(s["behind_units"] for s in repl_sections)
+                if repl_sections
+                else None
+            ),
+            "failover": {
+                "reps": failover_reps,
+                "seed_base": failover_seed,
+                "mttr_seconds_median": (
+                    round(statistics.median(replicated_mttrs), 3)
+                    if replicated_mttrs
+                    else None
+                ),
+                "mttr_seconds_all": [round(m, 3) for m in replicated_mttrs],
+            },
         },
         # Per-append ledger durability cost (fsync incl.) folded across
         # the final rep's shards — the ha_ledger_append_seconds histogram

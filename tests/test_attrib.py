@@ -134,7 +134,7 @@ def test_attribution_partition_sums_to_one_and_clamps():
     assert all(0.0 <= report["fractions"][k] <= 1.0 for k in FRACTION_KEYS)
     # Per-run apportioning exists and each run's carve also sums to 1.
     per_run = report["per_run"]
-    assert abs(sum(per_run["run"]["fractions"].values()) - 1.0) < 1e-6
+    assert abs(sum(per_run["run"]["fractions"].values()) - 1.0) < 5e-6
 
 
 def test_attribution_report_worker_seconds_fallback_and_empty():
@@ -547,7 +547,9 @@ def test_statistics_attribution_from_run_artifacts(monkeypatch, tmp_path):
     # The per-run split exists (one run) and sums to 1 as well.
     per_run = attribution["per_run"]
     assert len(per_run) == 1
-    assert abs(sum(next(iter(per_run.values()))["fractions"].values()) - 1.0) < 1e-6
+    # 5 fractions each rounded to 6 decimals: the exact-1.0 carve can
+    # drift by up to 5 * 0.5e-6 after rounding.
+    assert abs(sum(next(iter(per_run.values()))["fractions"].values()) - 1.0) < 5e-6
 
 
 # ---------------------------------------------------------------------------

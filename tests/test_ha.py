@@ -16,18 +16,32 @@ from pathlib import Path
 
 import pytest
 
+from tpu_render_cluster.chaos.invariants import counter_total
 from tpu_render_cluster.chaos.plan import (
+    KIND_FOLLOWER_LAG,
     KIND_MASTER_KILL,
     KIND_MASTER_PARTITION,
+    KIND_REPLICATION_PARTITION,
+    KIND_ROUTER_KILL,
     MASTER_TARGET,
+    REPLICATION_KINDS,
     FaultPlan,
 )
-from tpu_render_cluster.ha.chaos import run_chaos_failover_job
+from tpu_render_cluster.ha.chaos import (
+    run_chaos_failover_job,
+    run_chaos_replicated_failover,
+    run_chaos_shard_kill,
+)
 from tpu_render_cluster.ha.failover import apply_ledger_to_state
 from tpu_render_cluster.ha.ledger import (
     JobLedger,
     LedgerCorruptError,
     LedgerReplay,
+)
+from tpu_render_cluster.ha.replicate import (
+    LedgerFollower,
+    ReplicationServer,
+    _encode_line,
 )
 from tpu_render_cluster.ha.shards import (
     ShardRouter,
@@ -42,6 +56,7 @@ from tpu_render_cluster.master.state import ClusterManagerState, FrameStatus
 from tpu_render_cluster.obs import MetricsRegistry, validate_trace_file
 from tpu_render_cluster.obs.prometheus import lint_metric
 from tpu_render_cluster.protocol import messages as pm
+from tpu_render_cluster.sched.rebalance import Move, RebalancePlanner, ShardLoad
 
 pytestmark = pytest.mark.ha
 
@@ -433,6 +448,26 @@ def test_new_ha_metric_names_pass_the_naming_lint():
         ("master_stale_epoch_events_total", "counter", ()),
         ("worker_stale_epoch_requests_total", "counter", ()),
         ("worker_session_reannounces_total", "counter", ()),
+        ("ha_replication_followers_units", "gauge", ()),
+        ("ha_replication_behind_units", "gauge", ()),
+        ("ha_replication_lag_units", "gauge", ("follower",)),
+        ("ha_replication_lag_seconds", "histogram", ()),
+        ("ha_replication_records_sent_total", "counter", ("follower",)),
+        ("ha_replication_records_applied_total", "counter", ()),
+        ("ha_replication_reconnects_total", "counter", ()),
+        ("ha_replication_gaps_total", "counter", ()),
+        ("ha_replication_torn_tails_total", "counter", ()),
+        ("ha_replication_refused_total", "counter", ("end",)),
+        ("ha_replication_snapshots_sent_total", "counter", ()),
+        ("ha_failover_mttr_seconds", "gauge", ()),
+        ("ha_router_promotions_total", "counter", ("shard",)),
+        ("ha_router_scrapes_total", "counter", ("path", "shard")),
+        ("ha_router_scrape_failures_total", "counter", ("shard",)),
+        ("ha_router_shard_load_units", "gauge", ("shard",)),
+        ("ha_router_rebalance_moves_total", "counter", ("source", "target")),
+        ("worker_migrations_total", "counter", ()),
+        ("master_worker_migrations_total", "counter", ()),
+        ("master_worker_migrate_requests_total", "counter", ()),
     ]:
         assert lint_metric(name, kind, labels) == [], name
 
@@ -452,6 +487,41 @@ def test_failover_plan_is_seeded_and_master_targeted():
     # Pre-HA seeds keep bit-identical schedules (the new kinds draw last).
     legacy = FaultPlan.generate(ACCEPTANCE_SEED, 3)
     assert not legacy.master_events()
+
+
+def test_replication_chaos_kinds_draw_last_and_scenarios_are_seeded():
+    """The three replication kinds draw LAST from the plan RNG: adding
+    them to a seeded plan leaves every pre-existing event bit-identical,
+    so recorded legacy seeds keep their schedules."""
+    base = FaultPlan.generate(ACCEPTANCE_SEED, 3, master_kills=1)
+    extended = FaultPlan.generate(
+        ACCEPTANCE_SEED,
+        3,
+        master_kills=1,
+        replication_partitions=1,
+        router_kills=1,
+        follower_lags=1,
+    )
+    assert not base.replication_events()
+    assert len(extended.replication_events()) == 3
+    assert [
+        e for e in extended.events if e.kind not in REPLICATION_KINDS
+    ] == list(base.events)
+
+    rep = FaultPlan.generate_replicated_failover(ACCEPTANCE_SEED)
+    assert (
+        rep.fingerprint()
+        == FaultPlan.generate_replicated_failover(ACCEPTANCE_SEED).fingerprint()
+    )
+    kinds = rep.kinds()
+    assert KIND_MASTER_KILL in kinds
+    assert KIND_REPLICATION_PARTITION in kinds and KIND_FOLLOWER_LAG in kinds
+    assert rep.expected_evictions() == 0  # every worker survives
+
+    shard_kill = FaultPlan.generate_shard_kill(ACCEPTANCE_SEED)
+    assert KIND_MASTER_KILL in shard_kill.kinds()
+    assert KIND_ROUTER_KILL in shard_kill.kinds()
+    assert shard_kill.expected_evictions() == 0
 
 
 # ---------------------------------------------------------------------------
@@ -646,3 +716,521 @@ def test_shard_router_end_to_end_two_shards():
         await asyncio.gather(*wtasks, return_exceptions=True)
 
     asyncio.run(asyncio.wait_for(scenario(), 90.0))
+
+
+# ---------------------------------------------------------------------------
+# Ledger streaming replication (ha/replicate.py)
+
+
+async def _until(predicate, timeout=15.0):
+    async def _poll():
+        while not predicate():
+            await asyncio.sleep(0.01)
+
+    await asyncio.wait_for(_poll(), timeout)
+
+
+def test_replication_backlog_live_tail_and_promotion(tmp_path):
+    """A follower attaches (backlog re-fetch over TCP), tails live
+    commits, and promotes to a ledger whose epoch out-fences every epoch
+    the primary ever streamed — no shared filesystem anywhere."""
+    primary_dir = tmp_path / "primary"
+    replica_dir = tmp_path / "replica"
+
+    async def scenario():
+        ledger = JobLedger.open(primary_dir)
+        assert ledger.epoch == 1
+        ledger.append_job_started("rep", spec={"x": 1}, job_id="job-0001")
+        ledger.append_unit_finished("rep", 1)
+        registry = MetricsRegistry()
+        server = ReplicationServer(ledger, metrics=registry)
+        await server.start()
+        follower = LedgerFollower(
+            replica_dir,
+            "127.0.0.1",
+            server.port,
+            metrics=MetricsRegistry(),
+            follower_id="t-backlog",
+        )
+        follower.start()
+        await _until(lambda: follower.last_seq >= 2)  # the backlog
+        ledger.append_unit_finished("rep", 2)  # the live tail
+        await _until(lambda: follower.last_seq >= 3)
+        assert follower.records_applied == 3
+        assert follower.epoch == 1 and not follower.fenced
+        snapshot = registry.snapshot()
+        assert counter_total(snapshot, "ha_replication_records_sent_total") == 3
+        promoted = await follower.promote()
+        try:
+            assert promoted.epoch == 2  # strictly above the primary's 1
+            assert promoted.replay.finished_units("rep") == {
+                (1, None),
+                (2, None),
+            }
+            assert promoted.replay.job("rep").job_id == "job-0001"
+        finally:
+            promoted.close()
+            await server.stop()
+            ledger.close()
+
+    asyncio.run(asyncio.wait_for(scenario(), 30.0))
+
+
+def test_replication_ships_snapshot_when_attach_predates_compaction(
+    tmp_path, monkeypatch
+):
+    """A follower attaching below the primary's compaction floor gets the
+    snapshot plus the post-snapshot records — and its replica replays to
+    the same state the primary holds."""
+    monkeypatch.setenv("TRC_HA_SNAPSHOT_EVERY", "0")
+    primary_dir = tmp_path / "primary"
+    replica_dir = tmp_path / "replica"
+
+    async def scenario():
+        ledger = JobLedger.open(primary_dir)
+        ledger.append_job_started("snap")
+        for frame in range(8):
+            ledger.append_unit_finished("snap", frame)
+        ledger.snapshot()  # prunes every segment behind the floor
+        ledger.append_unit_finished("snap", 8)
+        registry = MetricsRegistry()
+        server = ReplicationServer(ledger, metrics=registry)
+        await server.start()
+        follower = LedgerFollower(
+            replica_dir,
+            "127.0.0.1",
+            server.port,
+            metrics=MetricsRegistry(),
+            follower_id="t-snap",
+        )
+        follower.start()
+        await _until(lambda: follower.last_seq >= ledger.replay.last_seq)
+        await follower.stop()
+        await server.stop()
+        ledger.close()
+        assert (replica_dir / "snapshot.json").exists()
+        snapshot = registry.snapshot()
+        assert (
+            counter_total(snapshot, "ha_replication_snapshots_sent_total") == 1
+        )
+        replay = JobLedger.replay_directory(replica_dir)
+        assert replay.finished_units("snap") == {(f, None) for f in range(9)}
+
+    asyncio.run(asyncio.wait_for(scenario(), 30.0))
+
+
+def test_replication_torn_midstream_record_refetched_never_applied(
+    tmp_path, monkeypatch
+):
+    """The primary dies mid-record: the follower discards the torn line
+    WITHOUT applying it, re-attaches from its last contiguous record, and
+    re-fetches — the replica replays clean, exactly once."""
+    monkeypatch.setenv("TRC_HA_REPL_RETRY_SECONDS", "0.05")
+    records = [
+        {"v": 1, "seq": 1, "type": "job_started", "job": "torn"},
+        {"v": 1, "seq": 2, "type": "unit_finished", "job": "torn", "frame": 1},
+        {"v": 1, "seq": 3, "type": "unit_finished", "job": "torn", "frame": 2},
+    ]
+    attach_positions = []
+
+    async def scenario():
+        async def fake_primary(reader, writer):
+            line = await reader.readline()
+            request = pm.decode_message(line)
+            attach_positions.append(request.last_seq)
+            writer.write(
+                _encode_line(
+                    pm.ReplicationAttachResponse(
+                        request.message_request_id, epoch=1, primary_seq=3
+                    )
+                )
+            )
+            if len(attach_positions) == 1:
+                # Record 1 lands whole; record 2 is severed mid-line.
+                writer.write(
+                    _encode_line(pm.ReplicationRecordEvent(1, records[0]))
+                )
+                torn = _encode_line(pm.ReplicationRecordEvent(2, records[1]))
+                writer.write(torn[: len(torn) // 2])
+                await writer.drain()
+                writer.close()
+                return
+            for record in records:
+                if record["seq"] > request.last_seq:
+                    writer.write(
+                        _encode_line(
+                            pm.ReplicationRecordEvent(record["seq"], record)
+                        )
+                    )
+            await writer.drain()
+            await reader.read()  # hold the stream open until the follower stops
+
+        fake = await asyncio.start_server(fake_primary, "127.0.0.1", 0)
+        port = fake.sockets[0].getsockname()[1]
+        registry = MetricsRegistry()
+        follower = LedgerFollower(
+            tmp_path, "127.0.0.1", port, metrics=registry, follower_id="t-torn"
+        )
+        follower.start()
+        await _until(lambda: follower.last_seq >= 3)
+        await follower.stop()
+        fake.close()
+        await fake.wait_closed()
+        # Re-attached exactly from the last contiguous record, not 0.
+        assert attach_positions == [0, 1]
+        snapshot = registry.snapshot()
+        assert counter_total(snapshot, "ha_replication_torn_tails_total") >= 1
+        assert counter_total(snapshot, "ha_replication_reconnects_total") >= 1
+        # The torn record was never half-applied: the replica replays to
+        # exactly the three records, each once.
+        assert follower.records_applied == 3
+        replay = JobLedger.replay_directory(tmp_path)
+        assert not replay.torn_tail
+        assert replay.finished_units("torn") == {(1, None), (2, None)}
+
+    asyncio.run(asyncio.wait_for(scenario(), 30.0))
+
+
+def test_promotion_race_revived_primary_refused_both_ends(
+    tmp_path, monkeypatch
+):
+    """A follower promotes while the old primary revives: the stale
+    primary refuses the newer-epoch follower (it learns it is deposed),
+    and a follower refuses a primary streaming an older epoch than its
+    replica has durably observed — fenced at BOTH ends of the wire."""
+    monkeypatch.setenv("TRC_HA_REPL_RETRY_SECONDS", "0.05")
+    primary_dir = tmp_path / "primary"
+    replica_dir = tmp_path / "replica"
+
+    async def scenario():
+        ledger = JobLedger.open(primary_dir)  # epoch 1
+        ledger.append_job_started("race")
+        primary_registry = MetricsRegistry()
+        server = ReplicationServer(ledger, metrics=primary_registry)
+        await server.start()
+        follower = LedgerFollower(
+            replica_dir,
+            "127.0.0.1",
+            server.port,
+            metrics=MetricsRegistry(),
+            follower_id="race-1",
+        )
+        follower.start()
+        await _until(lambda: follower.last_seq >= 1)
+        promoted = await follower.promote()  # the race winner: epoch 2
+        assert promoted.epoch == 2
+        promoted.close()
+
+        # Primary end: the revived epoch-1 primary must refuse a replica
+        # that has durably seen epoch 2 — never stream a stale timeline.
+        stale = LedgerFollower(
+            replica_dir,
+            "127.0.0.1",
+            server.port,
+            metrics=MetricsRegistry(),
+            follower_id="race-2",
+        )
+        assert stale.epoch == 2  # from the replica's EPOCH file
+        stale.start()
+        await _until(lambda: stale.fenced)
+        await stale.stop()
+        assert stale.last_seq == 1  # nothing from the stale stream applied
+        assert (
+            counter_total(
+                primary_registry.snapshot(), "ha_replication_refused_total"
+            )
+            == 1
+        )
+        await server.stop()
+        ledger.close()
+
+        # Follower end: a primary that STREAMS an older epoch than the
+        # replica observed is refused by the follower (the mirror-image
+        # fence, for a primary that skips the request-side check).
+        async def stale_primary(reader, writer):
+            line = await reader.readline()
+            request = pm.decode_message(line)
+            writer.write(
+                _encode_line(
+                    pm.ReplicationAttachResponse(
+                        request.message_request_id, epoch=1, primary_seq=9
+                    )
+                )
+            )
+            await writer.drain()
+            await reader.read()
+
+        fake = await asyncio.start_server(stale_primary, "127.0.0.1", 0)
+        fake_port = fake.sockets[0].getsockname()[1]
+        follower_registry = MetricsRegistry()
+        refuser = LedgerFollower(
+            replica_dir,
+            "127.0.0.1",
+            fake_port,
+            metrics=follower_registry,
+            follower_id="race-3",
+        )
+        refuser.start()
+        await _until(lambda: refuser.fenced)
+        await refuser.stop()
+        fake.close()
+        await fake.wait_closed()
+        assert refuser.last_seq == 1
+        assert (
+            counter_total(
+                follower_registry.snapshot(), "ha_replication_refused_total"
+            )
+            == 1
+        )
+
+    asyncio.run(asyncio.wait_for(scenario(), 30.0))
+
+
+# ---------------------------------------------------------------------------
+# Rebalance planner: threshold / hysteresis / cooldown (pure, no sockets)
+
+
+def test_rebalance_planner_hysteresis_prevents_flapping():
+    planner = RebalancePlanner(
+        threshold=2.0, hysteresis_ticks=3, cooldown_seconds=30.0, max_moves=2
+    )
+    hot = ShardLoad(shard=0, queue_depth=40, in_flight_cost_seconds=None, workers=4)
+    cold = ShardLoad(shard=1, queue_depth=2, in_flight_cost_seconds=None, workers=4)
+    even = ShardLoad(shard=0, queue_depth=2, in_flight_cost_seconds=None, workers=4)
+    # A short spike never moves anyone...
+    assert planner.observe([hot, cold], 1000.0) is None
+    assert planner.observe([hot, cold], 1001.0) is None
+    # ...a balanced tick resets the streak...
+    assert planner.observe([even, cold], 1002.0) is None
+    assert planner.observe([hot, cold], 1003.0) is None
+    assert planner.observe([hot, cold], 1004.0) is None
+    # ...and only a PERSISTENT imbalance fires.
+    move = planner.observe([hot, cold], 1005.0)
+    assert isinstance(move, Move)
+    assert (move.source, move.target, move.count) == (0, 1, 1)
+    # Cooldown: the imbalance persists, but no second move inside it —
+    # the migrated workers need time to land before the next decision.
+    for tick in range(6):
+        assert planner.observe([hot, cold], 1006.0 + tick) is None
+    # After the cooldown, the still-persistent imbalance may fire again.
+    assert planner.observe([hot, cold], 1035.0) is not None
+
+
+def test_rebalance_planner_excludes_dead_and_undrainable_shards():
+    planner = RebalancePlanner(
+        threshold=1.5, hysteresis_ticks=1, cooldown_seconds=0.0
+    )
+    hot = ShardLoad(
+        shard=0, queue_depth=100, in_flight_cost_seconds=None, workers=4
+    )
+    # A dead shard is never a migration target — its workers re-home
+    # through the router, not via ops a dead control plane cannot serve.
+    assert planner.observe([hot, ShardLoad.dead(1)], 0.0) is None
+    # A single-worker hot shard is never drained below one worker.
+    lone = ShardLoad(
+        shard=0, queue_depth=100, in_flight_cost_seconds=None, workers=1
+    )
+    idle = ShardLoad(shard=1, queue_depth=0, in_flight_cost_seconds=None, workers=1)
+    assert planner.observe([lone, idle], 1.0) is None
+    # Cost-based ranking only when EVERY live shard reports cost.
+    costed = ShardLoad(
+        shard=0, queue_depth=1, in_flight_cost_seconds=90.0, workers=2
+    )
+    uncosted = ShardLoad(
+        shard=1, queue_depth=1, in_flight_cost_seconds=None, workers=2
+    )
+    assert planner.observe([costed, uncosted], 2.0) is None  # unit tie
+    both = ShardLoad(
+        shard=1, queue_depth=1, in_flight_cost_seconds=1.0, workers=2
+    )
+    move = planner.observe([costed, both], 3.0)
+    assert move is not None and (move.source, move.target) == (0, 1)
+
+
+# ---------------------------------------------------------------------------
+# Router degradation + worker migration over real sockets
+
+
+def test_router_fanout_degrades_dead_shard_to_absence():
+    """A dead shard is ABSENT from the router's fan-out answers (and
+    counted in ha_router_scrape_failures_total), never surfaced as a
+    connection error poisoning the whole response."""
+    import socket
+
+    from tpu_render_cluster.sched.control import ControlServer, control_request
+    from tpu_render_cluster.sched.manager import JobManager
+
+    async def scenario():
+        manager = JobManager("127.0.0.1", 0, metrics=MetricsRegistry())
+        serve_task = asyncio.create_task(manager.serve())
+        while manager._server is None:
+            await asyncio.sleep(0.01)
+        control = ControlServer(manager, "127.0.0.1", 0)
+        await control.start()
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        dead_port = probe.getsockname()[1]
+        probe.close()
+        registry = MetricsRegistry()
+        router = ShardRouter(
+            [("127.0.0.1", control.port), ("127.0.0.1", dead_port)],
+            timeout=2.0,
+            metrics=registry,
+        )
+        server = ShardRouterServer(router)
+        await server.start()
+
+        async def rr(request):
+            return await control_request("127.0.0.1", server.port, request)
+
+        for op in ("status", "alerts", "ping"):
+            response = await rr({"op": op})
+            assert response["ok"], response
+            assert set(response["shards"]) == {"0"}
+            assert response["unreachable"] == [1]
+        snapshot = registry.snapshot()
+        assert counter_total(snapshot, "ha_router_scrape_failures_total") >= 3
+        drained = await rr({"op": "drain"})
+        assert drained["ok"] and drained["unreachable"] == [1]
+        await server.stop()
+        await control.stop()
+        serve_task.cancel()
+        await asyncio.gather(serve_task, return_exceptions=True)
+
+    asyncio.run(asyncio.wait_for(scenario(), 60.0))
+
+
+def test_migrate_workers_rehomes_worker_to_target_shard():
+    """The migrate_workers control op sheds a worker shard A -> shard B
+    via a graceful migrate goodbye: the worker departs WITHOUT counting
+    as a drain, re-announces at B, and renders B's job to completion."""
+    from tpu_render_cluster.sched.control import ControlServer, control_request
+    from tpu_render_cluster.sched.manager import JobManager
+    from tpu_render_cluster.worker.backends.mock import MockBackend
+    from tpu_render_cluster.worker.runtime import Worker
+
+    async def scenario():
+        managers, serves, controls = [], [], []
+        for _ in range(2):
+            manager = JobManager("127.0.0.1", 0, metrics=MetricsRegistry())
+            serve_task = asyncio.create_task(manager.serve())
+            while manager._server is None:
+                await asyncio.sleep(0.01)
+            control = ControlServer(manager, "127.0.0.1", 0)
+            await control.start()
+            managers.append(manager)
+            serves.append(serve_task)
+            controls.append(control)
+        submitted = await control_request(
+            "127.0.0.1",
+            controls[1].port,
+            {
+                "op": "submit",
+                "spec": {"job": make_job("migrate-target", frames=4).to_dict()},
+            },
+        )
+        assert submitted["ok"], submitted
+
+        worker_registry = MetricsRegistry()
+        worker = Worker(
+            "127.0.0.1",
+            managers[0].port,
+            MockBackend(render_seconds=0.01),
+            metrics=worker_registry,
+        )
+
+        async def no_route():
+            return None
+
+        worker_task = asyncio.create_task(worker.connect_and_serve(no_route))
+        await _until(lambda: len(managers[0].workers) == 1)
+        moved = await control_request(
+            "127.0.0.1",
+            controls[0].port,
+            {
+                "op": "migrate_workers",
+                "host": "127.0.0.1",
+                "port": managers[1].port,
+                "reason": "test rebalance",
+            },
+        )
+        assert moved["ok"] and moved["migrating"] == 1
+        drained = await control_request(
+            "127.0.0.1", controls[1].port, {"op": "drain"}
+        )
+        assert drained["ok"]
+        await asyncio.wait_for(serves[1], 60.0)
+        run = next(iter(managers[1]._runs.values()))
+        assert run.status == "finished"
+        assert run.state.finished_count() == 4
+        # The goodbye was a MIGRATE, not a drain — counted apart so the
+        # chaos audits' drain ledger stays exact.
+        assert (
+            counter_total(worker_registry.snapshot(), "worker_migrations_total")
+            == 1
+        )
+        source_snapshot = managers[0].metrics.snapshot()
+        assert (
+            counter_total(source_snapshot, "master_worker_migrations_total") == 1
+        )
+        assert (
+            counter_total(
+                source_snapshot, "master_worker_migrate_requests_total"
+            )
+            == 1
+        )
+        assert counter_total(source_snapshot, "master_worker_drains_total") == 0
+        await asyncio.gather(worker_task, return_exceptions=True)
+        serves[0].cancel()
+        await asyncio.gather(serves[0], return_exceptions=True)
+        for control in controls:
+            await control.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), 90.0))
+
+
+# ---------------------------------------------------------------------------
+# Seeded cross-host acceptance runs (replication + shard death)
+
+
+def test_replicated_failover_acceptance(tmp_path):
+    """Cross-host failover under chaos: the stream is severed and lagged,
+    the primary killed — the router's monitor promotes the follower
+    (epoch-fenced), and the promoted replica finishes the job with the
+    exactly-once audit green. NO shared filesystem between the hosts."""
+    plan = FaultPlan.generate_replicated_failover(7, workers=3)
+    report = run_chaos_replicated_failover(
+        plan,
+        frames=24,
+        primary_directory=tmp_path / "primary",
+        replica_directory=tmp_path / "replica",
+        timeout=120.0,
+    )
+    assert report.ok, report.violations
+    failover = report.stats["failover"]
+    assert len(failover["promotions"]) == 1
+    assert failover["standby_epoch"] > failover["primary_epoch"]
+    assert failover["follower"]["records_applied"] > 0
+    assert failover["mttr_seconds"] > 0.0
+    ledger = report.stats["ledger"]
+    assert (
+        failover["replayed_units"]
+        + ledger["ok_results"]
+        - ledger["duplicate_results"]
+        == report.stats["frames_total"]
+    )
+
+
+def test_shard_kill_workers_rehome_to_survivor(tmp_path):
+    """One of two router-fronted shards dies mid-backlog (master AND
+    control endpoint — a whole host), the router bounces once: every
+    orphaned worker re-homes through route_worker, the survivor finishes
+    the full backlog exactly once, and the router's fan-outs degrade the
+    dead shard to absence."""
+    plan = FaultPlan.generate_shard_kill(11, workers=4)
+    report = run_chaos_shard_kill(plan, jobs=2, frames=16, timeout=180.0)
+    assert report.ok, report.violations
+    shard_kill = report.stats["shard_kill"]
+    assert shard_kill["survivor_workers"] == plan.workers
+    assert shard_kill["drain_ok"]
+    assert report.stats["router_scrape_failures"] >= 1

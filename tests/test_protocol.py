@@ -74,12 +74,20 @@ EXPECTED_WIRE_TAGS = {
     pm.WorkerJobFinishedResponse: "response_job-finished",
     # Beyond-reference extension (graceful drain); C++ peers may ignore it.
     pm.WorkerGoodbyeEvent: "event_worker-goodbye",
+    # Beyond-reference extensions: ledger streaming replication (never on
+    # the worker wire) and the rebalancer's re-home event.
+    pm.ReplicationAttachRequest: "request_replication-attach",
+    pm.ReplicationAttachResponse: "response_replication-attach",
+    pm.ReplicationRecordEvent: "event_replication-record",
+    pm.ReplicationAckEvent: "event_replication-ack",
+    pm.MasterWorkerMigrateEvent: "event_worker-migrate",
 }
 
 
 def test_all_wire_tags_exact():
-    # The reference's 14 messages plus the goodbye drain extension.
-    assert len(pm.ALL_MESSAGE_TYPES) == 15
+    # The reference's 14 messages plus the goodbye drain extension, the
+    # four replication messages, and the migrate event.
+    assert len(pm.ALL_MESSAGE_TYPES) == 20
     for cls, tag in EXPECTED_WIRE_TAGS.items():
         assert cls.type_name == tag
 
@@ -113,6 +121,19 @@ def all_example_messages() -> list[pm.Message]:
         pm.MasterJobStartedEvent(),
         pm.MasterJobFinishedRequest(99),
         pm.WorkerJobFinishedResponse(99, make_trace()),
+        pm.ReplicationAttachRequest(7, last_seq=0),
+        pm.ReplicationAttachRequest(8, last_seq=41, epoch=3, follower_id="f-1"),
+        pm.ReplicationAttachResponse(7, epoch=3, primary_seq=41),
+        pm.ReplicationAttachResponse(
+            8, epoch=3, primary_seq=41, snapshot={"v": 1, "seq": 40}
+        ),
+        pm.ReplicationAttachResponse(
+            9, epoch=2, primary_seq=41, error="primary is deposed"
+        ),
+        pm.ReplicationRecordEvent(42, {"v": 1, "seq": 42, "type": "unit_finished"}),
+        pm.ReplicationAckEvent(42),
+        pm.MasterWorkerMigrateEvent("10.0.0.2", 9911),
+        pm.MasterWorkerMigrateEvent("10.0.0.2", 9911, reason="rebalance"),
     ]
 
 

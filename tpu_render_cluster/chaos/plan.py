@@ -57,6 +57,13 @@ KIND_DELAY_DISPATCH = "delay_dispatch"
 # rather than a worker slot.
 KIND_MASTER_KILL = "master_kill"
 KIND_MASTER_PARTITION = "master_partition"
+# Replication/router faults (executed by the replicated-failover harness,
+# ha/chaos.py): the replication stream severed mid-flight, the shard
+# router itself killed and restarted, and a follower artificially lagged
+# (per-record apply delay) so promotion picks among unequal replicas.
+KIND_REPLICATION_PARTITION = "replication_partition"
+KIND_ROUTER_KILL = "router_kill"
+KIND_FOLLOWER_LAG = "follower_lag"
 
 # Slot sentinel for faults aimed at the master process itself.
 MASTER_TARGET = -1
@@ -75,9 +82,17 @@ ALL_KINDS = (
     KIND_DELAY_DISPATCH,
     KIND_MASTER_KILL,
     KIND_MASTER_PARTITION,
+    KIND_REPLICATION_PARTITION,
+    KIND_ROUTER_KILL,
+    KIND_FOLLOWER_LAG,
 )
 
 MASTER_KINDS = (KIND_MASTER_KILL, KIND_MASTER_PARTITION)
+REPLICATION_KINDS = (
+    KIND_REPLICATION_PARTITION,
+    KIND_ROUTER_KILL,
+    KIND_FOLLOWER_LAG,
+)
 
 FINISHED_EVENT_TYPE = "event_frame-queue_item-finished"
 RENDERING_EVENT_TYPE = "event_frame-queue_item-started-rendering"
@@ -202,6 +217,16 @@ class FaultPlan:
             )
         )
 
+    def replication_events(self) -> tuple[FaultEvent, ...]:
+        """Replication-plane faults (stream partition, router kill,
+        follower lag), schedule order."""
+        return tuple(
+            sorted(
+                (e for e in self.events if e.kind in REPLICATION_KINDS),
+                key=lambda e: e.at_seconds,
+            )
+        )
+
     def expected_evictions(self) -> int:
         return sum(1 for e in self.events if e.causes_eviction)
 
@@ -253,6 +278,9 @@ class FaultPlan:
         drains: int = 0,
         master_kills: int = 0,
         master_partitions: int = 0,
+        replication_partitions: int = 0,
+        router_kills: int = 0,
+        follower_lags: int = 0,
     ) -> "FaultPlan":
         """Roll a schedule from one PCG64 stream.
 
@@ -401,6 +429,40 @@ class FaultPlan:
                     at_seconds=float(rng.uniform(0.4, 0.8)),
                 )
             )
+        # Replication-plane faults draw after the master faults, for the
+        # same bit-identity reason: every pre-replication seed (including
+        # failover plans with master faults) keeps its exact schedule.
+        for _ in range(replication_partitions):
+            # Severed before the master kill window (0.8+): the follower
+            # must reconnect, gap-detect, and catch back up in time for
+            # promotion to still find a current replica.
+            events.append(
+                FaultEvent(
+                    kind=KIND_REPLICATION_PARTITION,
+                    target=MASTER_TARGET,
+                    at_seconds=float(rng.uniform(0.2, 0.6)),
+                    duration_seconds=float(rng.uniform(0.1, 0.3)),
+                )
+            )
+        for _ in range(router_kills):
+            events.append(
+                FaultEvent(
+                    kind=KIND_ROUTER_KILL,
+                    target=MASTER_TARGET,
+                    at_seconds=float(rng.uniform(0.3, 0.7)),
+                    duration_seconds=float(rng.uniform(0.2, 0.5)),
+                )
+            )
+        for _ in range(follower_lags):
+            events.append(
+                FaultEvent(
+                    kind=KIND_FOLLOWER_LAG,
+                    target=MASTER_TARGET,
+                    at_seconds=float(rng.uniform(0.1, 0.4)),
+                    duration_seconds=float(rng.uniform(0.3, 0.8)),
+                    multiplier=float(rng.uniform(0.005, 0.02)),
+                )
+            )
         return cls(
             seed=seed, workers=workers, events=tuple(events), timings=timings
         )
@@ -426,6 +488,58 @@ class FaultPlan:
             dispatch_delays=0,
             master_kills=1,
             master_partitions=1,
+        )
+
+    @classmethod
+    def generate_replicated_failover(cls, seed: int, workers: int = 3) -> "FaultPlan":
+        """A cross-host failover schedule: the replication stream is
+        severed and re-established mid-job, the follower is briefly
+        lagged, and THEN the primary is killed — promotion must find a
+        replica that caught back up over TCP, with no shared filesystem
+        to fall back on. Worker faults stay survivable (straggler +
+        duplicated result send) so the exactly-once seam is exercised
+        across the promotion boundary."""
+        return cls.generate(
+            seed,
+            workers,
+            kills=0,
+            partitions=0,
+            wedges=0,
+            hangs=0,
+            drains=0,
+            duplicate_sends=1,
+            stragglers=1,
+            drops=1,
+            dispatch_delays=0,
+            master_kills=1,
+            master_partitions=0,
+            replication_partitions=1,
+            follower_lags=1,
+        )
+
+    @classmethod
+    def generate_shard_kill(cls, seed: int, workers: int = 4) -> "FaultPlan":
+        """A shard-death schedule for the two-shard router scenario: one
+        shard's master is killed mid-run (its workers must re-home to the
+        survivor through the router) and the router itself is bounced
+        once (re-homing must ride out the window). Worker faults stay
+        survivable so every worker lives to re-home and the survivor's
+        dedup seam still sees a duplicated send."""
+        return cls.generate(
+            seed,
+            workers,
+            kills=0,
+            partitions=0,
+            wedges=0,
+            hangs=0,
+            drains=0,
+            duplicate_sends=1,
+            stragglers=1,
+            drops=1,
+            dispatch_delays=0,
+            master_kills=1,
+            master_partitions=0,
+            router_kills=1,
         )
 
     @classmethod

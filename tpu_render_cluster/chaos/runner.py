@@ -549,11 +549,61 @@ def build_parser() -> argparse.ArgumentParser:
         "cross-incarnation exactly-once invariant. Uses "
         "FaultPlan.generate_failover(seed, workers) unless --plan is given.",
     )
+    parser.add_argument(
+        "--replicated-failover",
+        dest="replicated_failover",
+        action="store_true",
+        help="Cross-host failover: the standby's ledger arrives by "
+        "STREAMING REPLICATION only (no shared filesystem); the stream "
+        "is partitioned and the follower lagged before the kill, then "
+        "the router's PromotionMonitor promotes the replica, which "
+        "finishes the job. Uses "
+        "FaultPlan.generate_replicated_failover(seed, workers) unless "
+        "--plan is given.",
+    )
+    parser.add_argument(
+        "--shard-kill",
+        dest="shard_kill",
+        action="store_true",
+        help="Two router-fronted shards, one killed whole (master AND "
+        "control endpoint) mid-backlog: every orphaned worker must "
+        "re-home through the router's route_worker op and the survivor "
+        "finish all --jobs exactly once, with the router's fan-outs "
+        "degrading the dead shard to absence. Uses "
+        "FaultPlan.generate_shard_kill(seed, workers) unless --plan is "
+        "given.",
+    )
     return parser
 
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.replicated_failover:
+        from tpu_render_cluster.ha.chaos import run_chaos_replicated_failover
+
+        plan = (
+            FaultPlan.from_toml(args.plan)
+            if args.plan
+            else FaultPlan.generate_replicated_failover(args.seed, args.workers)
+        )
+        report = run_chaos_replicated_failover(
+            plan, frames=args.frames, timeout=args.timeout
+        )
+        print(json.dumps(report.to_dict(), indent=2))
+        return 0 if report.ok else 1
+    if args.shard_kill:
+        from tpu_render_cluster.ha.chaos import run_chaos_shard_kill
+
+        plan = (
+            FaultPlan.from_toml(args.plan)
+            if args.plan
+            else FaultPlan.generate_shard_kill(args.seed, args.workers)
+        )
+        report = run_chaos_shard_kill(
+            plan, jobs=args.jobs, frames=args.frames, timeout=args.timeout
+        )
+        print(json.dumps(report.to_dict(), indent=2))
+        return 0 if report.ok else 1
     if args.failover:
         from tpu_render_cluster.ha.chaos import run_chaos_failover_job
 

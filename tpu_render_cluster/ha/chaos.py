@@ -27,6 +27,21 @@ exactly-once equation::
 plus zero ghost mirrors, zero unplanned evictions/drains, and a merged
 cluster timeline whose flows all resolve. MTTR is measured as
 kill -> first post-adoption queue-add dispatch.
+
+Two cross-host scenarios build on the same skeleton:
+
+- ``run_chaos_replicated_failover`` — the standby's ledger arrives by
+  STREAMING REPLICATION (ha/replicate.py), never a shared directory; the
+  stream is partitioned and lagged mid-job (``replication_partition`` /
+  ``follower_lag``), then the primary dies and the router's
+  ``PromotionMonitor`` promotes the follower (epoch bump out-fencing the
+  dead primary), which finishes the job on the primary's port.
+- ``run_chaos_shard_kill`` — two router-fronted ``JobManager`` shards;
+  one is killed whole-host (master + control) and the router itself is
+  bounced (``router_kill``); the orphaned workers re-home to the
+  survivor through ``route_worker`` and the survivor completes the
+  entire backlog exactly once, with the dead shard degraded to absence
+  in every fan-out.
 """
 
 from __future__ import annotations
@@ -40,8 +55,11 @@ from typing import Any
 
 from tpu_render_cluster.chaos.inject import MasterChaosHooks, WorkerChaosController
 from tpu_render_cluster.chaos.plan import (
+    KIND_FOLLOWER_LAG,
     KIND_MASTER_KILL,
     KIND_MASTER_PARTITION,
+    KIND_REPLICATION_PARTITION,
+    KIND_ROUTER_KILL,
     FaultPlan,
 )
 from tpu_render_cluster.chaos.runner import (
@@ -425,3 +443,777 @@ def run_chaos_failover_job(
     return ChaosReport(
         plan=plan, violations=violations, stats=stats, artifacts=artifacts
     )
+
+
+# ---------------------------------------------------------------------------
+# Cross-host replicated failover: streaming replication, NO shared filesystem
+
+
+async def _replicated_failover_run(
+    job,
+    plan: FaultPlan,
+    backends: list[FaultyBackend],
+    controllers: list[WorkerChaosController],
+    hooks: MasterChaosHooks,
+    registries: list[MetricsRegistry],
+    primary_registry: MetricsRegistry,
+    follower_registry: MetricsRegistry,
+    standby_registry: MetricsRegistry,
+    router_registry: MetricsRegistry,
+    primary_directory: Path,
+    replica_directory: Path,
+    failover_stats: dict[str, Any],
+):
+    from tpu_render_cluster.ha.replicate import (
+        LedgerFollower,
+        PromotableFollower,
+        ReplicationServer,
+    )
+    from tpu_render_cluster.ha.shards import PromotionMonitor, ShardRouter
+
+    loop = asyncio.get_running_loop()
+    started = loop.time()
+    watchdogs: list[asyncio.Task] = []
+    holder: dict[str, Any] = {}
+
+    primary_ledger = JobLedger.open(primary_directory, metrics=primary_registry)
+    replication = ReplicationServer(
+        primary_ledger, host="127.0.0.1", port=0, metrics=primary_registry
+    )
+    await replication.start()
+    primary = ClusterManager(
+        "127.0.0.1",
+        0,
+        job,
+        metrics=primary_registry,
+        dispatch_delay_fn=hooks.dispatch_delay,
+        ledger=primary_ledger,
+    )
+    primary_task = asyncio.create_task(
+        primary.initialize_server_and_run_job(), name="primary-master"
+    )
+    while primary._server is None:
+        if primary_task.done():
+            await primary_task
+            raise RuntimeError("primary master exited before startup")
+        await asyncio.sleep(0.01)
+    port = primary.port
+    failover_stats["primary_epoch"] = primary_ledger.epoch
+
+    # The replica lives in a DIFFERENT directory on (conceptually) a
+    # different host: every byte it holds arrived over the TCP stream.
+    follower = LedgerFollower(
+        replica_directory,
+        "127.0.0.1",
+        replication.port,
+        metrics=follower_registry,
+        follower_id="chaos-follower",
+    )
+    follower.start()
+
+    def adoption_probe(worker_id: int, frame_index: int) -> float:
+        if "first_dispatch_at" not in failover_stats:
+            failover_stats["first_dispatch_at"] = time.time()
+        return hooks.dispatch_delay(worker_id, frame_index)
+
+    async def promote_callback(ledger: JobLedger) -> dict[str, Any]:
+        # The promoted replica serves on the SAME worker port the dead
+        # primary used, so the workers' ordinary reconnect loop lands on
+        # the new incarnation (epoch piggyback -> fresh sessions).
+        standby = ClusterManager(
+            "127.0.0.1",
+            port,
+            job,
+            metrics=standby_registry,
+            dispatch_delay_fn=adoption_probe,
+            ledger=ledger,
+        )
+        failover_stats["replayed_units"] = standby.replayed_units
+        failover_stats["standby_epoch"] = ledger.epoch
+        standby_task: asyncio.Task | None = None
+        for _attempt in range(STANDBY_BIND_RETRIES):
+            standby_task = asyncio.create_task(
+                standby.initialize_server_and_run_job(), name="standby-master"
+            )
+            while standby._server is None and not standby_task.done():
+                await asyncio.sleep(0.01)
+            if standby._server is not None:
+                break
+            try:
+                await standby_task
+            except OSError:
+                await asyncio.sleep(STANDBY_BIND_RETRY_SECONDS)
+                continue
+            raise RuntimeError("standby master exited before startup")
+        if standby._server is None:
+            raise RuntimeError(
+                f"standby could not bind port {port} after "
+                f"{STANDBY_BIND_RETRIES} attempts"
+            )
+        holder["standby"] = standby
+        holder["task"] = standby_task
+        return {
+            "ok": True,
+            "serving": True,
+            "host": "127.0.0.1",
+            "port": port,
+            "control_port": port,
+        }
+
+    control = PromotableFollower(
+        follower,
+        promote_callback=promote_callback,
+        host="127.0.0.1",
+        port=0,
+        metrics=standby_registry,
+    )
+    await control.start()
+
+    async def tcp_probe(_shard: int, host: str, probe_port: int) -> bool:
+        try:
+            _reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, probe_port), 0.25
+            )
+        except (OSError, asyncio.TimeoutError):
+            return False
+        writer.close()
+        return True
+
+    router = ShardRouter([("127.0.0.1", port)], metrics=router_registry)
+    monitor = PromotionMonitor(
+        router,
+        {0: [("127.0.0.1", control.port)]},
+        probe_fn=tcp_probe,
+        probe_interval=0.1,
+        promote_timeout=0.4,
+    )
+    monitor.start()
+
+    workers = [
+        Worker(
+            "127.0.0.1",
+            port,
+            backend,
+            metrics=registries[slot],
+            connection_wrapper=controllers[slot].wrap_connection,
+        )
+        for slot, backend in enumerate(backends)
+    ]
+    worker_tasks = [
+        asyncio.create_task(w.connect_and_run_to_job_completion()) for w in workers
+    ]
+    for slot, worker in enumerate(workers):
+        hooks.map_worker(worker.worker_id, slot)
+        controllers[slot].attach(worker, worker_tasks[slot].cancel)
+        watchdogs.append(
+            asyncio.create_task(
+                controllers[slot].run_timed_faults(),
+                name=f"chaos-watchdog-{slot}",
+            )
+        )
+
+    try:
+        killed = False
+        schedule = sorted(
+            plan.master_events() + plan.replication_events(),
+            key=lambda e: e.at_seconds,
+        )
+        for event in schedule:
+            await asyncio.sleep(max(0.0, started + event.at_seconds - loop.time()))
+            if event.kind == KIND_REPLICATION_PARTITION:
+                # Sever the stream and keep severing any reattach for the
+                # window: the follower must gap-detect + catch up after.
+                logger.info("chaos: partitioning the replication stream")
+                failover_stats["replication_partitions"] = (
+                    failover_stats.get("replication_partitions", 0) + 1
+                )
+                deadline = loop.time() + event.duration_seconds
+                while loop.time() < deadline:
+                    follower.abort_connection()
+                    await asyncio.sleep(0.05)
+            elif event.kind == KIND_FOLLOWER_LAG:
+                logger.info(
+                    "chaos: lagging the follower by %.3fs/record for %.2fs",
+                    event.multiplier, event.duration_seconds,
+                )
+                failover_stats["follower_lags"] = (
+                    failover_stats.get("follower_lags", 0) + 1
+                )
+                follower.inject_delay_seconds = event.multiplier
+
+                async def clear_lag(duration: float = event.duration_seconds):
+                    await asyncio.sleep(duration)
+                    follower.inject_delay_seconds = 0.0
+
+                watchdogs.append(asyncio.create_task(clear_lag()))
+            elif event.kind == KIND_ROUTER_KILL:
+                # This scenario's "router" is the promotion monitor; a
+                # dead router must merely delay promotion, never lose it.
+                logger.info("chaos: killing the router/monitor")
+                failover_stats["router_kills"] = (
+                    failover_stats.get("router_kills", 0) + 1
+                )
+                await monitor.stop()
+
+                async def revive_monitor(
+                    duration: float = event.duration_seconds,
+                ):
+                    await asyncio.sleep(duration)
+                    monitor.start()
+
+                watchdogs.append(asyncio.create_task(revive_monitor()))
+            elif event.kind == KIND_MASTER_PARTITION:
+                logger.info("chaos: partitioning the master from all workers")
+                failover_stats["master_partitions"] = (
+                    failover_stats.get("master_partitions", 0) + 1
+                )
+                for handle in primary.workers.values():
+                    handle.connection._connection.abort()
+            elif event.kind == KIND_MASTER_KILL and not killed:
+                killed = True
+                logger.info("chaos: killing the primary master (and stream)")
+                failover_stats["kill_at"] = time.time()
+                primary_task.cancel()
+                await asyncio.gather(primary_task, return_exceptions=True)
+                await replication.stop()
+
+        if not killed:
+            master_trace, worker_traces = await primary_task
+            return master_trace, worker_traces, primary, workers
+
+        # The router detects the death and promotes; wait for the standby
+        # it installs, then for the job to finish under it.
+        deadline = loop.time() + 60.0
+        while "task" not in holder:
+            if loop.time() > deadline:
+                raise RuntimeError(
+                    "promotion monitor never promoted the follower"
+                )
+            await asyncio.sleep(0.02)
+        standby = holder["standby"]
+        master_trace, worker_traces = await holder["task"]
+        if "first_dispatch_at" in failover_stats and "kill_at" in failover_stats:
+            mttr = (
+                failover_stats["first_dispatch_at"] - failover_stats["kill_at"]
+            )
+            failover_stats["mttr_seconds"] = mttr
+            standby_registry.gauge(
+                "ha_failover_mttr_seconds",
+                "Master kill to the standby's first post-adoption dispatch "
+                "in the most recent failover",
+            ).set(mttr)
+        failover_stats["promotions"] = list(monitor.promotions)
+        failover_stats["follower"] = {
+            "records_applied": follower.records_applied,
+            "last_seq": follower.last_seq,
+            "fenced": follower.fenced,
+            "lag": unit_latency_stats(list(follower.lag_samples)),
+        }
+        return master_trace, worker_traces, standby, workers
+    finally:
+        await monitor.stop()
+        await control.stop()
+        await follower.stop()
+        await replication.stop()
+        for watchdog in watchdogs:
+            watchdog.cancel()
+        await asyncio.gather(*watchdogs, return_exceptions=True)
+        _done, pending = await asyncio.wait(worker_tasks, timeout=3.0)
+        for task in pending:
+            task.cancel()
+        await asyncio.gather(*worker_tasks, return_exceptions=True)
+
+
+def run_chaos_replicated_failover(
+    plan: FaultPlan,
+    *,
+    frames: int = DEFAULT_FAILOVER_FRAMES,
+    primary_directory: str | Path | None = None,
+    replica_directory: str | Path | None = None,
+    results_directory: str | Path | None = None,
+    render_seconds: float = DEFAULT_RENDER_SECONDS,
+    timeout: float = 240.0,
+    tile_grid: tuple[int, int] | None = None,
+) -> ChaosReport:
+    """Cross-host failover under chaos: the ledger reaches the standby by
+    STREAMING REPLICATION only (ha/replicate.py), never a shared path.
+
+    The plan should come from ``FaultPlan.generate_replicated_failover``:
+    the stream is severed and re-established, the follower briefly
+    lagged, then the primary killed — the router's ``PromotionMonitor``
+    detects the death, promotes the most-caught-up follower (epoch bump),
+    and the promoted replica finishes the job on the primary's port. The
+    audit is ``check_failover_invariants`` over the promoted incarnation
+    — the cross-host exactly-once equation ``follower_replayed +
+    (ok - duplicates) == units`` — plus replication-specific checks
+    (promotion happened exactly once, the promoted epoch out-fences the
+    primary's, the replica directory is disjoint).
+    """
+    import os
+    import tempfile
+
+    job = _make_job(plan, frames, None, tile_grid)
+    if primary_directory is None:
+        primary_directory = Path(tempfile.mkdtemp(prefix="trc-ha-primary-"))
+    if replica_directory is None:
+        replica_directory = Path(tempfile.mkdtemp(prefix="trc-ha-replica-"))
+    primary_directory = Path(primary_directory)
+    replica_directory = Path(replica_directory)
+    if primary_directory.resolve() == replica_directory.resolve():
+        raise ValueError(
+            "replicated failover needs DISJOINT primary/replica "
+            "directories (that is the point)"
+        )
+
+    registries = [MetricsRegistry() for _ in range(plan.workers)]
+    controllers = [
+        WorkerChaosController(slot, plan.events_for(slot), registry=registries[slot])
+        for slot in range(plan.workers)
+    ]
+    primary_registry = MetricsRegistry()
+    follower_registry = MetricsRegistry()
+    standby_registry = MetricsRegistry()
+    router_registry = MetricsRegistry()
+    hooks = MasterChaosHooks(plan, registry=primary_registry)
+    backends = [
+        FaultyBackend(
+            MockBackend(
+                load_seconds=0.004,
+                save_seconds=0.004,
+                render_seconds=render_seconds,
+            ),
+            controllers[slot],
+        )
+        for slot in range(plan.workers)
+    ]
+    failover_stats: dict[str, Any] = {}
+    started = time.time()
+    # A compressed chaos run needs the follower to reattach fast after a
+    # severed stream (same spirit as _timing_overrides' env profile).
+    retry_env = {"TRC_HA_REPL_RETRY_SECONDS": "0.05"}
+    saved_retry = {name: os.environ.get(name) for name in retry_env}
+    os.environ.update(retry_env)
+    try:
+        with _timing_overrides(plan.timings):
+            master_trace, worker_traces, manager, workers = asyncio.run(
+                asyncio.wait_for(
+                    _replicated_failover_run(
+                        job,
+                        plan,
+                        backends,
+                        controllers,
+                        hooks,
+                        registries,
+                        primary_registry,
+                        follower_registry,
+                        standby_registry,
+                        router_registry,
+                        primary_directory,
+                        replica_directory,
+                        failover_stats,
+                    ),
+                    timeout,
+                )
+            )
+    finally:
+        for name, value in saved_retry.items():
+            if value is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = value
+
+    artifacts: dict[str, str] = {}
+    if results_directory is not None:
+        results_directory = Path(results_directory)
+        results_directory.mkdir(parents=True, exist_ok=True)
+        prefix = results_directory / (
+            f"replicated-failover-{plan.seed}-{plan.fingerprint()}"
+        )
+        trace_path, metrics_path, cluster_trace_path = (
+            local_harness.save_obs_artifacts(prefix, manager, workers)
+        )
+        artifacts = {
+            "trace_events": str(trace_path),
+            "metrics": str(metrics_path),
+            "cluster_trace": str(cluster_trace_path),
+        }
+        cluster_trace_document = json.loads(
+            Path(cluster_trace_path).read_text(encoding="utf-8")
+        )
+    else:
+        from tpu_render_cluster.obs import merge_timeline
+
+        cluster_trace_document = merge_timeline(
+            manager.cluster_timeline_processes()
+        )
+
+    violations = check_failover_invariants(
+        manager, plan, cluster_trace_document=cluster_trace_document
+    )
+    promotions = failover_stats.get("promotions", [])
+    if len(promotions) != 1:
+        violations.append(
+            f"promotion: expected exactly one router-driven promotion, "
+            f"monitor recorded {len(promotions)}"
+        )
+    primary_epoch = failover_stats.get("primary_epoch")
+    standby_epoch = failover_stats.get("standby_epoch")
+    if (
+        primary_epoch is not None
+        and standby_epoch is not None
+        and standby_epoch <= primary_epoch
+    ):
+        violations.append(
+            f"epoch fence: promoted epoch {standby_epoch} does not exceed "
+            f"the dead primary's {primary_epoch}"
+        )
+    follower_stats = failover_stats.get("follower", {})
+    if follower_stats.get("records_applied", 0) <= 0:
+        violations.append(
+            "replication: the follower applied no records before promotion "
+            "— the standby replayed a stale (or empty) replica"
+        )
+
+    from tpu_render_cluster.chaos.invariants import ledger_stats
+
+    stats: dict[str, Any] = {
+        "frames_total": len(manager.state.frames),
+        "tiles_per_frame": job.tiles_per_frame(),
+        "job_seconds": master_trace.job_finish_time - master_trace.job_start_time,
+        "wall_seconds": time.time() - started,
+        "worker_traces_collected": len(worker_traces),
+        "failover": failover_stats,
+        "ledger": {
+            **ledger_stats(manager.metrics.snapshot()),
+            "stale_epoch_results": manager.state.ledger["stale_epoch_results"],
+        },
+        "primary_ledger": ledger_stats(primary_registry.snapshot()),
+        "unit_latency": unit_latency_stats(manager.state.unit_seconds),
+    }
+    return ChaosReport(
+        plan=plan, violations=violations, stats=stats, artifacts=artifacts
+    )
+
+
+# ---------------------------------------------------------------------------
+# Shard death under a router: workers re-home to the survivor
+
+
+async def _shard_kill_run(
+    specs: list[dict[str, Any]],
+    plan: FaultPlan,
+    backends: list[FaultyBackend],
+    controllers: list[WorkerChaosController],
+    hooks: MasterChaosHooks,
+    registries: list[MetricsRegistry],
+    shard_registries: list[MetricsRegistry],
+    router_registry: MetricsRegistry,
+    kill_stats: dict[str, Any],
+):
+    from tpu_render_cluster.ha.shards import ShardRouter, ShardRouterServer
+    from tpu_render_cluster.sched.control import ControlServer, control_request
+    from tpu_render_cluster.sched.manager import JobManager, SchedulerConfig
+    from tpu_render_cluster.worker.main import make_router_route_fn
+
+    loop = asyncio.get_running_loop()
+    started = loop.time()
+    watchdogs: list[asyncio.Task] = []
+
+    managers: list[JobManager] = []
+    serves: list[asyncio.Task] = []
+    controls: list[ControlServer] = []
+    for shard in range(2):
+        manager = JobManager(
+            "127.0.0.1",
+            0,
+            config=SchedulerConfig.from_env(),
+            metrics=shard_registries[shard],
+            # Every submitted job name hashes onto shard 1 (the survivor),
+            # so the plan's dispatch hooks belong there.
+            dispatch_delay_fn=hooks.dispatch_delay if shard == 1 else None,
+        )
+        serve_task = asyncio.create_task(manager.serve(), name=f"shard-{shard}")
+        while manager._server is None:
+            if serve_task.done():
+                await serve_task
+                raise RuntimeError(f"shard {shard} exited before startup")
+            await asyncio.sleep(0.01)
+        control = ControlServer(manager, "127.0.0.1", 0)
+        await control.start()
+        managers.append(manager)
+        serves.append(serve_task)
+        controls.append(control)
+
+    router = ShardRouter(
+        [("127.0.0.1", c.port) for c in controls],
+        worker_endpoints=[("127.0.0.1", m.port) for m in managers],
+        metrics=router_registry,
+    )
+    server = ShardRouterServer(router)
+    await server.start()
+    route_fn = make_router_route_fn(f"127.0.0.1:{server.port}")
+
+    # First half of the pool homes on the doomed shard 0, the rest on the
+    # survivor; everyone runs the re-homing serve loop.
+    def home(slot: int) -> int:
+        return 0 if slot < len(backends) // 2 else 1
+
+    workers = [
+        Worker(
+            "127.0.0.1",
+            managers[home(slot)].port,
+            backend,
+            metrics=registries[slot],
+            connection_wrapper=controllers[slot].wrap_connection,
+        )
+        for slot, backend in enumerate(backends)
+    ]
+    worker_tasks = [
+        asyncio.create_task(w.connect_and_serve(route_fn)) for w in workers
+    ]
+    for slot, worker in enumerate(workers):
+        hooks.map_worker(worker.worker_id, slot)
+        controllers[slot].attach(worker, worker_tasks[slot].cancel)
+        watchdogs.append(
+            asyncio.create_task(
+                controllers[slot].run_timed_faults(),
+                name=f"chaos-watchdog-{slot}",
+            )
+        )
+
+    try:
+        job_ids: list[str] = []
+        for spec in specs:
+            response = await control_request(
+                "127.0.0.1", server.port, {"op": "submit", "spec": spec}
+            )
+            if not response.get("ok"):
+                raise RuntimeError(f"router submit failed: {response.get('error')}")
+            if not response["job_id"].startswith("s1/"):
+                raise RuntimeError(
+                    f"job {spec['job']['job_name']!r} routed to "
+                    f"{response['job_id']} — shard-kill jobs must hash to "
+                    "the survivor (shard 1)"
+                )
+            job_ids.append(response["job_id"])
+
+        killed = False
+        schedule = sorted(
+            plan.master_events() + plan.replication_events(),
+            key=lambda e: e.at_seconds,
+        )
+        for event in schedule:
+            await asyncio.sleep(max(0.0, started + event.at_seconds - loop.time()))
+            if event.kind == KIND_MASTER_KILL and not killed:
+                killed = True
+                logger.info("chaos: killing shard 0 (master + control)")
+                kill_stats["kill_at"] = time.time()
+                serves[0].cancel()
+                await asyncio.gather(serves[0], return_exceptions=True)
+                # The whole host dies: the control endpoint goes with the
+                # master, so the router sees the shard as unreachable (not
+                # a zombie answering status for a dead scheduler).
+                await controls[0].stop()
+            elif event.kind == KIND_ROUTER_KILL:
+                logger.info("chaos: killing the shard router for %.2fs",
+                            event.duration_seconds)
+                kill_stats["router_kills"] = (
+                    kill_stats.get("router_kills", 0) + 1
+                )
+                await server.stop()
+
+                async def revive_router(duration: float = event.duration_seconds):
+                    await asyncio.sleep(duration)
+                    await server.start()
+
+                watchdogs.append(asyncio.create_task(revive_router()))
+            elif event.kind == KIND_MASTER_PARTITION:
+                logger.info("chaos: partitioning shard 0 from its workers")
+                for handle in managers[0].workers.values():
+                    handle.connection._connection.abort()
+            # replication_partition / follower_lag have no replication
+            # plane in this scenario; they are inert if a plan carries them.
+
+        if not killed:
+            raise RuntimeError(
+                "shard-kill plan has no master_kill event; use "
+                "FaultPlan.generate_shard_kill"
+            )
+
+        # The orphaned workers re-home through the router; wait for the
+        # survivor to have adopted the whole pool before draining so the
+        # re-home itself is part of the audited run.
+        deadline = loop.time() + 30.0
+        while (
+            len(managers[1].workers) < len(workers) and loop.time() < deadline
+        ):
+            await asyncio.sleep(0.05)
+        kill_stats["survivor_workers"] = len(managers[1].workers)
+        if len(managers[1].workers) >= len(workers):
+            kill_stats["rehome_seconds"] = time.time() - kill_stats["kill_at"]
+
+        # Drain through the router: the dead shard degrades to absence
+        # (plus the scrape-failure counter), never to a connection error.
+        drained = await control_request(
+            "127.0.0.1", server.port, {"op": "drain"}
+        )
+        kill_stats["drain_ok"] = bool(drained.get("ok"))
+        kill_stats["drain_unreachable"] = drained.get("unreachable")
+        worker_traces = await serves[1]
+        return worker_traces, managers, workers, job_ids
+    finally:
+        await server.stop()
+        for control in controls:
+            await control.stop()
+        for serve_task in serves:
+            if not serve_task.done():
+                serve_task.cancel()
+        await asyncio.gather(*serves, return_exceptions=True)
+        for watchdog in watchdogs:
+            watchdog.cancel()
+        await asyncio.gather(*watchdogs, return_exceptions=True)
+        _done, pending = await asyncio.wait(worker_tasks, timeout=3.0)
+        for task in pending:
+            task.cancel()
+        await asyncio.gather(*worker_tasks, return_exceptions=True)
+
+
+def run_chaos_shard_kill(
+    plan: FaultPlan,
+    *,
+    jobs: int = 2,
+    frames: int = 32,
+    render_seconds: float = DEFAULT_RENDER_SECONDS,
+    timeout: float = 240.0,
+) -> ChaosReport:
+    """Two router-fronted shards, one killed mid-backlog: the orphans
+    re-home and the survivor completes every job.
+
+    The plan should come from ``FaultPlan.generate_shard_kill``: shard
+    0's master AND control endpoint die at the scheduled offset (a whole
+    host gone), the router is bounced once so re-homing has to retry
+    through the window, and the survivable worker faults (straggler,
+    duplicated send, dropped send) keep the dedup seam honest across the
+    re-home. All jobs are submitted THROUGH the router with names that
+    hash onto shard 1, so killing shard 0 orphans only workers — the
+    audit then demands the survivor finish the whole backlog exactly
+    once (``check_multi_job_invariants``), the full pool re-homed, and
+    the router's fan-outs degraded (absence + counter), not errored.
+    """
+    from tpu_render_cluster.ha.shards import shard_for_job_name
+    from tpu_render_cluster.sched.models import JOB_FINISHED
+
+    base = _make_job(plan, frames, None, None)
+    names: list[str] = []
+    candidate = 0
+    while len(names) < jobs:
+        name = f"chaos-seed-{plan.seed}-sk{candidate}"
+        candidate += 1
+        if shard_for_job_name(name, 2) == 1:
+            names.append(name)
+    survivor_pool = plan.workers - plan.workers // 2
+    specs = [
+        {
+            "job": {
+                **base.to_dict(),
+                "job_name": name,
+                "wait_for_number_of_workers": survivor_pool,
+            },
+            "weight": float(i + 1),
+        }
+        for i, name in enumerate(names)
+    ]
+
+    registries = [MetricsRegistry() for _ in range(plan.workers)]
+    controllers = [
+        WorkerChaosController(slot, plan.events_for(slot), registry=registries[slot])
+        for slot in range(plan.workers)
+    ]
+    shard_registries = [MetricsRegistry(), MetricsRegistry()]
+    router_registry = MetricsRegistry()
+    hooks = MasterChaosHooks(plan, registry=shard_registries[1])
+    backends = [
+        FaultyBackend(
+            MockBackend(
+                load_seconds=0.004,
+                save_seconds=0.004,
+                render_seconds=render_seconds,
+            ),
+            controllers[slot],
+        )
+        for slot in range(plan.workers)
+    ]
+    kill_stats: dict[str, Any] = {}
+    started = time.time()
+    with _timing_overrides(plan.timings):
+        worker_traces, managers, workers, job_ids = asyncio.run(
+            asyncio.wait_for(
+                _shard_kill_run(
+                    specs,
+                    plan,
+                    backends,
+                    controllers,
+                    hooks,
+                    registries,
+                    shard_registries,
+                    router_registry,
+                    kill_stats,
+                ),
+                timeout,
+            )
+        )
+
+    from tpu_render_cluster.chaos.invariants import (
+        check_multi_job_invariants,
+        counter_total,
+        ledger_stats,
+    )
+    from tpu_render_cluster.obs import merge_timeline
+
+    survivor = managers[1]
+    cluster_trace_document = merge_timeline(survivor.cluster_timeline_processes())
+    violations = check_multi_job_invariants(
+        survivor, plan, cluster_trace_document=cluster_trace_document
+    )
+    for job_id in job_ids:
+        inner = job_id.split("/", 1)[1]
+        run = survivor._runs.get(inner)
+        if run is None:
+            violations.append(f"{job_id}: survivor has no such run")
+        elif run.status != JOB_FINISHED:
+            violations.append(
+                f"{job_id}: ended the run in state {run.status!r}, "
+                "expected finished"
+            )
+    if kill_stats.get("survivor_workers", 0) < plan.workers:
+        violations.append(
+            f"re-home: only {kill_stats.get('survivor_workers', 0)} of "
+            f"{plan.workers} worker(s) reached the survivor shard"
+        )
+    if not kill_stats.get("drain_ok"):
+        violations.append(
+            "router degrade: the drain fan-out through the router failed "
+            "outright instead of degrading the dead shard to absence"
+        )
+    router_snapshot = router_registry.snapshot()
+    if counter_total(router_snapshot, "ha_router_scrape_failures_total") < 1:
+        violations.append(
+            "router degrade: no ha_router_scrape_failures_total sample — "
+            "the dead shard was never degraded through a fan-out"
+        )
+
+    stats: dict[str, Any] = {
+        "jobs": {
+            job_id: survivor.job_status(job_id.split("/", 1)[1])
+            for job_id in job_ids
+        },
+        "frames_total": frames * jobs,
+        "wall_seconds": time.time() - started,
+        "worker_traces_collected": len(worker_traces),
+        "shard_kill": kill_stats,
+        "ledger": ledger_stats(survivor.metrics.snapshot()),
+        "router_scrape_failures": counter_total(
+            router_snapshot, "ha_router_scrape_failures_total"
+        ),
+    }
+    return ChaosReport(plan=plan, violations=violations, stats=stats)

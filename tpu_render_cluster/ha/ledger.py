@@ -319,6 +319,7 @@ class JobLedger:
         self._seq = 0
         self._since_snapshot = 0
         self._replay: LedgerReplay | None = None
+        self._commit_listeners: list[Callable[[int, dict[str, Any]], None]] = []
 
     # -- construction --------------------------------------------------------
 
@@ -389,6 +390,90 @@ class JobLedger:
                 self._segment_file.close()
                 self._segment_file = None
 
+    # -- replication hooks ---------------------------------------------------
+
+    def add_commit_listener(
+        self, listener: Callable[[int, dict[str, Any]], None]
+    ) -> None:
+        """Register a callback invoked with ``(seq, record)`` after every
+        DURABLE append — i.e. after the fsync, so a listener never observes
+        a record that a crash could still un-write. Listeners run on the
+        appending thread (usually the ``AsyncLedgerAppender`` worker
+        thread) and must be cheap and thread-safe; the replication
+        streamer (ha/replicate.py) uses ``loop.call_soon_threadsafe`` to
+        hop back onto its event loop. A listener that raises is logged and
+        dropped from the append path's perspective — replication is a
+        best-effort tail, never a reason to fail the primary's write."""
+        self._commit_listeners.append(listener)
+
+    def remove_commit_listener(
+        self, listener: Callable[[int, dict[str, Any]], None]
+    ) -> None:
+        try:
+            self._commit_listeners.remove(listener)
+        except ValueError:
+            pass
+
+    def records_since(
+        self, after_seq: int
+    ) -> tuple[dict[str, Any] | None, list[dict[str, Any]]]:
+        """Everything committed after sequence ``after_seq``, for a
+        follower attach / re-fetch.
+
+        Returns ``(snapshot, records)``: when ``after_seq`` predates the
+        compaction floor (the snapshot's seq), the snapshot document is
+        returned and ``records`` holds only what the segments carry beyond
+        it; otherwise ``snapshot`` is None and ``records`` holds every
+        on-disk record with ``seq > after_seq`` in sequence order. Reads
+        the segments from disk — ``append`` flushes per record, so the
+        disk view is current — and skips an unparsable final tail (a
+        record mid-write with fsync disabled is not yet committed)."""
+        snapshot: dict[str, Any] | None = None
+        snapshot_path = self.directory / "snapshot.json"
+        if snapshot_path.is_file():
+            try:
+                data = json.loads(snapshot_path.read_text(encoding="utf-8"))
+            except (OSError, json.JSONDecodeError) as e:
+                raise LedgerCorruptError(f"unreadable snapshot: {e}") from e
+            floor = int(data.get("seq", 0))
+            if after_seq < floor:
+                snapshot = data
+                after_seq = floor
+        records: list[dict[str, Any]] = []
+        segments = self._segments()
+        for position, (_, segment_path) in enumerate(segments):
+            raw = segment_path.read_bytes()
+            if not raw:
+                continue
+            lines = raw.split(b"\n")
+            body, tail = lines[:-1], lines[-1]
+            for line in body:
+                try:
+                    record = json.loads(line)
+                    seq = int(record["seq"])
+                except (ValueError, KeyError, TypeError) as e:
+                    raise LedgerCorruptError(
+                        f"{segment_path.name}: malformed record ({e})"
+                    ) from e
+                if seq > after_seq:
+                    records.append(record)
+            if tail != b"" and position == len(segments) - 1:
+                try:
+                    record = json.loads(tail)
+                    if int(record["seq"]) > after_seq:
+                        records.append(record)
+                except (ValueError, KeyError, TypeError):
+                    pass  # torn in-progress append: not committed yet
+        records.sort(key=lambda r: int(r["seq"]))
+        return snapshot, records
+
+    def _notify_commit(self, seq: int, record: dict[str, Any]) -> None:
+        for listener in list(self._commit_listeners):
+            try:
+                listener(seq, record)
+            except Exception as e:  # noqa: BLE001 - replication is best-effort
+                logger.error("Ledger commit listener failed: %s", e)
+
     # -- append path ---------------------------------------------------------
 
     def append(self, record_type: str, job_name: str, **fields: Any) -> None:
@@ -430,6 +515,7 @@ class JobLedger:
                 "Records appended to the write-ahead job ledger, by type",
                 labels=("type",),
             ).inc(type=record_type)
+        self._notify_commit(self._seq, record)
         self._since_snapshot += 1
         every = _snapshot_every()
         if every > 0 and self._since_snapshot >= every:

@@ -1,0 +1,988 @@
+"""Ledger streaming replication: a standby on ANOTHER HOST, no shared disk.
+
+PR 11's failover story required the standby to open the primary's ledger
+directory — shared storage. This module removes that requirement: the
+primary streams every committed ledger record over the wire and a
+follower maintains its own replay-ready replica directory, so promotion
+is a plain ``JobLedger.open()`` on the FOLLOWER's local disk.
+
+Three pieces:
+
+- :class:`ReplicationServer` — primary side. A JSON-lines TCP endpoint
+  (the sched/control.py idiom: one ``protocol.messages`` envelope per
+  line) serving N followers. An attach request carries the follower's
+  last contiguous sequence number; the primary answers with its epoch,
+  its current head, and — when the follower's position predates the
+  compaction floor — the snapshot document, then the backlog records,
+  then the live tail (fed by the ledger's post-fsync commit listener, so
+  a follower can never observe a record a crash could still un-write).
+  Followers ack cumulatively; the primary's per-follower lag gauge is
+  derived from the acks.
+
+- :class:`LedgerFollower` — follower side. Tails the stream into a local
+  segmented replica (same on-disk format as the primary's, torn-tail
+  recovery included), persisting the primary's epoch so a later
+  promotion out-fences it. Strictly sequential: a sequence gap, a torn
+  mid-stream line, or a record/envelope mismatch aborts the connection
+  and re-attaches from the last contiguous record (truncate-and-refetch
+  — a partial record is NEVER applied). Epoch-fenced on both ends: the
+  primary refuses an attach from a follower that has durably seen a
+  NEWER epoch (the primary is deposed), and the follower refuses a
+  stream whose epoch is OLDER than its own (a deposed primary revived).
+
+- :class:`PromotableFollower` — the follower's control endpoint. A tiny
+  JSON-lines server (``status`` / ``promote`` / ``ping``) the shard
+  router's liveness monitor drives: ``promote`` stops the tail, opens
+  the replica ledger (epoch bump > every epoch the dead primary ever
+  streamed), and hands it to an injected callback that builds the
+  serving master — returning the endpoints the router re-routes to.
+
+Tuning (``TRC_HA_REPL_*``, utils/env.py idiom): ``TRC_HA_REPL_ACK_EVERY``
+records per cumulative ack, ``TRC_HA_REPL_RETRY_SECONDS`` between
+follower re-attach attempts.
+
+CLI: ``python -m tpu_render_cluster.ha.replicate --directory D
+--primary HOST:PORT --controlPort C`` runs a follower with its control
+endpoint; add ``--servePort``/``--serveControlPort`` to let a promotion
+start the full scheduler service from the adopted ledger in-process.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import time
+from collections import deque
+from pathlib import Path
+from typing import Any, Awaitable, Callable
+
+from tpu_render_cluster.ha.ledger import (
+    JobLedger,
+    LedgerReplay,
+    _check_version,
+    _fsync_dir,
+    _fsync_enabled,
+    _segment_max_records,
+    _SEGMENT_RE,
+)
+from tpu_render_cluster.protocol.messages import (
+    Message,
+    ReplicationAckEvent,
+    ReplicationAttachRequest,
+    ReplicationAttachResponse,
+    ReplicationRecordEvent,
+    decode_message,
+    encode_message,
+)
+from tpu_render_cluster.utils.env import env_float, env_int
+
+logger = logging.getLogger(__name__)
+
+MAX_LINE_BYTES = 16 * 1024 * 1024
+
+# Seconds of stream silence before the follower flushes a pending ack
+# anyway, keeping the primary's lag gauge fresh between append bursts.
+IDLE_ACK_SECONDS = 1.0
+
+
+def _ack_every() -> int:
+    return max(1, env_int("TRC_HA_REPL_ACK_EVERY", 32))
+
+
+def _retry_seconds() -> float:
+    return max(0.01, env_float("TRC_HA_REPL_RETRY_SECONDS", 0.5))
+
+
+def _encode_line(message: Message) -> bytes:
+    return encode_message(message).encode("utf-8") + b"\n"
+
+
+class ReplicationFencedError(RuntimeError):
+    """The attach was refused on epoch grounds — retrying is pointless
+    until an operator re-points the follower (or this end IS the stale
+    one and must stand down)."""
+
+
+# ---------------------------------------------------------------------------
+# Primary side
+
+
+class _FollowerStream:
+    """One attached follower's live-tail state on the primary."""
+
+    __slots__ = ("follower_id", "queue", "sent_floor", "acked_seq")
+
+    def __init__(self, follower_id: str) -> None:
+        self.follower_id = follower_id
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self.sent_floor = 0  # records <= floor went out with the backlog
+        self.acked_seq = 0
+
+
+class ReplicationServer:
+    """Primary-side replication endpoint over an ``open()``'d ledger."""
+
+    def __init__(
+        self,
+        ledger: JobLedger,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        metrics=None,
+    ) -> None:
+        self.ledger = ledger
+        self.host = host
+        self.port = port
+        self.metrics = metrics
+        self._server: asyncio.Server | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._streams: set[_FollowerStream] = set()
+        self._listening = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._server = await asyncio.start_server(
+            self._on_connection, self.host, self.port, limit=MAX_LINE_BYTES
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        self.ledger.add_commit_listener(self._on_commit)
+        self._listening = True
+        logger.info(
+            "Ledger replication streaming on %s:%d (epoch %d).",
+            self.host, self.port, self.ledger.epoch,
+        )
+
+    async def stop(self) -> None:
+        self._listening = False
+        self.ledger.remove_commit_listener(self._on_commit)
+        if self._server is not None:
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 5.0)
+            except asyncio.TimeoutError:
+                logger.warning("Replication server close timed out.")
+            self._server = None
+
+    # -- live tail feed (called from the appender thread) --------------------
+
+    def _on_commit(self, seq: int, record: dict[str, Any]) -> None:
+        loop = self._loop
+        if loop is None or loop.is_closed() or not self._listening:
+            return
+        try:
+            loop.call_soon_threadsafe(self._fan_out_record, seq, record)
+        except RuntimeError:  # loop shut down between the checks
+            pass
+
+    def _fan_out_record(self, seq: int, record: dict[str, Any]) -> None:
+        for stream in self._streams:
+            stream.queue.put_nowait((seq, record))
+
+    # -- connection handling -------------------------------------------------
+
+    def _count_refused(self, end: str) -> None:
+        if self.metrics is not None:
+            self.metrics.counter(
+                "ha_replication_refused_total",
+                "Replication attaches refused on epoch-fencing grounds, "
+                "by which end refused (primary = deposed self, follower = "
+                "stale stream)",
+                labels=("end",),
+            ).inc(end=end)
+
+    def _set_follower_gauge(self) -> None:
+        if self.metrics is not None:
+            self.metrics.gauge(
+                "ha_replication_followers_units",
+                "Followers currently attached to this primary's stream",
+            ).set(len(self._streams))
+
+    async def _on_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        peer = writer.get_extra_info("peername")
+        stream: _FollowerStream | None = None
+        sender: asyncio.Task | None = None
+        try:
+            line = await asyncio.wait_for(reader.readline(), 30.0)
+            if not line:
+                return
+            request = decode_message(line)
+            if not isinstance(request, ReplicationAttachRequest):
+                logger.warning(
+                    "Replication connection from %s opened with %s; closing.",
+                    peer, type(request).__name__,
+                )
+                return
+            follower_id = request.follower_id or f"{peer}"
+            head = self.ledger.replay.last_seq
+            if request.epoch is not None and request.epoch > self.ledger.epoch:
+                # The follower has durably seen a NEWER master epoch than
+                # ours: we are a deposed primary. Refuse to stream the
+                # stale timeline instead of splitting the brain.
+                self._count_refused("primary")
+                writer.write(_encode_line(ReplicationAttachResponse(
+                    request.message_request_id,
+                    epoch=self.ledger.epoch,
+                    primary_seq=head,
+                    error=(
+                        f"primary epoch {self.ledger.epoch} predates "
+                        f"follower-observed epoch {request.epoch}; this "
+                        "primary is deposed"
+                    ),
+                )))
+                await writer.drain()
+                return
+            # Register the live tail BEFORE the backlog read: a commit
+            # landing while the segment files are read off-loop buffers in
+            # stream.queue (the sender starts after the backlog goes out),
+            # and the sent floor skips whatever the backlog read already
+            # covered — no record can land in the crack either way.
+            stream = _FollowerStream(follower_id)
+            self._streams.add(stream)
+            self._set_follower_gauge()
+            snapshot, records = await asyncio.to_thread(
+                self.ledger.records_since, request.last_seq
+            )
+            stream.sent_floor = max(
+                [request.last_seq]
+                + ([int(snapshot["seq"])] if snapshot is not None else [])
+                + [int(r["seq"]) for r in records]
+            )
+            writer.write(_encode_line(ReplicationAttachResponse(
+                request.message_request_id,
+                epoch=self.ledger.epoch,
+                primary_seq=head,
+                snapshot=snapshot,
+            )))
+            if snapshot is not None and self.metrics is not None:
+                self.metrics.counter(
+                    "ha_replication_snapshots_sent_total",
+                    "Ledger snapshots shipped to followers whose attach "
+                    "position predated the compaction floor",
+                ).inc()
+            sent = 0
+            for record in records:
+                writer.write(_encode_line(
+                    ReplicationRecordEvent(int(record["seq"]), record)
+                ))
+                sent += 1
+                if sent % 256 == 0:
+                    await writer.drain()
+            await writer.drain()
+            if self.metrics is not None and sent:
+                self.metrics.counter(
+                    "ha_replication_records_sent_total",
+                    "Ledger records streamed to followers (backlog + live)",
+                    labels=("follower",),
+                ).inc(sent, follower=follower_id)
+            logger.info(
+                "Follower %s attached at seq %d (%d backlog record(s)%s).",
+                follower_id, request.last_seq, sent,
+                ", snapshot" if snapshot is not None else "",
+            )
+            sender = asyncio.create_task(
+                self._pump(stream, writer), name=f"repl-pump-{follower_id}"
+            )
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                try:
+                    message = decode_message(line)
+                except ValueError:
+                    return
+                if isinstance(message, ReplicationAckEvent):
+                    stream.acked_seq = max(stream.acked_seq, message.seq)
+                    if self.metrics is not None:
+                        self.metrics.gauge(
+                            "ha_replication_lag_units",
+                            "Committed records not yet acked by each "
+                            "follower (primary head minus cumulative ack)",
+                            labels=("follower",),
+                        ).set(
+                            max(0, self.ledger.replay.last_seq - stream.acked_seq),
+                            follower=follower_id,
+                        )
+        except (
+            ConnectionError,
+            asyncio.IncompleteReadError,
+            asyncio.TimeoutError,
+            OSError,
+            ValueError,
+        ) as e:
+            logger.info("Replication connection from %s ended: %s", peer, e)
+        finally:
+            if sender is not None:
+                sender.cancel()
+                try:
+                    await sender
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
+            if stream is not None:
+                self._streams.discard(stream)
+                self._set_follower_gauge()
+            writer.close()
+
+    async def _pump(
+        self, stream: _FollowerStream, writer: asyncio.StreamWriter
+    ) -> None:
+        """Forward live-committed records to one follower, in order."""
+        while True:
+            seq, record = await stream.queue.get()
+            if seq <= stream.sent_floor:
+                continue  # the backlog already carried it
+            writer.write(_encode_line(ReplicationRecordEvent(seq, record)))
+            await writer.drain()
+            if self.metrics is not None:
+                self.metrics.counter(
+                    "ha_replication_records_sent_total",
+                    "Ledger records streamed to followers (backlog + live)",
+                    labels=("follower",),
+                ).inc(follower=stream.follower_id)
+
+
+# ---------------------------------------------------------------------------
+# Follower side
+
+
+class LedgerFollower:
+    """Tails a primary's record stream into a local replica directory.
+
+    The replica uses the exact ledger on-disk format, so promotion is
+    ``JobLedger.open(directory)`` — the epoch bump lands ABOVE every
+    epoch the primary ever streamed because each observed epoch is
+    persisted to the replica's ``EPOCH`` file as it arrives.
+    """
+
+    def __init__(
+        self,
+        directory: str | Path,
+        primary_host: str,
+        primary_port: int,
+        *,
+        metrics=None,
+        follower_id: str | None = None,
+    ) -> None:
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.primary_host = primary_host
+        self.primary_port = primary_port
+        self.metrics = metrics
+        self.follower_id = follower_id or f"follower-{os.getpid()}"
+        self.epoch = JobLedger.peek_epoch(self.directory)
+        self.replay = JobLedger.replay_directory(self.directory)
+        self.last_seq = self.replay.last_seq
+        self.records_applied = 0
+        self.fenced = False
+        self.promoted = False
+        # Chaos hook (``follower_lag`` fault kind): extra seconds slept
+        # before each record is applied, simulating a slow replica disk.
+        self.inject_delay_seconds = 0.0
+        # Raw apply-lag samples (seconds between the primary's append and
+        # the follower's durable apply) for the bench's p50/p99 readout.
+        self.lag_samples: deque[float] = deque(maxlen=4096)
+        self._running = False
+        self._task: asyncio.Task | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._segment_file = None
+        self._segment_records = 0
+        segments = self._segments()
+        self._segment_index = segments[-1][0] if segments else 0
+        if segments:
+            # Same crash repair open() performs: a torn local tail (the
+            # follower died mid-append) is truncated back to the last
+            # complete record; a complete record that merely lost its
+            # newline gets it appended. last_seq already excludes the
+            # torn record (replay_directory dropped it).
+            probe = JobLedger(self.directory, self.epoch)
+            if self.replay.torn_tail:
+                probe._truncate_torn_tail(segments[-1][1])
+            else:
+                probe._repair_missing_newline(segments[-1][1])
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(
+            self.run(), name=f"ledger-follower-{self.follower_id}"
+        )
+
+    async def run(self) -> None:
+        """Attach-and-stream until stopped or fenced; every failure mode
+        (connection loss, gap, torn line) re-attaches from the last
+        contiguous record after ``TRC_HA_REPL_RETRY_SECONDS``."""
+        self._running = True
+        while self._running and not self.fenced:
+            try:
+                await self._attach_and_stream()
+            except ReplicationFencedError as e:
+                logger.warning("Follower %s fenced: %s", self.follower_id, e)
+                self.fenced = True
+                break
+            except (
+                ConnectionError,
+                asyncio.IncompleteReadError,
+                asyncio.TimeoutError,
+                OSError,
+                ValueError,
+            ) as e:
+                if not self._running:
+                    break
+                if self.metrics is not None:
+                    self.metrics.counter(
+                        "ha_replication_reconnects_total",
+                        "Follower re-attach attempts after a stream "
+                        "failure (connection loss, gap, torn record)",
+                    ).inc()
+                logger.info(
+                    "Follower %s stream ended (%s); re-attaching from seq %d.",
+                    self.follower_id, e, self.last_seq,
+                )
+            try:
+                await asyncio.sleep(_retry_seconds())
+            except asyncio.CancelledError:
+                break
+
+    async def stop(self) -> None:
+        self._running = False
+        self.abort_connection()
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._task = None
+        await asyncio.to_thread(self._close_segment)
+
+    def abort_connection(self) -> None:
+        """Hard-drop the current stream connection (chaos
+        ``replication_partition``; also part of stop())."""
+        writer = self._writer
+        if writer is not None:
+            transport = writer.transport
+            if transport is not None:
+                transport.abort()
+
+    async def promote(self, *, metrics=None, flightrec=None) -> JobLedger:
+        """Stop tailing and claim the replica for a new master
+        incarnation. The returned ledger's epoch is strictly greater
+        than every epoch the dead primary ever streamed."""
+        await self.stop()
+        ledger = await asyncio.to_thread(
+            JobLedger.open,
+            self.directory,
+            metrics=metrics if metrics is not None else self.metrics,
+        )
+        self.promoted = True
+        if flightrec is not None:
+            from tpu_render_cluster.obs.flightrec import TRIGGER_PROMOTION
+
+            flightrec.trigger(
+                TRIGGER_PROMOTION,
+                {
+                    "follower_id": self.follower_id,
+                    "epoch": ledger.epoch,
+                    "replayed_seq": ledger.replay.last_seq,
+                },
+            )
+        logger.info(
+            "Follower %s promoted: epoch %d, %d record(s) in the replica.",
+            self.follower_id, ledger.epoch, ledger.replay.last_seq,
+        )
+        return ledger
+
+    # -- stream handling -----------------------------------------------------
+
+    async def _attach_and_stream(self) -> None:
+        reader, writer = await asyncio.open_connection(
+            self.primary_host, self.primary_port, limit=MAX_LINE_BYTES
+        )
+        self._writer = writer
+        try:
+            writer.write(_encode_line(ReplicationAttachRequest.new(
+                self.last_seq,
+                epoch=self.epoch if self.epoch > 0 else None,
+                follower_id=self.follower_id,
+            )))
+            await writer.drain()
+            line = await asyncio.wait_for(reader.readline(), 30.0)
+            if not line or not line.endswith(b"\n"):
+                raise ConnectionError("truncated attach response")
+            response = decode_message(line)
+            if not isinstance(response, ReplicationAttachResponse):
+                raise ValueError(
+                    f"expected an attach response, got {type(response).__name__}"
+                )
+            if response.error is not None:
+                # The primary refused us — it knows it is deposed. Its
+                # stream is stale; stop tailing it.
+                raise ReplicationFencedError(response.error)
+            if response.epoch < self.epoch:
+                # A deposed primary revived and does NOT know: its epoch
+                # is older than one we durably observed. Refuse the
+                # stream (the mirror-image fence of the primary's).
+                if self.metrics is not None:
+                    self.metrics.counter(
+                        "ha_replication_refused_total",
+                        "Replication attaches refused on epoch-fencing "
+                        "grounds, by which end refused (primary = deposed "
+                        "self, follower = stale stream)",
+                        labels=("end",),
+                    ).inc(end="follower")
+                raise ReplicationFencedError(
+                    f"primary streams epoch {response.epoch} but this "
+                    f"replica has durably seen epoch {self.epoch}; "
+                    "refusing the stale timeline"
+                )
+            if response.epoch > self.epoch:
+                await asyncio.to_thread(self._persist_epoch, response.epoch)
+            if response.snapshot is not None:
+                await asyncio.to_thread(
+                    self._install_snapshot, response.snapshot
+                )
+            primary_head = max(response.primary_seq, self.last_seq)
+            self._set_lag_gauges(primary_head)
+            unacked = 0
+            while True:
+                try:
+                    line = await asyncio.wait_for(
+                        reader.readline(), IDLE_ACK_SECONDS
+                    )
+                except asyncio.TimeoutError:
+                    if unacked:
+                        writer.write(_encode_line(
+                            ReplicationAckEvent(self.last_seq)
+                        ))
+                        await writer.drain()
+                        unacked = 0
+                    continue
+                if not line:
+                    raise ConnectionError("stream closed")
+                if not line.endswith(b"\n"):
+                    # A torn mid-stream line: the primary (or the network)
+                    # died mid-record. NEVER applied — re-attach refetches
+                    # from the last contiguous record.
+                    self._count_torn()
+                    raise ConnectionError("torn record at stream tail")
+                try:
+                    message = decode_message(line)
+                except ValueError as e:
+                    self._count_torn()
+                    raise ConnectionError(f"malformed stream line: {e}")
+                if not isinstance(message, ReplicationRecordEvent):
+                    continue
+                if message.seq <= self.last_seq:
+                    continue  # re-attach overlap; already durable here
+                if message.seq != self.last_seq + 1:
+                    if self.metrics is not None:
+                        self.metrics.counter(
+                            "ha_replication_gaps_total",
+                            "Sequence gaps detected in the record stream "
+                            "(each forces a re-attach + segment re-fetch)",
+                        ).inc()
+                    raise ConnectionError(
+                        f"sequence gap: expected {self.last_seq + 1}, "
+                        f"got {message.seq}"
+                    )
+                record = message.record
+                try:
+                    record_seq = int(record["seq"])
+                except (KeyError, TypeError, ValueError):
+                    record_seq = -1
+                if record_seq != message.seq:
+                    self._count_torn()
+                    raise ConnectionError("record/envelope seq mismatch")
+                _check_version(record)  # LedgerCorruptError is fatal
+                if self.inject_delay_seconds > 0:
+                    await asyncio.sleep(self.inject_delay_seconds)
+                await asyncio.to_thread(self._append_record, record)
+                primary_head = max(primary_head, message.seq)
+                self._observe_applied(record, primary_head)
+                unacked += 1
+                if unacked >= _ack_every():
+                    writer.write(_encode_line(
+                        ReplicationAckEvent(self.last_seq)
+                    ))
+                    await writer.drain()
+                    unacked = 0
+        finally:
+            self._writer = None
+            writer.close()
+
+    # -- replica persistence -------------------------------------------------
+
+    def _segments(self) -> list[tuple[int, Path]]:
+        out = []
+        for entry in self.directory.iterdir():
+            match = _SEGMENT_RE.match(entry.name)
+            if match is not None:
+                out.append((int(match.group(1)), entry))
+        return sorted(out)
+
+    def _close_segment(self) -> None:
+        if self._segment_file is not None:
+            try:
+                self._segment_file.flush()
+                if _fsync_enabled():
+                    os.fsync(self._segment_file.fileno())
+            finally:
+                self._segment_file.close()
+                self._segment_file = None
+
+    def _current_segment(self):
+        if (
+            self._segment_file is not None
+            and self._segment_records >= _segment_max_records()
+        ):
+            self._close_segment()
+        if self._segment_file is None:
+            self._segment_index += 1
+            path = self.directory / f"segment-{self._segment_index:08d}.jsonl"
+            self._segment_file = open(path, "a", encoding="utf-8")
+            self._segment_records = 0
+            _fsync_dir(self.directory)
+        return self._segment_file
+
+    def _append_record(self, record: dict[str, Any]) -> None:
+        """Durably append one streamed record to the replica (write +
+        flush + fsync, the primary's append discipline) and fold it into
+        the live replay."""
+        line = json.dumps(record, separators=(",", ":")) + "\n"
+        f = self._current_segment()
+        f.write(line)
+        f.flush()
+        if _fsync_enabled():
+            os.fsync(f.fileno())
+        self._segment_records += 1
+        self.replay.apply(record)
+        seq = int(record["seq"])
+        self.replay.last_seq = seq
+        self.replay.records += 1
+        self.last_seq = seq
+        self.records_applied += 1
+
+    def _persist_epoch(self, epoch: int) -> None:
+        epoch_path = self.directory / "EPOCH"
+        tmp = epoch_path.with_suffix(".tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(f"{epoch}\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, epoch_path)
+        _fsync_dir(self.directory)
+        self.epoch = epoch
+
+    def _install_snapshot(self, snapshot: dict[str, Any]) -> None:
+        """Reset the replica to a primary-shipped snapshot (our attach
+        position predated the primary's compaction floor)."""
+        _check_version(snapshot)
+        self._close_segment()
+        for _, segment_path in self._segments():
+            try:
+                segment_path.unlink()
+            except OSError as e:  # pragma: no cover
+                logger.warning("Could not drop %s: %s", segment_path, e)
+        path = self.directory / "snapshot.json"
+        tmp = path.with_suffix(".tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(snapshot, f, separators=(",", ":"))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        _fsync_dir(self.directory)
+        self.replay = LedgerReplay.from_snapshot(snapshot, self.epoch)
+        self.last_seq = self.replay.last_seq
+        logger.info(
+            "Follower %s installed a snapshot at seq %d.",
+            self.follower_id, self.last_seq,
+        )
+
+    # -- metrics -------------------------------------------------------------
+
+    def _count_torn(self) -> None:
+        if self.metrics is not None:
+            self.metrics.counter(
+                "ha_replication_torn_tails_total",
+                "Torn or malformed stream lines discarded by the follower "
+                "(truncate-and-refetch; a partial record is never applied)",
+            ).inc()
+
+    def _set_lag_gauges(self, primary_head: int) -> None:
+        if self.metrics is not None:
+            self.metrics.gauge(
+                "ha_replication_behind_units",
+                "Records the follower still trails the primary's known head",
+            ).set(max(0, primary_head - self.last_seq))
+
+    def _observe_applied(
+        self, record: dict[str, Any], primary_head: int
+    ) -> None:
+        lag = max(0.0, time.time() - float(record.get("ts") or time.time()))
+        self.lag_samples.append(lag)
+        if self.metrics is not None:
+            self.metrics.counter(
+                "ha_replication_records_applied_total",
+                "Records durably applied to the local replica ledger",
+            ).inc()
+            self.metrics.histogram(
+                "ha_replication_lag_seconds",
+                "Seconds between the primary's durable append and the "
+                "follower's durable apply of the same record",
+            ).observe(lag)
+        self._set_lag_gauges(primary_head)
+
+
+# ---------------------------------------------------------------------------
+# The follower's control endpoint (what the shard router drives)
+
+
+class PromotableFollower:
+    """JSON-lines ``status``/``promote``/``ping`` frontend over a
+    :class:`LedgerFollower`.
+
+    ``promote`` is idempotent: the first call stops the tail, opens the
+    replica ledger, and runs the injected ``promote_callback(ledger)``
+    (which builds the serving master and returns the endpoints to
+    re-route to, e.g. ``{"ok": True, "host": ..., "port": ...,
+    "control_port": ...}``); later calls return the cached result, so a
+    router retrying through a timeout cannot double-promote.
+    """
+
+    def __init__(
+        self,
+        follower: LedgerFollower,
+        *,
+        promote_callback: Callable[[JobLedger], Awaitable[dict[str, Any]]] | None = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        metrics=None,
+        flightrec=None,
+    ) -> None:
+        self.follower = follower
+        self.promote_callback = promote_callback
+        self.host = host
+        self.port = port
+        self.metrics = metrics
+        self.flightrec = flightrec
+        self._server: asyncio.Server | None = None
+        self._promote_lock = asyncio.Lock()
+        self._promote_result: dict[str, Any] | None = None
+        self.ledger: JobLedger | None = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_connection, self.host, self.port, limit=MAX_LINE_BYTES
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info(
+            "Follower control endpoint on %s:%d.", self.host, self.port
+        )
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 5.0)
+            except asyncio.TimeoutError:
+                logger.warning("Follower control close timed out.")
+            self._server = None
+
+    def status(self) -> dict[str, Any]:
+        return {
+            "ok": True,
+            "follower_id": self.follower.follower_id,
+            "last_seq": self.follower.last_seq,
+            "epoch": self.follower.epoch,
+            "records_applied": self.follower.records_applied,
+            "fenced": self.follower.fenced,
+            "promoted": self.follower.promoted,
+        }
+
+    async def promote(self) -> dict[str, Any]:
+        async with self._promote_lock:
+            if self._promote_result is not None:
+                return self._promote_result
+            self.ledger = await self.follower.promote(
+                metrics=self.metrics, flightrec=self.flightrec
+            )
+            if self.promote_callback is not None:
+                result = dict(await self.promote_callback(self.ledger))
+            else:
+                result = {"ok": True}
+            result.setdefault("ok", True)
+            result["epoch"] = self.ledger.epoch
+            result["replayed_seq"] = self.ledger.replay.last_seq
+            self._promote_result = result
+            return result
+
+    async def handle_request(self, request: dict[str, Any]) -> dict[str, Any]:
+        op = request.get("op")
+        try:
+            if op == "ping":
+                return {"ok": True, "pong": True, "role": "ledger-follower"}
+            if op == "status":
+                return self.status()
+            if op == "promote":
+                return await self.promote()
+            return {"ok": False, "error": f"unknown op: {op!r}"}
+        except (ValueError, RuntimeError, KeyError, TypeError, OSError) as e:
+            return {"ok": False, "error": str(e)}
+
+    async def _on_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        peer = writer.get_extra_info("peername")
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                try:
+                    request = json.loads(line)
+                    if not isinstance(request, dict):
+                        raise ValueError("request must be a JSON object")
+                except (json.JSONDecodeError, ValueError) as e:
+                    response: dict[str, Any] = {
+                        "ok": False, "error": f"bad request: {e}"
+                    }
+                else:
+                    response = await self.handle_request(request)
+                writer.write(json.dumps(response).encode("utf-8") + b"\n")
+                await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        except Exception as e:  # noqa: BLE001 - one bad client is not fatal
+            logger.warning("Follower control connection %s failed: %s", peer, e)
+        finally:
+            writer.close()
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def build_parser():
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="trc-follower",
+        description="Ledger replication follower (replica + control endpoint)",
+    )
+    parser.add_argument(
+        "--directory", required=True,
+        help="Local replica ledger directory (created if missing).",
+    )
+    parser.add_argument(
+        "--primary", required=True,
+        help="HOST:PORT of the primary's replication endpoint "
+        "(master --replicationPort).",
+    )
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument(
+        "--controlPort", dest="control_port", type=int, default=9905,
+        help="JSON-lines status/promote endpoint the shard router probes.",
+    )
+    parser.add_argument(
+        "--servePort", dest="serve_port", type=int, default=None,
+        help="Worker WebSocket port a promotion binds the scheduler "
+        "service to (omit to make promote a ledger-adopt only).",
+    )
+    parser.add_argument(
+        "--serveControlPort", dest="serve_control_port", type=int, default=0,
+        help="Scheduler control-plane port of the promoted service.",
+    )
+    return parser
+
+
+async def _run_follower(args) -> int:
+    from tpu_render_cluster.obs import get_registry
+
+    primary_host, _, primary_port = args.primary.rpartition(":")
+    registry = get_registry()
+    follower = LedgerFollower(
+        args.directory, primary_host or "127.0.0.1", int(primary_port),
+        metrics=registry,
+    )
+    serve_done: asyncio.Event = asyncio.Event()
+
+    async def promote_callback(ledger: JobLedger) -> dict[str, Any]:
+        if args.serve_port is None:
+            return {"ok": True, "serving": False}
+        from tpu_render_cluster.jobs.models import BlenderJob
+        from tpu_render_cluster.sched.control import ControlServer
+        from tpu_render_cluster.sched.manager import JobManager
+        from tpu_render_cluster.sched.models import JobSpec
+
+        manager = JobManager(args.host, args.serve_port, ledger=ledger)
+        for entry in ledger.replay.unfinished_jobs():
+            if entry.job is None:
+                continue
+            manager.submit(JobSpec(
+                job=BlenderJob.from_dict(entry.job),
+                weight=entry.weight,
+                priority=entry.priority,
+            ))
+        control = ControlServer(manager, args.host, args.serve_control_port)
+        await control.start()
+
+        async def _serve() -> None:
+            try:
+                await manager.serve()
+            finally:
+                await control.stop()
+                serve_done.set()
+
+        asyncio.create_task(_serve(), name="promoted-master")
+        return {
+            "ok": True,
+            "serving": True,
+            "host": args.host,
+            "port": args.serve_port,
+            "control_port": control.port,
+        }
+
+    endpoint = PromotableFollower(
+        follower,
+        promote_callback=promote_callback,
+        host=args.host,
+        port=args.control_port,
+        metrics=registry,
+    )
+    follower.start()
+    await endpoint.start()
+    print(
+        f"Follower tailing {args.primary} into {args.directory}; "
+        f"control on {args.host}:{endpoint.port}."
+    )
+    try:
+        while True:
+            if follower.promoted:
+                await serve_done.wait()
+                return 0
+            if follower.fenced:
+                print("Follower fenced (stale-epoch stream); exiting.")
+                return 1
+            await asyncio.sleep(0.5)
+    finally:
+        await endpoint.stop()
+        await follower.stop()
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return asyncio.run(_run_follower(args))
+    except KeyboardInterrupt:
+        return 130
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

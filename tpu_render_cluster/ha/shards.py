@@ -18,7 +18,21 @@ unchanged against it) and routes:
 - ``status``/``cancel`` with a ``s<shard>/job-NNNN`` id — routed to the
   owning shard (the prefix is stripped before forwarding);
 - ``status`` (global), ``alerts``, ``drain``, ``ping`` — fanned out to
-  every shard and aggregated under ``shards``.
+  every shard and aggregated under ``shards``; a dead shard degrades to
+  ABSENCE from the merge (plus ``ha_router_scrape_failures_total``),
+  exactly like the federated ``/metrics`` view;
+- ``route_worker`` — where should a worker (re)connect? Answers with the
+  least-backlogged live shard's WORKER endpoint (``--shardWorkers``);
+  workers whose shard died re-home through this.
+
+With ``--followers`` the router also runs the ``PromotionMonitor``:
+shards are liveness-probed, and one that stays unreachable past
+``TRC_HA_REPL_PROMOTE_TIMEOUT`` has its most-caught-up ledger follower
+(ha/replicate.py) promoted to primary — epoch-fenced against the old
+primary's revival — with the shard slot re-pointed at the promoted
+process. With ``--rebalance`` (or ``TRC_REBALANCE=1``) the router runs
+the hot->cold worker rebalancer (sched/rebalance.py) over the same
+control plane.
 
 Federated telemetry (``TelemetryFederation``): with ``--telemetryPort``
 and ``--shardTelemetry`` the router additionally serves ``/metrics`` and
@@ -52,12 +66,17 @@ import asyncio
 import json
 import logging
 import sys
+import time
 import urllib.parse
 import urllib.request
 import zlib
-from typing import Any
+from typing import TYPE_CHECKING, Any
 
 from tpu_render_cluster.obs import LoopLagMonitor, MetricsRegistry, get_registry
+from tpu_render_cluster.utils.env import env_float
+
+if TYPE_CHECKING:
+    from tpu_render_cluster.sched.rebalance import Move, ShardLoad
 from tpu_render_cluster.obs.prometheus import (
     CONTENT_TYPE,
     parse_prometheus,
@@ -98,12 +117,22 @@ class ShardRouter:
         self,
         shards: list[tuple[str, int]],
         *,
+        worker_endpoints: list[tuple[str, int]] | None = None,
         timeout: float = 30.0,
         metrics: MetricsRegistry | None = None,
     ) -> None:
         if not shards:
             raise ValueError("ShardRouter needs at least one shard")
+        if worker_endpoints is not None and len(worker_endpoints) != len(shards):
+            raise ValueError(
+                f"{len(worker_endpoints)} worker endpoint(s) for "
+                f"{len(shards)} shard(s)"
+            )
         self.shards = shards
+        # Per-shard WORKER (WebSocket) endpoints, in --shards order. Only
+        # needed for the ops that point workers somewhere: route_worker
+        # (re-homing after a shard death) and rebalance moves.
+        self.worker_endpoints = worker_endpoints
         self.timeout = timeout
         self.metrics = metrics if metrics is not None else get_registry()
         self._requests = self.metrics.counter(
@@ -117,9 +146,31 @@ class ShardRouter:
             "Submissions hashed onto each shard",
             labels=("shard",),
         )
+        # Shared with TelemetryFederation (same name, same labels): a
+        # control fan-out degrading a dead shard to absence is the same
+        # observable event as a telemetry scrape doing so.
+        self._fanout_failures = self.metrics.counter(
+            "ha_router_scrape_failures_total",
+            "Shard telemetry scrapes that failed (shard absent from the "
+            "federated view)",
+            labels=("shard",),
+        )
 
     def shard_for(self, job_name: str) -> int:
         return shard_for_job_name(job_name, len(self.shards))
+
+    def update_shard(
+        self,
+        shard: int,
+        control: tuple[str, int],
+        worker: tuple[str, int] | None = None,
+    ) -> None:
+        """Re-point one shard's endpoints (a promotion installed a new
+        primary). Routing math is positional, so the keyspace mapping is
+        untouched — only the addresses behind slot ``shard`` change."""
+        self.shards[shard] = control
+        if worker is not None and self.worker_endpoints is not None:
+            self.worker_endpoints[shard] = worker
 
     async def _forward(
         self, shard: int, request: dict[str, Any]
@@ -134,6 +185,7 @@ class ShardRouter:
                 "ok": False,
                 "error": f"shard {shard} ({host}:{port}) unreachable: {e}",
                 "shard": shard,
+                "unreachable": True,
             }
 
     async def _fan_out(self, request: dict[str, Any]) -> list[dict[str, Any]]:
@@ -176,14 +228,266 @@ class ShardRouter:
             self._requests.inc(op=str(op), shard=str(shard))
             return await self._forward(shard, {**request, "job_id": inner_id})
         if op in ("status", "alerts", "drain", "ping"):
-            # Global fan-out, aggregated per shard.
+            # Global fan-out, aggregated per shard. A dead shard degrades
+            # exactly like the federated /metrics view: it is ABSENT from
+            # ``shards`` and counted in ha_router_scrape_failures_total —
+            # the caller sees the survivors' merged answer, not one
+            # shard's connection error poisoning the whole response.
             self._requests.inc(op=str(op), shard="all")
             responses = await self._fan_out(request)
-            return {
-                "ok": all(r.get("ok") for r in responses),
-                "shards": {str(i): r for i, r in enumerate(responses)},
+            shards: dict[str, dict[str, Any]] = {}
+            unreachable: list[int] = []
+            for i, response in enumerate(responses):
+                if response.get("unreachable"):
+                    unreachable.append(i)
+                    self._fanout_failures.inc(shard=str(i))
+                    logger.warning(
+                        "Fan-out %s: %s", op, response.get("error")
+                    )
+                    continue
+                shards[str(i)] = response
+            out: dict[str, Any] = {
+                "ok": bool(shards)
+                and all(r.get("ok") for r in shards.values()),
+                "shards": shards,
             }
+            if unreachable:
+                out["unreachable"] = unreachable
+            return out
+        if op == "route_worker":
+            # Where should a worker (re)connect? The least-backlogged
+            # LIVE shard's worker endpoint — the re-home path workers
+            # take when their shard dies (worker --router).
+            self._requests.inc(op="route_worker", shard="all")
+            if self.worker_endpoints is None:
+                return {
+                    "ok": False,
+                    "error": "router has no --shardWorkers endpoints",
+                }
+            loads = await self.shard_loads()
+            live = [load for load in loads if load.alive]
+            if not live:
+                return {"ok": False, "error": "no live shards"}
+            best = min(live, key=lambda load: load.queue_depth)
+            host, port = self.worker_endpoints[best.shard]
+            return {"ok": True, "shard": best.shard, "host": host, "port": port}
         return {"ok": False, "error": f"unknown op: {op!r}"}
+
+    async def shard_loads(self) -> "list[ShardLoad]":
+        """Every shard's rebalance load summary (dead shards included as
+        ``alive=False`` placeholders) — the rebalancer's scrape and
+        route_worker's ranking input."""
+        from tpu_render_cluster.sched.rebalance import ShardLoad
+
+        responses = await self._fan_out({"op": "status"})
+        loads: list[ShardLoad] = []
+        for i, response in enumerate(responses):
+            view = (response.get("sched") or {}).get("rebalance")
+            if not response.get("ok") or not isinstance(view, dict):
+                if response.get("unreachable"):
+                    self._fanout_failures.inc(shard=str(i))
+                loads.append(ShardLoad.dead(i))
+                continue
+            loads.append(ShardLoad.from_view(i, view))
+        return loads
+
+
+class PromotionMonitor:
+    """Automatic failover: probe shards, promote a follower when one dies.
+
+    The router is the only component with a cluster-wide view, so it is
+    where "the primary is gone" becomes a decision rather than a stream
+    of connection errors. Each shard is probed every
+    ``TRC_HA_REPL_PROBE_SECONDS`` (``probe_fn`` injectable — the default
+    is a control-plane ping; chaos tests substitute cheaper probes).
+    A shard continuously unreachable for ``TRC_HA_REPL_PROMOTE_TIMEOUT``
+    seconds with registered followers is declared dead: the monitor
+    queries every follower's replication position, picks the MOST
+    CAUGHT-UP one (max applied seq — minimizes lost suffix), and sends it
+    the ``promote`` op (ha/replicate.py ``PromotableFollower``). The
+    promotion epoch-bumps via ``JobLedger.open()``, so a revived old
+    primary is fenced on both the worker protocol and the replication
+    stream. On success the router's shard table is re-pointed at the new
+    primary's control/worker endpoints — the crc32 keyspace mapping is
+    positional and survives unchanged — and workers re-home through
+    ``route_worker``.
+
+    Detection->serving time is stamped on ``ha_failover_mttr_seconds``
+    (the same gauge the single-host failover path stamps) and counted in
+    ``ha_router_promotions_total``; each promotion also fires the flight
+    recorder's ``promotion`` trigger when one is wired.
+    """
+
+    def __init__(
+        self,
+        router: ShardRouter,
+        followers: dict[int, list[tuple[str, int]]],
+        *,
+        probe_fn: Any = None,
+        probe_interval: float | None = None,
+        promote_timeout: float | None = None,
+        flightrec: Any = None,
+    ) -> None:
+        self.router = router
+        # shard index -> PromotableFollower control endpoints.
+        self.followers = followers
+        self.probe_fn = probe_fn
+        self.probe_interval = (
+            probe_interval
+            if probe_interval is not None
+            else max(0.05, env_float("TRC_HA_REPL_PROBE_SECONDS", 0.5))
+        )
+        self.promote_timeout = (
+            promote_timeout
+            if promote_timeout is not None
+            else max(0.1, env_float("TRC_HA_REPL_PROMOTE_TIMEOUT", 2.0))
+        )
+        self.flightrec = flightrec
+        self.promotions: list[dict[str, Any]] = []
+        self._down_since: dict[int, float] = {}
+        self._promoting: set[int] = set()
+        self._running = False
+        self._task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        self._running = True
+        self._task = asyncio.create_task(self.run(), name="promotion-monitor")
+
+    async def stop(self) -> None:
+        self._running = False
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._task = None
+
+    async def run(self) -> None:
+        self._running = True
+        while self._running:
+            await asyncio.sleep(self.probe_interval)
+            try:
+                await self.tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 - keep probing through chaos
+                logger.warning("Promotion-monitor tick failed: %s", e)
+
+    async def tick(self) -> None:
+        """One probe round (tests drive this directly)."""
+        now = time.monotonic()
+        for shard in range(len(self.router.shards)):
+            if shard in self._promoting:
+                continue
+            if await self._probe(shard):
+                self._down_since.pop(shard, None)
+                continue
+            first = self._down_since.setdefault(shard, now)
+            if (
+                now - first >= self.promote_timeout
+                and self.followers.get(shard)
+            ):
+                self._promoting.add(shard)
+                try:
+                    await self._promote(shard, detected_at=first)
+                finally:
+                    self._promoting.discard(shard)
+
+    async def _probe(self, shard: int) -> bool:
+        if self.probe_fn is not None:
+            return bool(await self.probe_fn(shard, *self.router.shards[shard]))
+        host, port = self.router.shards[shard]
+        try:
+            response = await control_request(
+                host, port, {"op": "ping"}, timeout=self.probe_interval * 2
+            )
+            return bool(response.get("ok"))
+        except (OSError, ValueError, ConnectionError, asyncio.TimeoutError):
+            return False
+
+    async def _follower_status(
+        self, host: str, port: int
+    ) -> dict[str, Any] | None:
+        try:
+            response = await control_request(
+                host, port, {"op": "status"}, timeout=self.probe_interval * 4
+            )
+        except (OSError, ValueError, ConnectionError, asyncio.TimeoutError):
+            return None
+        return response if response.get("ok") else None
+
+    async def _promote(self, shard: int, *, detected_at: float) -> None:
+        # Most-caught-up follower wins: every record it holds is one the
+        # dead primary fsynced, so max applied seq = min lost suffix.
+        candidates = []
+        for host, port in self.followers.get(shard, []):
+            status = await self._follower_status(host, port)
+            if status is None or status.get("fenced"):
+                continue
+            candidates.append((int(status.get("last_seq", -1)), host, port))
+        if not candidates:
+            logger.error(
+                "Shard %d is dead but no follower is reachable; cannot "
+                "promote.", shard,
+            )
+            return
+        last_seq, host, port = max(candidates)
+        logger.warning(
+            "Shard %d unreachable for %.2fs; promoting follower %s:%d "
+            "(applied seq %d).",
+            shard, time.monotonic() - detected_at, host, port, last_seq,
+        )
+        try:
+            response = await control_request(
+                host, port, {"op": "promote"}, timeout=self.router.timeout
+            )
+        except (OSError, ValueError, ConnectionError, asyncio.TimeoutError) as e:
+            logger.error("Promote of %s:%d failed: %s", host, port, e)
+            return
+        if not response.get("ok"):
+            logger.error(
+                "Promote of %s:%d refused: %s", host, port,
+                response.get("error"),
+            )
+            return
+        mttr = time.monotonic() - detected_at
+        record: dict[str, Any] = {
+            "shard": shard,
+            "follower": f"{host}:{port}",
+            "epoch": response.get("epoch"),
+            "replayed_seq": response.get("replayed_seq"),
+            "mttr_seconds": mttr,
+        }
+        if response.get("serving"):
+            new_control = (str(response["host"]), int(response["control_port"]))
+            new_worker = (str(response["host"]), int(response["port"]))
+            self.router.update_shard(shard, new_control, new_worker)
+            record["control"] = f"{new_control[0]}:{new_control[1]}"
+            record["worker"] = f"{new_worker[0]}:{new_worker[1]}"
+        self.promotions.append(record)
+        self._down_since.pop(shard, None)
+        # Satellite: router-driven promotions stamp the SAME MTTR gauge
+        # the single-host standby path stamps — one series answers "how
+        # fast does this cluster recover" regardless of the failover path.
+        self.router.metrics.gauge(
+            "ha_failover_mttr_seconds",
+            "Seconds from primary-death detection to a promoted "
+            "replacement serving",
+        ).set(mttr)
+        self.router.metrics.counter(
+            "ha_router_promotions_total",
+            "Followers promoted to shard primary by the router",
+            labels=("shard",),
+        ).inc(shard=str(shard))
+        if self.flightrec is not None:
+            from tpu_render_cluster.obs.flightrec import TRIGGER_PROMOTION
+
+            self.flightrec.trigger(TRIGGER_PROMOTION, dict(record))
+        logger.warning(
+            "Shard %d promoted: %s (epoch %s, %.3fs after detection).",
+            shard, record["follower"], record.get("epoch"), mttr,
+        )
 
 
 _JSON_CONTENT_TYPE = "application/json; charset=utf-8"
@@ -448,14 +752,79 @@ def build_parser() -> argparse.ArgumentParser:
         "shard in --shards order (each master's --telemetryPort address). "
         "Required when --telemetryPort is set.",
     )
+    parser.add_argument(
+        "--shardWorkers",
+        dest="shard_workers",
+        default=None,
+        help="Comma-separated host:port WORKER (WebSocket) endpoints, one "
+        "per shard in --shards order. Enables the route_worker op (worker "
+        "re-homing after a shard death) and --rebalance moves.",
+    )
+    parser.add_argument(
+        "--followers",
+        default=None,
+        help="Ledger-follower control endpoints for automatic promotion: "
+        "semicolon-separated per-shard groups in --shards order, each a "
+        "comma-separated host:port list (ha.replicate --controlPort "
+        "addresses); an empty group means that shard has no follower. "
+        "Example: '127.0.0.1:9905;;127.0.0.1:9925' gives shards 0 and 2 "
+        "one follower each.",
+    )
+    parser.add_argument(
+        "--rebalance",
+        action="store_true",
+        help="Run the hot->cold worker rebalancer (sched/rebalance.py); "
+        "requires --shardWorkers. Also enabled by TRC_REBALANCE=1.",
+    )
     return parser
+
+
+async def execute_move(router: ShardRouter, move: "Move") -> int:
+    """Execute one rebalance move: tell the hot shard's control plane to
+    shed ``move.count`` workers toward the cold shard's worker endpoint.
+    Returns how many workers the hot shard reported migrating."""
+    if router.worker_endpoints is None:
+        return 0
+    host, port = router.worker_endpoints[move.target]
+    response = await router._forward(
+        move.source,
+        {
+            "op": "migrate_workers",
+            "count": move.count,
+            "host": host,
+            "port": port,
+            "reason": f"rebalance->s{move.target}",
+        },
+    )
+    if not response.get("ok"):
+        logger.warning(
+            "Rebalance move on shard %d failed: %s",
+            move.source, response.get("error"),
+        )
+        return 0
+    return int(response.get("migrating", 0))
+
+
+def parse_follower_groups(text: str) -> dict[int, list[tuple[str, int]]]:
+    """``"h:9905;;h:9925"`` -> ``{0: [("h", 9905)], 2: [("h", 9925)]}``."""
+    groups: dict[int, list[tuple[str, int]]] = {}
+    for shard, chunk in enumerate(text.split(";")):
+        chunk = chunk.strip()
+        if chunk:
+            groups[shard] = parse_shard_list(chunk)
+    return groups
 
 
 async def serve(args: argparse.Namespace) -> int:
     from tpu_render_cluster.obs.http import TelemetryServer, resolve_telemetry_port
+    from tpu_render_cluster.sched.rebalance import RebalanceLoop, rebalance_enabled
 
     router = ShardRouter(
-        parse_shard_list(args.shards), timeout=args.timeout
+        parse_shard_list(args.shards),
+        worker_endpoints=(
+            parse_shard_list(args.shard_workers) if args.shard_workers else None
+        ),
+        timeout=args.timeout,
     )
     server = ShardRouterServer(router, args.host, args.control_port)
     await server.start()
@@ -500,6 +869,25 @@ async def serve(args: argparse.Namespace) -> int:
             f"Federated telemetry on {args.host}:{telemetry.port} "
             f"(/metrics + /history across {len(endpoints)} shard(s))"
         )
+    monitor = None
+    if args.followers:
+        monitor = PromotionMonitor(router, parse_follower_groups(args.followers))
+        monitor.start()
+        print(
+            f"Promotion monitor armed over {len(monitor.followers)} "
+            f"shard(s) with followers"
+        )
+    rebalancer = None
+    if args.rebalance or rebalance_enabled():
+        if router.worker_endpoints is None:
+            raise SystemExit("--rebalance needs --shardWorkers")
+        rebalancer = RebalanceLoop(
+            router.shard_loads,
+            lambda move: execute_move(router, move),
+            metrics=router.metrics,
+        )
+        rebalancer.start()
+        print("Rebalancer running (hot->cold worker migration)")
     print(
         f"Shard router on {args.host}:{server.port} over "
         f"{len(router.shards)} shard(s): "
@@ -508,6 +896,10 @@ async def serve(args: argparse.Namespace) -> int:
     try:
         await asyncio.Event().wait()  # serve until interrupted
     finally:
+        if rebalancer is not None:
+            await rebalancer.stop()
+        if monitor is not None:
+            await monitor.stop()
         await loopmon.stop()
         if telemetry is not None:
             await telemetry.stop()

@@ -50,6 +50,18 @@ def build_parser() -> argparse.ArgumentParser:
         "variable; omit both to run ledger-less (reference behavior).",
     )
     parser.add_argument(
+        "--replicationPort",
+        dest="replication_port",
+        type=int,
+        default=None,
+        help="Stream the ledger's committed records to follower processes "
+        "(python -m tpu_render_cluster.ha.replicate) on this TCP port, so "
+        "a standby on ANOTHER host holds a promotable replica — no shared "
+        "filesystem. 0 picks an ephemeral port. Requires --ledger (or "
+        "TRC_HA_LEDGER); defaults to the TRC_HA_REPL_PORT environment "
+        "variable; omit both to disable.",
+    )
+    parser.add_argument(
         "--telemetryPort",
         dest="telemetry_port",
         type=int,
@@ -148,6 +160,39 @@ def open_ledger(args: argparse.Namespace):
     return ledger
 
 
+async def start_replication(ledger, args: argparse.Namespace):
+    """Start the ledger streaming-replication endpoint when configured
+    (``--replicationPort`` flag, else ``TRC_HA_REPL_PORT``), or None."""
+    from tpu_render_cluster.utils.env import env_int
+
+    port = args.replication_port
+    if port is None:
+        port = env_int("TRC_HA_REPL_PORT", -1)
+        if port < 0:
+            return None
+    if ledger is None:
+        print(
+            "warning: --replicationPort ignored: no ledger to replicate "
+            "(pass --ledger or set TRC_HA_LEDGER).",
+            file=sys.stderr,
+        )
+        return None
+    from tpu_render_cluster.ha.replicate import ReplicationServer
+    from tpu_render_cluster.obs import get_registry
+
+    replication = ReplicationServer(
+        ledger, host=args.host, port=port, metrics=get_registry()
+    )
+    await replication.start()
+    print(
+        f"Ledger replication streaming on {args.host}:{replication.port} "
+        f"(epoch {ledger.epoch}); attach followers with "
+        f"python -m tpu_render_cluster.ha.replicate --primary "
+        f"{args.host}:{replication.port} --directory <replica-dir>."
+    )
+    return replication
+
+
 async def serve_command(args: argparse.Namespace) -> int:
     from tpu_render_cluster.sched.control import ControlServer
     from tpu_render_cluster.sched.manager import JobManager
@@ -205,6 +250,7 @@ async def serve_command(args: argparse.Namespace) -> int:
         restored = load_model_snapshot(sched_model_path)
         if restored is not None:
             manager.cost_service.model = restored
+    replication = await start_replication(ledger, args)
     control = ControlServer(manager, args.host, args.control_port)
     await control.start()
     print(
@@ -224,6 +270,8 @@ async def serve_command(args: argparse.Namespace) -> int:
         await manager.serve()
     finally:
         await control.stop()
+        if replication is not None:
+            await replication.stop()
 
         # Artifact export runs on FAILURE paths too (same pattern as the
         # assembly drain): a service that died mid-run is exactly the one
@@ -353,9 +401,12 @@ async def run_job_command(args: argparse.Namespace) -> int:
     assignment_ops.reset_greedy_fallback_count()
     results_directory = Path(args.results_directory)
     prefix = run_file_prefix(start_time, job)
+    replication = await start_replication(ledger, args)
     try:
         master_trace, worker_traces = await manager.initialize_server_and_run_job()
     finally:
+        if replication is not None:
+            await replication.stop()
         # Obs artifacts are written even when the job RAISES (worker-pool
         # collapse, unit error budget, operator interrupt): the partial
         # span timeline, merged cluster trace, and final metrics/ledger

@@ -650,6 +650,18 @@ class WorkerHandle:
             pm.MasterJobStartedEvent(trace_id=trace_id, job_id=job_id)
         )
 
+    async def send_migrate(
+        self, host: str, port: int, *, reason: str | None = None
+    ) -> None:
+        """Ask this worker to re-home to another shard master: it drains
+        gracefully (goodbye reason ``"migrate"``, queued frames returned
+        and requeued here) and reconnects there with a fresh announce.
+        Fire-and-forget like the drain protocol — a reference worker
+        ignores the unknown tag and stays."""
+        await self.sender.send_message(
+            pm.MasterWorkerMigrateEvent(host=host, port=port, reason=reason)
+        )
+
     async def finish_job_and_get_trace(self):
         """Request the worker's trace; 600 s budget for huge traces."""
         request = pm.MasterJobFinishedRequest.new()
@@ -1132,10 +1144,19 @@ class WorkerHandle:
                 requeued += 1
         self._update_queue_depth_gauge()
         if self.metrics is not None:
-            self.metrics.counter(
-                "master_worker_drains_total",
-                "Workers that departed gracefully via the goodbye message",
-            ).inc()
+            if event.reason == "migrate":
+                # A rebalance re-home is not an operator drain: counted
+                # apart so the chaos audits' drain ledger stays exact.
+                self.metrics.counter(
+                    "master_worker_migrations_total",
+                    "Workers that departed via a master-requested migrate "
+                    "goodbye (shard rebalancing)",
+                ).inc()
+            else:
+                self.metrics.counter(
+                    "master_worker_drains_total",
+                    "Workers that departed gracefully via the goodbye message",
+                ).inc()
         self.logger.info(
             "Worker drained gracefully (%s); %d frame(s) requeued.",
             event.reason,

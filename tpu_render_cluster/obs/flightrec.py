@@ -69,6 +69,7 @@ TRIGGER_WORKER_EVICTION = "worker_eviction"
 TRIGGER_JOB_FAILURE = "job_failure"
 TRIGGER_EPOCH_FENCE = "epoch_fence"
 TRIGGER_MASTER_FAILOVER = "master_failover"
+TRIGGER_PROMOTION = "promotion"
 TRIGGER_LOOP_LAG = "loop_lag"
 TRIGGER_TICK_BUDGET = "tick_budget"
 

@@ -787,6 +787,201 @@ class WorkerJobFinishedResponse(Message):
 
 
 # ---------------------------------------------------------------------------
+# Ledger streaming replication (PROTOCOL.md §Ledger streaming replication)
+#
+# Follower <-> primary traffic over the JSON-lines control-plane idiom —
+# one ``encode_message`` envelope per line on a plain TCP socket, NOT the
+# worker WebSocket. These tags never ride the reference worker protocol,
+# but they use the same envelope + schema registry so the wire-schema
+# lint covers the replication contract too.
+
+
+@dataclass(frozen=True)
+class ReplicationAttachRequest(Message):
+    """F→P: attach (or re-attach) to the primary's record stream.
+
+    ``last_seq`` is the highest *contiguous* sequence number durably in
+    the follower's local replica (0 = empty). The primary answers with
+    everything after it — via a snapshot when ``last_seq`` predates the
+    primary's compaction floor. The optional ``epoch`` carries the newest
+    master epoch the follower has durably observed: a primary whose own
+    epoch is LOWER knows it has been deposed and must refuse the attach
+    rather than stream a stale timeline.
+    """
+
+    type_name: ClassVar[str] = "request_replication-attach"
+    message_request_id: int
+    last_seq: int
+    epoch: int | None = None
+    follower_id: str | None = None
+
+    @classmethod
+    def new(
+        cls, last_seq: int, *, epoch: int | None = None, follower_id: str | None = None
+    ) -> "ReplicationAttachRequest":
+        return cls(
+            generate_message_request_id(),
+            last_seq=last_seq,
+            epoch=epoch,
+            follower_id=follower_id,
+        )
+
+    def to_payload(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "message_request_id": self.message_request_id,
+            "last_seq": self.last_seq,
+        }
+        if self.epoch is not None:
+            out["epoch"] = self.epoch
+        if self.follower_id is not None:
+            out["follower_id"] = self.follower_id
+        return out
+
+    @classmethod
+    def from_payload(cls, payload: dict[str, Any]) -> "ReplicationAttachRequest":
+        last_seq = int(payload["last_seq"])
+        if last_seq < 0:
+            raise ValueError(f"last_seq must be >= 0, got {last_seq}")
+        follower_id = payload.get("follower_id")
+        return cls(
+            message_request_id=int(payload["message_request_id"]),
+            last_seq=last_seq,
+            epoch=_epoch_from_payload(payload),
+            follower_id=None if follower_id is None else str(follower_id),
+        )
+
+
+@dataclass(frozen=True)
+class ReplicationAttachResponse(Message):
+    """P→F: accept (stream follows) or refuse an attach.
+
+    On accept: ``epoch`` is the primary's current epoch, ``primary_seq``
+    its highest committed sequence number (the follower's initial lag
+    baseline), and ``snapshot`` — present only when the follower's
+    ``last_seq`` predates the compaction floor — a full ledger snapshot
+    document to seed the replica before the record stream resumes. On
+    refusal ``error`` says why and the connection closes; the follower
+    counts the refusal and does NOT retry a stale-epoch one.
+    """
+
+    type_name: ClassVar[str] = "response_replication-attach"
+    message_request_context_id: int
+    epoch: int
+    primary_seq: int
+    snapshot: dict[str, Any] | None = None
+    error: str | None = None
+
+    def to_payload(self) -> dict[str, Any]:
+        out: dict[str, Any] = {
+            "message_request_context_id": self.message_request_context_id,
+            "epoch": self.epoch,
+            "primary_seq": self.primary_seq,
+        }
+        if self.snapshot is not None:
+            out["snapshot"] = self.snapshot
+        if self.error is not None:
+            out["error"] = self.error
+        return out
+
+    @classmethod
+    def from_payload(cls, payload: dict[str, Any]) -> "ReplicationAttachResponse":
+        snapshot = payload.get("snapshot")
+        if snapshot is not None and not isinstance(snapshot, dict):
+            raise ValueError("snapshot payload must be an object")
+        error = payload.get("error")
+        return cls(
+            message_request_context_id=int(payload["message_request_context_id"]),
+            epoch=int(payload["epoch"]),
+            primary_seq=int(payload["primary_seq"]),
+            snapshot=snapshot,
+            error=None if error is None else str(error),
+        )
+
+
+@dataclass(frozen=True)
+class ReplicationRecordEvent(Message):
+    """P→F: one committed ledger record.
+
+    ``record`` is the exact dict the primary appended (``{"v", "seq",
+    "type", "job", "ts", ...}``); ``seq`` duplicates ``record["seq"]`` at
+    the envelope level so the follower's gap detector never has to trust
+    a partially-validated body. Streamed in strict sequence order; a gap
+    means the connection lost records and the follower must re-attach
+    from its last contiguous sequence.
+    """
+
+    type_name: ClassVar[str] = "event_replication-record"
+    seq: int
+    record: dict[str, Any]
+
+    def to_payload(self) -> dict[str, Any]:
+        return {"seq": self.seq, "record": self.record}
+
+    @classmethod
+    def from_payload(cls, payload: dict[str, Any]) -> "ReplicationRecordEvent":
+        record = payload["record"]
+        if not isinstance(record, dict):
+            raise ValueError("record must be an object")
+        return cls(seq=int(payload["seq"]), record=record)
+
+
+@dataclass(frozen=True)
+class ReplicationAckEvent(Message):
+    """F→P: cumulative acknowledgement — every record up to and including
+    ``seq`` is durably on the follower's disk. Sent every
+    ``TRC_HA_REPL_ACK_EVERY`` records (and on stream idle), not per
+    record; the primary's per-follower lag gauge is derived from it."""
+
+    type_name: ClassVar[str] = "event_replication-ack"
+    seq: int
+
+    def to_payload(self) -> dict[str, Any]:
+        return {"seq": self.seq}
+
+    @classmethod
+    def from_payload(cls, payload: dict[str, Any]) -> "ReplicationAckEvent":
+        return cls(seq=int(payload["seq"]))
+
+
+@dataclass(frozen=True)
+class MasterWorkerMigrateEvent(Message):
+    """M→W: re-home to another shard master (beyond-reference, rebalance).
+
+    The shard router's rebalancer asks a hot shard's master to shed a
+    worker; the master picks one and sends this event. The worker treats
+    it exactly like a drain — finish the in-flight unit, return queued
+    frames via ``event_worker-goodbye`` (reason ``"migrate"``) — then
+    reconnects to ``host``:``port`` with a FRESH first-connection
+    announce instead of exiting. A reference worker ignores the unknown
+    tag and simply stays put, so rebalancing degrades to a no-op rather
+    than an error on mixed fleets.
+    """
+
+    type_name: ClassVar[str] = "event_worker-migrate"
+    host: str
+    port: int
+    reason: str | None = None
+
+    def to_payload(self) -> dict[str, Any]:
+        out: dict[str, Any] = {"host": self.host, "port": self.port}
+        if self.reason is not None:
+            out["reason"] = self.reason
+        return out
+
+    @classmethod
+    def from_payload(cls, payload: dict[str, Any]) -> "MasterWorkerMigrateEvent":
+        port = int(payload["port"])
+        if not (0 < port < 65536):
+            raise ValueError(f"port must be 1..65535, got {port}")
+        reason = payload.get("reason")
+        return cls(
+            host=str(payload["host"]),
+            port=port,
+            reason=None if reason is None else str(reason),
+        )
+
+
+# ---------------------------------------------------------------------------
 # Envelope
 
 ALL_MESSAGE_TYPES: tuple[type[Message], ...] = (
@@ -805,6 +1000,11 @@ ALL_MESSAGE_TYPES: tuple[type[Message], ...] = (
     MasterJobStartedEvent,
     MasterJobFinishedRequest,
     WorkerJobFinishedResponse,
+    ReplicationAttachRequest,
+    ReplicationAttachResponse,
+    ReplicationRecordEvent,
+    ReplicationAckEvent,
+    MasterWorkerMigrateEvent,
 )
 
 _TYPE_REGISTRY: dict[str, type[Message]] = {m.type_name: m for m in ALL_MESSAGE_TYPES}

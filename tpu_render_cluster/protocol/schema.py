@@ -161,6 +161,39 @@ WIRE_SCHEMAS: dict[str, WireSchema] = {
             "M->W",
             required=("message_request_id",),
         ),
+        # -- ledger streaming replication (PROTOCOL.md §Ledger streaming
+        # replication): follower <-> primary over the JSON-lines TCP idiom,
+        # one envelope per line. Not part of the reference worker protocol
+        # — both ends are this repo's — but declared here so the same
+        # wire-schema lint guards the contract.
+        WireSchema(
+            "request_replication-attach",
+            "F->P",
+            required=("message_request_id", "last_seq"),
+            optional=("epoch", "follower_id"),
+        ),
+        WireSchema(
+            "response_replication-attach",
+            "P->F",
+            required=("message_request_context_id", "epoch", "primary_seq"),
+            optional=("snapshot", "error"),
+        ),
+        WireSchema(
+            "event_replication-record",
+            "P->F",
+            required=("seq", "record"),
+        ),
+        WireSchema(
+            "event_replication-ack",
+            "F->P",
+            required=("seq",),
+        ),
+        WireSchema(
+            "event_worker-migrate",
+            "M->W",
+            required=("host", "port"),
+            optional=("reason",),
+        ),
         WireSchema(
             "response_job-finished",
             "W->M",

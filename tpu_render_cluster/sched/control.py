@@ -11,6 +11,9 @@ scheduler with ``nc``. Operations:
 - ``{"op": "status", "job_id": "job-0001"}`` -> ``{"ok": true, "job": {...}}``
 - ``{"op": "cancel", "job_id": "job-0001"}`` -> ``{"ok": true, "cancelled": bool}``
 - ``{"op": "drain"}`` -> stop admitting; the service exits when idle
+- ``{"op": "migrate_workers", "count": 2, "host": "...", "port": N}``
+  -> ``{"ok": true, "migrating": n}`` — shed up to ``count`` workers
+  toward another shard master (the router's rebalance move)
 - ``{"op": "alerts"}`` -> ``{"ok": true, "alerts": [...], "slo": {...}}``
   — the SLO engine's structured alert log (obs/slo.py: one ``fire`` per
   breach episode, one ``clear`` per recovery) plus the live per-job
@@ -66,6 +69,18 @@ async def handle_request(manager: "JobManager", request: dict[str, Any]) -> dict
         if op == "drain":
             manager.request_drain()
             return {"ok": True, "draining": True}
+        if op == "migrate_workers":
+            host = request.get("host")
+            port = request.get("port")
+            if not host or port is None:
+                return {"ok": False, "error": "migrate_workers requires host and port"}
+            moved = await manager.migrate_workers(
+                int(request.get("count", 1)),
+                str(host),
+                int(port),
+                reason=request.get("reason"),
+            )
+            return {"ok": True, "migrating": moved}
         if op == "alerts":
             return {
                 "ok": True,

@@ -245,8 +245,63 @@ class JobManager(ClusterManager):
             "admission_queue": list(self._admission),
             "running": list(self._running),
             "total_slots": self._total_slots(),
+            "rebalance": self.rebalance_view(),
             "jobs": {job_id: run.view() for job_id, run in self._runs.items()},
         }
+
+    def rebalance_view(self) -> dict[str, Any]:
+        """This shard's load summary, as the router's rebalancer consumes
+        it (sched/rebalance.py): backlog in units, the cost model's
+        predicted in-flight seconds (None until the model has history —
+        commensurable with ``_share_inputs``'s fallback), and live
+        workers. Queued-but-unadmitted jobs count their whole frame
+        table; they are backlog this shard owns just as much as pending
+        units of running jobs."""
+        queue_depth = 0
+        in_flight_cost: float | None = None
+        for job_id in self._running:
+            run = self._runs[job_id]
+            assert run.state is not None
+            queue_depth += run.state.pending_count() + run.state.in_flight_count()
+            cost = self._in_flight_cost(run)
+            if cost is not None:
+                in_flight_cost = (in_flight_cost or 0.0) + cost
+        for job_id in self._admission:
+            queue_depth += self._runs[job_id].spec.job.frame_count()
+        return {
+            "queue_depth": queue_depth,
+            "in_flight_cost_seconds": in_flight_cost,
+            "workers": len(self.live_workers()),
+        }
+
+    async def migrate_workers(
+        self, count: int, host: str, port: int, *, reason: str | None = None
+    ) -> int:
+        """Shed up to ``count`` live workers toward another shard master
+        (the router's rebalance move, and its drain-a-dead-shard's-load
+        primitive). Workers with the least queued work go first — their
+        goodbye returns the fewest frames to this shard's pool — and each
+        departs via the graceful migrate-goodbye path, so nothing is lost
+        mid-move. Returns how many migrate events were actually sent."""
+        workers = sorted(
+            self.live_workers(), key=lambda w: len(w.queue.all_frames())
+        )
+        moved = 0
+        for worker in workers[: max(0, int(count))]:
+            try:
+                await worker.send_migrate(host, port, reason=reason)
+            except Exception as e:  # noqa: BLE001 - worker failure mid-send
+                logger.warning(
+                    "Migrate of worker %08x to %s:%d failed: %s",
+                    worker.worker_id, host, port, e,
+                )
+                continue
+            moved += 1
+            self.metrics.counter(
+                "master_worker_migrate_requests_total",
+                "Migrate events sent to workers (shard rebalancing)",
+            ).inc()
+        return moved
 
     def cluster_view(self) -> dict:
         view = super().cluster_view()

@@ -1,0 +1,300 @@
+"""Live shard rebalancing: move worker slices from hot shards to cold.
+
+The router (ha/shards.py) partitions the job keyspace by crc32, which
+balances *submissions* but not *work*: one shard can end up with the
+render-heavy jobs while another idles. This module closes that loop.
+Each shard already exposes its own load summary through the control
+plane (``scheduler_view()["rebalance"]``: backlog units, the PR-8 cost
+model's predicted in-flight seconds, live workers); the router collects
+those, and when one shard's per-worker load stays persistently above
+another's, it tells the hot shard to shed workers toward the cold one
+(the ``migrate_workers`` control op -> per-worker migrate goodbye ->
+fresh announce on the target shard).
+
+Split in the proven chaos-planner style: a PURE planner
+(``RebalancePlanner.observe``) that turns load snapshots into at most
+one ``Move`` per tick — deterministic, clock-injected, unit-testable
+without sockets — and a thin async ``RebalanceLoop`` that feeds it real
+scrapes and executes its moves.
+
+Stability over speed: migration is expensive (a drain + reconnect per
+worker), so the planner is deliberately sluggish —
+
+- **threshold**: the hot shard's per-worker load must exceed the cold
+  shard's by a multiplicative factor (``TRC_REBALANCE_THRESHOLD``), not
+  merely be larger;
+- **hysteresis**: the imbalance must persist for N consecutive ticks
+  (``TRC_REBALANCE_HYSTERESIS_TICKS``) before the first move — a one-
+  tick spike (a job finishing, a scrape racing a dispatch burst) never
+  moves anyone;
+- **cooldown**: after a move, no further moves for
+  ``TRC_REBALANCE_COOLDOWN_SECONDS`` — migrated workers need time to
+  drain, reconnect, and show up in the target's load before the next
+  decision, otherwise the planner chases its own tail (flapping);
+- **bounded moves**: at most ``TRC_REBALANCE_MAX_MOVES`` workers per
+  move, and never below one worker left on the source shard.
+
+Enable on the router with ``--rebalance`` (or ``TRC_REBALANCE=1``);
+``TRC_REBALANCE_INTERVAL_SECONDS`` sets the scrape/decide cadence.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+import time
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Any, Awaitable, Callable
+
+from tpu_render_cluster.utils.env import env_float, env_int
+
+if TYPE_CHECKING:
+    from tpu_render_cluster.obs.registry import MetricsRegistry
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ShardLoad", "Move", "RebalancePlanner", "RebalanceLoop"]
+
+
+def rebalance_enabled() -> bool:
+    return env_int("TRC_REBALANCE", 0) != 0
+
+
+def rebalance_interval_seconds() -> float:
+    return max(0.05, env_float("TRC_REBALANCE_INTERVAL_SECONDS", 5.0))
+
+
+@dataclass(frozen=True)
+class ShardLoad:
+    """One shard's load snapshot, as scraped from its control plane."""
+
+    shard: int
+    queue_depth: int
+    in_flight_cost_seconds: float | None
+    workers: int
+    alive: bool = True
+
+    @classmethod
+    def from_view(cls, shard: int, view: dict[str, Any]) -> "ShardLoad":
+        return cls(
+            shard=shard,
+            queue_depth=int(view.get("queue_depth", 0)),
+            in_flight_cost_seconds=view.get("in_flight_cost_seconds"),
+            workers=int(view.get("workers", 0)),
+        )
+
+    @classmethod
+    def dead(cls, shard: int) -> "ShardLoad":
+        return cls(
+            shard=shard,
+            queue_depth=0,
+            in_flight_cost_seconds=None,
+            workers=0,
+            alive=False,
+        )
+
+
+@dataclass(frozen=True)
+class Move:
+    """One planner decision: shed ``count`` workers source -> target."""
+
+    source: int
+    target: int
+    count: int
+    reason: str
+
+
+class RebalancePlanner:
+    """Pure hot->cold move planner with threshold/hysteresis/cooldown.
+
+    ``observe(loads, now)`` is the whole API: feed it one snapshot per
+    tick and it returns at most one ``Move`` (or None). All state is a
+    consecutive-imbalance streak and the last-move timestamp; the clock
+    is an argument, so tests drive it deterministically.
+    """
+
+    def __init__(
+        self,
+        *,
+        threshold: float | None = None,
+        hysteresis_ticks: int | None = None,
+        cooldown_seconds: float | None = None,
+        max_moves: int | None = None,
+    ) -> None:
+        self.threshold = (
+            threshold
+            if threshold is not None
+            else max(1.0, env_float("TRC_REBALANCE_THRESHOLD", 2.0))
+        )
+        self.hysteresis_ticks = (
+            hysteresis_ticks
+            if hysteresis_ticks is not None
+            else max(1, env_int("TRC_REBALANCE_HYSTERESIS_TICKS", 3))
+        )
+        self.cooldown_seconds = (
+            cooldown_seconds
+            if cooldown_seconds is not None
+            else max(0.0, env_float("TRC_REBALANCE_COOLDOWN_SECONDS", 30.0))
+        )
+        self.max_moves = (
+            max_moves
+            if max_moves is not None
+            else max(1, env_int("TRC_REBALANCE_MAX_MOVES", 2))
+        )
+        self._streak = 0
+        self._last_move_at = -math.inf
+
+    @staticmethod
+    def _per_worker_load(load: ShardLoad, use_cost: bool) -> float:
+        raw = (
+            float(load.in_flight_cost_seconds or 0.0)
+            if use_cost
+            else float(load.queue_depth)
+        )
+        return raw / max(1, load.workers)
+
+    def observe(self, loads: list[ShardLoad], now: float) -> Move | None:
+        """One decision tick. Dead shards are excluded — their workers
+        re-home through the router's routing path, not through migrate
+        ops a dead control plane cannot serve. Cost-based load is only
+        used when EVERY live shard reports it (commensurable inputs,
+        same rule as the scheduler's own fair-share fallback)."""
+        live = [load for load in loads if load.alive]
+        if len(live) < 2:
+            self._streak = 0
+            return None
+        use_cost = all(
+            load.in_flight_cost_seconds is not None for load in live
+        )
+        hot = max(live, key=lambda load: self._per_worker_load(load, use_cost))
+        cold = min(live, key=lambda load: self._per_worker_load(load, use_cost))
+        hot_load = self._per_worker_load(hot, use_cost)
+        cold_load = self._per_worker_load(cold, use_cost)
+        imbalanced = (
+            hot.shard != cold.shard
+            and hot.workers >= 2
+            and hot_load > 0.0
+            and hot_load > cold_load * self.threshold
+        )
+        if not imbalanced:
+            self._streak = 0
+            return None
+        self._streak += 1
+        if self._streak < self.hysteresis_ticks:
+            return None
+        if now - self._last_move_at < self.cooldown_seconds:
+            return None
+        # Move toward even worker counts, never emptying the source and
+        # never more than max_moves at once.
+        count = min(
+            self.max_moves,
+            max(1, (hot.workers - cold.workers) // 2),
+            hot.workers - 1,
+        )
+        self._streak = 0
+        self._last_move_at = now
+        return Move(
+            source=hot.shard,
+            target=cold.shard,
+            count=count,
+            reason=(
+                f"per-worker load {hot_load:.3f} vs {cold_load:.3f} "
+                f"({'cost' if use_cost else 'units'}) for "
+                f"{self.hysteresis_ticks} ticks"
+            ),
+        )
+
+
+class RebalanceLoop:
+    """The router's async harness around the pure planner.
+
+    Dependency-injected at the edges (``loads_fn`` scrapes, ``move_fn``
+    executes) so it carries no socket code of its own and the router can
+    reuse its existing degradation-aware fan-out for both.
+    """
+
+    def __init__(
+        self,
+        loads_fn: Callable[[], Awaitable[list[ShardLoad]]],
+        move_fn: Callable[[Move], Awaitable[int]],
+        *,
+        planner: RebalancePlanner | None = None,
+        interval_seconds: float | None = None,
+        metrics: "MetricsRegistry | None" = None,
+    ) -> None:
+        self.loads_fn = loads_fn
+        self.move_fn = move_fn
+        self.planner = planner if planner is not None else RebalancePlanner()
+        self.interval_seconds = (
+            interval_seconds
+            if interval_seconds is not None
+            else rebalance_interval_seconds()
+        )
+        self.metrics = metrics
+        self.moves: list[dict[str, Any]] = []
+        self._running = False
+        self._task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        self._running = True
+        self._task = asyncio.create_task(self.run(), name="rebalance-loop")
+
+    async def stop(self) -> None:
+        self._running = False
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._task = None
+
+    async def run(self) -> None:
+        self._running = True
+        while self._running:
+            await asyncio.sleep(self.interval_seconds)
+            try:
+                await self.tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 - keep deciding through chaos
+                logger.warning("Rebalance tick failed: %s", e)
+
+    async def tick(self) -> Move | None:
+        """One scrape+decide+execute round (tests call this directly)."""
+        loads = await self.loads_fn()
+        if self.metrics is not None:
+            gauge = self.metrics.gauge(
+                "ha_router_shard_load_units",
+                "Per-shard backlog (pending + in-flight units) as last "
+                "scraped by the rebalancer",
+                labels=("shard",),
+            )
+            for load in loads:
+                gauge.set(float(load.queue_depth), shard=str(load.shard))
+        move = self.planner.observe(loads, time.time())
+        if move is None:
+            return None
+        moved = await self.move_fn(move)
+        logger.info(
+            "Rebalance: shard %d -> shard %d, %d/%d workers (%s).",
+            move.source, move.target, moved, move.count, move.reason,
+        )
+        self.moves.append(
+            {
+                "at": time.time(),
+                "source": move.source,
+                "target": move.target,
+                "requested": move.count,
+                "moved": moved,
+                "reason": move.reason,
+            }
+        )
+        if self.metrics is not None:
+            self.metrics.counter(
+                "ha_router_rebalance_moves_total",
+                "Worker migrations executed by the rebalancer, by edge",
+                labels=("source", "target"),
+            ).inc(moved, source=str(move.source), target=str(move.target))
+        return move

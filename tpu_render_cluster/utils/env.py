@@ -129,6 +129,18 @@ declare("TRC_HA_LEDGER", "path", None, "Write-ahead job ledger directory (master
 declare("TRC_HA_FSYNC", "flag", 1, "fsync after every ledger append")
 declare("TRC_HA_SEGMENT_RECORDS", "int", 4096, "Ledger records per segment before rotation")
 declare("TRC_HA_SNAPSHOT_EVERY", "int", 8192, "Appends between automatic ledger snapshots (0 off)")
+declare("TRC_HA_REPL_PORT", "port", None, "Ledger streaming-replication listen port (master --replicationPort default)")
+declare("TRC_HA_REPL_ACK_EVERY", "int", 32, "Applied records between follower cumulative acks")
+declare("TRC_HA_REPL_RETRY_SECONDS", "float", 0.5, "Follower reconnect delay after a broken replication stream")
+declare("TRC_HA_REPL_PROBE_SECONDS", "float", 0.5, "Router shard-liveness probe interval")
+declare("TRC_HA_REPL_PROMOTE_TIMEOUT", "float", 2.0, "Unreachable-primary window before the router promotes a follower")
+# -- live shard rebalancing ---------------------------------------------------
+declare("TRC_REBALANCE", "flag", 0, "Router-driven hot->cold worker rebalancing on/off")
+declare("TRC_REBALANCE_INTERVAL_SECONDS", "float", 5.0, "Rebalancer scrape/decide tick interval")
+declare("TRC_REBALANCE_THRESHOLD", "float", 2.0, "Hot/cold per-worker load ratio that counts as imbalanced")
+declare("TRC_REBALANCE_HYSTERESIS_TICKS", "int", 3, "Consecutive imbalanced ticks before the first move")
+declare("TRC_REBALANCE_COOLDOWN_SECONDS", "float", 30.0, "Min spacing between rebalance moves")
+declare("TRC_REBALANCE_MAX_MOVES", "int", 2, "Max workers migrated per rebalance move")
 
 
 # ---------------------------------------------------------------------------

@@ -119,6 +119,15 @@ def build_parser() -> argparse.ArgumentParser:
         "the master's posture; use 127.0.0.1 to keep them local).",
     )
     parser.add_argument(
+        "--router",
+        default=None,
+        help="host:port of the shard router's control endpoint. When set, "
+        "a lost master does not end this worker: it asks the router's "
+        "route_worker op for the least-loaded live shard and re-homes "
+        "there (requires the router to be started with --shardWorkers). "
+        "Master-requested migrations (rebalancing) are also followed.",
+    )
+    parser.add_argument(
         "--warmScene",
         dest="warm_scene",
         default=None,
@@ -175,10 +184,46 @@ def make_backend(args: argparse.Namespace):
     return create_backend("mock")
 
 
+ROUTE_ATTEMPTS = 10
+ROUTE_RETRY_SECONDS = 0.25
+
+
+def make_router_route_fn(router: str):
+    """``route_fn`` for ``Worker.connect_and_serve``: ask the shard
+    router where to (re)connect. A worker loses its master at exactly the
+    moment the control plane is most likely to be churning (a shard died,
+    maybe the router is restarting too), so the lookup retries for a few
+    seconds before giving up; None (exit) only when the router stays
+    unreachable or has no live shard to offer for the whole window."""
+    host, _, port_text = router.rpartition(":")
+    if not host:
+        raise SystemExit(f"--router must be host:port, got {router!r}")
+    port = int(port_text)
+
+    async def route_fn() -> tuple[str, int] | None:
+        from tpu_render_cluster.sched.control import control_request
+
+        for attempt in range(ROUTE_ATTEMPTS):
+            try:
+                response = await control_request(
+                    host, port, {"op": "route_worker"}, timeout=10.0
+                )
+            except (OSError, ValueError, ConnectionError, asyncio.TimeoutError):
+                response = None
+            if response is not None and response.get("ok"):
+                return str(response["host"]), int(response["port"])
+            if attempt + 1 < ROUTE_ATTEMPTS:
+                await asyncio.sleep(ROUTE_RETRY_SECONDS)
+        return None
+
+    return route_fn
+
+
 async def _run_worker(
     worker: Worker,
     telemetry_port: int | None = None,
     telemetry_host: str = "0.0.0.0",
+    router: str | None = None,
 ):
     """Run to completion with SIGTERM wired to a graceful drain.
 
@@ -224,6 +269,8 @@ async def _run_worker(
         )
         await telemetry.start()
     try:
+        if router is not None:
+            return await worker.connect_and_serve(make_router_route_fn(router))
         return await worker.connect_and_run_to_job_completion()
     finally:
         if telemetry is not None:
@@ -249,7 +296,9 @@ def main(argv: list[str] | None = None) -> int:
         args.telemetry_port, "TRC_OBS_WORKER_PORT"
     )
     try:
-        asyncio.run(_run_worker(worker, telemetry_port, args.telemetry_host))
+        asyncio.run(
+            _run_worker(worker, telemetry_port, args.telemetry_host, args.router)
+        )
     finally:
         # Export this daemon's obs artifacts even when the run died (the
         # partial timeline matters most in exactly those runs): in

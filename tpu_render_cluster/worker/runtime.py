@@ -157,6 +157,9 @@ class Worker:
         # announces first-connection (restarted epoch-less master).
         self._force_fresh_announce = False
         self._frame_queue: WorkerAutomaticQueue | None = None
+        # Set by event_worker-migrate: after the drain-style goodbye, the
+        # serve loop reconnects here instead of exiting (rebalancing).
+        self._migrate_target: tuple[str, int] | None = None
 
     def _begin_fresh_session(self) -> None:
         """A reconnect landed on a NEW master incarnation (epoch change or
@@ -186,6 +189,67 @@ class Worker:
         and disconnect. Wired to SIGTERM by the CLI; safe to call from
         any task on the worker's loop, idempotent."""
         self._drain_requested.set()
+
+    def _reset_for_rerun(self, host: str, port: int) -> None:
+        """Point the worker at another master and refresh every per-run
+        token so ``connect_and_run_to_job_completion`` can run again. The
+        new master is a DIFFERENT incarnation by definition, so the next
+        handshake announces a fresh first-connection session (the PR-11
+        re-announce path — no change to the fencing contract)."""
+        self.master_host = host
+        self.master_port = port
+        self.cancellation = CancellationToken()
+        self._drain_requested = asyncio.Event()
+        self._migrate_target = None
+        self._master_epoch = None
+        self._force_fresh_announce = True
+        self._client = None
+        self._final_trace = None
+
+    async def connect_and_serve(
+        self,
+        route_fn: Callable[[], "asyncio.Future | object"] | None = None,
+    ) -> WorkerTrace:
+        """Run the job protocol, following migrations and router re-homes.
+
+        Wraps :meth:`connect_and_run_to_job_completion` in a loop:
+
+        - a run that ended because the master sent ``event_worker-migrate``
+          reconnects to the migration target and keeps serving;
+        - a run that DIED (connect retries exhausted — the shard's master
+          is gone) asks the async ``route_fn`` for a new ``(host, port)``
+          and re-homes there; without a ``route_fn`` (or when it returns
+          None) the failure propagates exactly as before.
+
+        Each hop re-announces a fresh session, so the receiving master
+        sees an ordinary late-joining worker.
+        """
+        rehomes = 0
+        while True:
+            try:
+                trace = await self.connect_and_run_to_job_completion()
+            except (WebSocketClosed, ConnectionError, OSError, asyncio.TimeoutError):
+                if route_fn is None:
+                    raise
+                target = await route_fn()
+                if target is None or rehomes >= 16:
+                    raise
+                rehomes += 1
+                host, port = target
+                logger.info(
+                    "Master %s:%d unreachable; re-homing to %s:%d (%d/16).",
+                    self.master_host, self.master_port, host, port, rehomes,
+                )
+                self._reset_for_rerun(host, port)
+                continue
+            if self._migrate_target is not None:
+                host, port = self._migrate_target
+                logger.info(
+                    "Migrating to %s:%d as requested by the master.", host, port
+                )
+                self._reset_for_rerun(host, port)
+                continue
+            return trace
 
     async def connect_and_run_to_job_completion(self) -> WorkerTrace:
         """Connect, serve the job protocol until job-finished, return the trace."""
@@ -332,7 +396,39 @@ class Worker:
         remove_queue = router.subscribe(pm.MasterFrameQueueRemoveRequest)
         started_queue = router.subscribe(pm.MasterJobStartedEvent)
         finished_queue = router.subscribe(pm.MasterJobFinishedRequest)
+        migrate_queue = router.subscribe(pm.MasterWorkerMigrateEvent)
         job_done = asyncio.Event()
+
+        async def depart(reason: str) -> None:
+            """Drain-style graceful departure: finish the in-flight frame,
+            return the queued rest via the goodbye, close out the trace
+            locally (no job-finished request will come for a departed
+            worker), and end this run."""
+            returned = await frame_queue.drain()
+            job_name = returned[0][0] if returned else None
+            await sender.send_message(
+                pm.WorkerGoodbyeEvent(
+                    reason=reason,
+                    job_name=job_name,
+                    returned_frames=tuple(
+                        unit.frame_index for _, unit in returned
+                    ),
+                    returned_tiles=(
+                        tuple(unit.tile for _, unit in returned)
+                        if any(unit.tile is not None for _, unit in returned)
+                        else None
+                    ),
+                )
+            )
+            logger.info(
+                "Goodbye sent (%s, %d frame(s) returned); disconnecting.",
+                reason,
+                len(returned),
+            )
+            self.tracer.ensure_job_start_time(time.time())
+            self.tracer.set_job_finish_time(time.time())
+            self._final_trace = self.tracer.build()
+            job_done.set()
 
         async def handle_adds() -> None:
             while True:
@@ -444,33 +540,24 @@ class Worker:
         async def handle_drain() -> None:
             await self._drain_requested.wait()
             logger.info("Drain requested; finishing the in-flight frame.")
-            returned = await frame_queue.drain()
-            job_name = returned[0][0] if returned else None
-            await sender.send_message(
-                pm.WorkerGoodbyeEvent(
-                    reason="drain",
-                    job_name=job_name,
-                    returned_frames=tuple(
-                        unit.frame_index for _, unit in returned
-                    ),
-                    returned_tiles=(
-                        tuple(unit.tile for _, unit in returned)
-                        if any(unit.tile is not None for _, unit in returned)
-                        else None
-                    ),
-                )
-            )
+            await depart("drain")
+
+        async def handle_migrate() -> None:
+            event = await migrate_queue.get()
             logger.info(
-                "Goodbye sent (%d frame(s) returned); disconnecting.",
-                len(returned),
+                "Migrate requested (%s:%d%s); finishing the in-flight frame.",
+                event.host,
+                event.port,
+                f", {event.reason}" if event.reason is not None else "",
             )
-            # No job-finished request will come for a departed worker:
-            # close out the trace locally so the caller still gets one
-            # (ensure_* covers a drain before any job ever started).
-            self.tracer.ensure_job_start_time(time.time())
-            self.tracer.set_job_finish_time(time.time())
-            self._final_trace = self.tracer.build()
-            job_done.set()
+            # Record the target FIRST: the serve loop reads it after this
+            # run unwinds to decide between exit and re-home.
+            self._migrate_target = (event.host, event.port)
+            self.metrics.counter(
+                "worker_migrations_total",
+                "Master-requested re-homes to another shard (rebalancing)",
+            ).inc()
+            await depart("migrate")
 
         tasks = [
             asyncio.create_task(handle_adds()),
@@ -478,10 +565,31 @@ class Worker:
             asyncio.create_task(handle_job_started()),
             asyncio.create_task(handle_job_finished()),
             asyncio.create_task(handle_drain()),
+            asyncio.create_task(handle_migrate()),
         ]
+        job_done_task = asyncio.create_task(job_done.wait())
         try:
-            await job_done.wait()
+            # Select on BOTH job completion and receive-loop death: when
+            # the master is gone for good (the reconnect budget exhausted
+            # inside the receive op), no job-finished event will ever set
+            # ``job_done`` — the failure must propagate so the serve loop
+            # (``connect_and_serve``) can ask the router for a new home
+            # instead of parking this worker forever.
+            await asyncio.wait(
+                {job_done_task, router.dead},
+                return_when=asyncio.FIRST_COMPLETED,
+            )
+            if not job_done.is_set():
+                error = router.dead.result()
+                if error is not None:
+                    raise error
+                raise WebSocketClosed(
+                    "Receive loop ended before the job finished."
+                )
         finally:
+            job_done_task.cancel()
             for task in tasks:
                 task.cancel()
-            await asyncio.gather(*tasks, return_exceptions=True)
+            await asyncio.gather(
+                job_done_task, *tasks, return_exceptions=True
+            )
